@@ -1,0 +1,12 @@
+# Root conftest: force JAX onto a virtual 8-device CPU mesh BEFORE jax import.
+# Mirrors the reference's CI strategy of substituting real services with local
+# stand-ins (reference .github/workflows/go.yml:61-91 runs Kafka/Redis/MySQL
+# containers; our "service container" is the CPU PJRT backend).
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

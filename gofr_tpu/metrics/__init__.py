@@ -1,0 +1,242 @@
+"""Metrics: manager + instruments + Prometheus text exposition.
+
+Parity: reference pkg/gofr/metrics/ — Manager interface with
+new/increment Counter, UpDownCounter, Histogram, Gauge (register.go:15-25),
+name->instrument store (store.go:7-34), synchronous gauge (register.go:40-46),
+label validation warnings, Prometheus exporter (exporters/exporter.go:14-29).
+
+Implementation is self-contained (no OTel SDK in the hot path): instruments
+are lock-light — counters/gauges use a per-instrument dict guarded by a small
+lock; histograms pre-compute bucket bounds. The serving hot loop records two
+histograms per request (http + tpu), same budget as the reference
+(SURVEY.md §3.3).
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+from typing import Iterable
+
+from ..logging import Logger
+
+DEFAULT_HISTOGRAM_BUCKETS = (
+    0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10,
+)
+# Reference container.go:176: .001 - 30s for HTTP response histograms.
+HTTP_BUCKETS = (0.001, 0.003, 0.005, 0.01, 0.02, 0.03, 0.05, 0.1, 0.2, 0.3, 0.5, 0.75, 1, 2, 3, 5, 10, 30)
+# Reference container.go:182-188: sub-ms buckets for datasource ops.
+DATASOURCE_BUCKETS = (0.00005, 0.0001, 0.00025, 0.0005, 0.001, 0.002, 0.003, 0.005, 0.0075, 0.01)
+# TPU execute latencies: 100us .. 5s (first decode steps / big batches).
+TPU_BUCKETS = (0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0)
+
+
+def _label_key(labels: dict[str, str]) -> tuple[tuple[str, str], ...]:
+    return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+
+
+class _Instrument:
+    kind = "untyped"
+
+    def __init__(self, name: str, description: str):
+        self.name = name
+        self.description = description
+        self._lock = threading.Lock()
+        self._series: dict[tuple[tuple[str, str], ...], float] = {}
+
+    def _bump(self, delta: float, labels: dict[str, str]) -> None:
+        key = _label_key(labels)
+        with self._lock:
+            self._series[key] = self._series.get(key, 0.0) + delta
+
+    def _set(self, value: float, labels: dict[str, str]) -> None:
+        key = _label_key(labels)
+        with self._lock:
+            self._series[key] = value
+
+    def collect(self) -> Iterable[tuple[str, dict[str, str], float]]:
+        with self._lock:
+            items = list(self._series.items())
+        for key, value in items:
+            yield self.name, dict(key), value
+
+
+class Counter(_Instrument):
+    kind = "counter"
+
+    def increment(self, by: float = 1.0, **labels: str) -> None:
+        self._bump(by, labels)
+
+
+class UpDownCounter(_Instrument):
+    kind = "gauge"  # prometheus has no native updown; exposed as gauge
+
+    def delta(self, by: float, **labels: str) -> None:
+        self._bump(by, labels)
+
+
+class Gauge(_Instrument):
+    kind = "gauge"
+
+    def set(self, value: float, **labels: str) -> None:
+        self._set(value, labels)
+
+
+class Histogram:
+    kind = "histogram"
+
+    def __init__(self, name: str, description: str, buckets: tuple[float, ...]):
+        self.name = name
+        self.description = description
+        self.buckets = tuple(sorted(buckets))
+        self._lock = threading.Lock()
+        # per label-set: [bucket counts..., +inf count], sum, count
+        self._series: dict[tuple[tuple[str, str], ...], list] = {}
+
+    def record(self, value: float, **labels: str) -> None:
+        key = _label_key(labels)
+        idx = bisect.bisect_left(self.buckets, value)
+        with self._lock:
+            s = self._series.get(key)
+            if s is None:
+                s = [[0] * (len(self.buckets) + 1), 0.0, 0]
+                self._series[key] = s
+            s[0][idx] += 1
+            s[1] += value
+            s[2] += 1
+
+    def collect_histogram(self):
+        with self._lock:
+            items = [(k, ([*v[0]], v[1], v[2])) for k, v in self._series.items()]
+        return items
+
+    def percentile(self, q: float, **labels: str) -> float:
+        """Approximate percentile from bucket midpoints (for health/bench)."""
+        key = _label_key(labels)
+        with self._lock:
+            s = self._series.get(key)
+            if not s:
+                return 0.0
+            counts, _, total = [*s[0]], s[1], s[2]
+        if total == 0:
+            return 0.0
+        target = q * total
+        acc = 0
+        for i, c in enumerate(counts):
+            acc += c
+            if acc >= target:
+                if i < len(self.buckets):
+                    return self.buckets[i]
+                return self.buckets[-1]
+        return self.buckets[-1]
+
+
+class Manager:
+    """Name->instrument registry. Parity: metrics/register.go + store.go."""
+
+    def __init__(self, logger: Logger | None = None):
+        self._logger = logger
+        self._lock = threading.Lock()
+        self._instruments: dict[str, object] = {}
+
+    def _register(self, name: str, inst):
+        with self._lock:
+            if name in self._instruments:
+                if self._logger:
+                    self._logger.warn(f"metric {name} already registered")
+                return self._instruments[name]
+            self._instruments[name] = inst
+            return inst
+
+    def new_counter(self, name: str, description: str = "") -> Counter:
+        return self._register(name, Counter(name, description))
+
+    def new_updown_counter(self, name: str, description: str = "") -> UpDownCounter:
+        return self._register(name, UpDownCounter(name, description))
+
+    def new_gauge(self, name: str, description: str = "") -> Gauge:
+        return self._register(name, Gauge(name, description))
+
+    def new_histogram(
+        self, name: str, description: str = "", buckets: tuple[float, ...] = DEFAULT_HISTOGRAM_BUCKETS
+    ) -> Histogram:
+        return self._register(name, Histogram(name, description, buckets))
+
+    def _get(self, name: str, kind):
+        inst = self._instruments.get(name)
+        if inst is None or not isinstance(inst, kind):
+            if self._logger:
+                self._logger.error(f"metric {name} not registered as {kind.__name__}")
+            return None
+        return inst
+
+    # Verb API mirroring the reference Manager (register.go:15-25): callers
+    # address instruments by name so user code never holds instrument objects.
+    def increment_counter(self, name: str, by: float = 1.0, **labels: str) -> None:
+        c = self._get(name, Counter)
+        if c:
+            c.increment(by, **labels)
+
+    def delta_updown_counter(self, name: str, by: float, **labels: str) -> None:
+        c = self._get(name, UpDownCounter)
+        if c:
+            c.delta(by, **labels)
+
+    def record_histogram(self, name: str, value: float, **labels: str) -> None:
+        h = self._get(name, Histogram)
+        if h:
+            h.record(value, **labels)
+
+    def set_gauge(self, name: str, value: float, **labels: str) -> None:
+        g = self._get(name, Gauge)
+        if g:
+            g.set(value, **labels)
+
+    def histogram(self, name: str) -> Histogram | None:
+        return self._get(name, Histogram)
+
+    # -- exposition --
+    def render_prometheus(self) -> str:
+        """Prometheus text format 0.0.4."""
+        with self._lock:
+            instruments = list(self._instruments.values())
+        out: list[str] = []
+        for inst in instruments:
+            name = inst.name  # type: ignore[attr-defined]
+            if inst.description:  # type: ignore[attr-defined]
+                out.append(f"# HELP {name} {inst.description}")  # type: ignore[attr-defined]
+            out.append(f"# TYPE {name} {inst.kind}")  # type: ignore[attr-defined]
+            if isinstance(inst, Histogram):
+                for key, (counts, total_sum, count) in inst.collect_histogram():
+                    base = dict(key)
+                    acc = 0
+                    for ub, c in zip(inst.buckets, counts):
+                        acc += c
+                        out.append(_line(f"{name}_bucket", {**base, "le": _fmt(ub)}, acc))
+                    acc += counts[-1]
+                    out.append(_line(f"{name}_bucket", {**base, "le": "+Inf"}, acc))
+                    out.append(_line(f"{name}_sum", base, total_sum))
+                    out.append(_line(f"{name}_count", base, count))
+            else:
+                for mname, labels, value in inst.collect():  # type: ignore[attr-defined]
+                    out.append(_line(mname, labels, value))
+        return "\n".join(out) + "\n"
+
+
+def _fmt(v: float) -> str:
+    return f"{v:g}"
+
+
+def _line(name: str, labels: dict[str, str], value) -> str:
+    if labels:
+        lab = ",".join(f'{k}="{_escape(v)}"' for k, v in sorted(labels.items()))
+        return f"{name}{{{lab}}} {_fmt(float(value))}"
+    return f"{name} {_fmt(float(value))}"
+
+
+def _escape(v: str) -> str:
+    return str(v).replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def new_metrics_manager(logger: Logger | None = None) -> Manager:
+    return Manager(logger)

@@ -1,0 +1,72 @@
+"""Config tests. Mirrors reference config/godotenv_test.go behavior."""
+
+import os
+
+from gofr_tpu.config import EnvConfig, MapConfig, new_mock_config
+
+
+def write(path, content):
+    with open(path, "w") as f:
+        f.write(content)
+
+
+def test_env_file_loading(tmp_path):
+    cfg_dir = tmp_path / "configs"
+    cfg_dir.mkdir()
+    write(cfg_dir / ".env", "APP_NAME=test-app\nHTTP_PORT=8001\n# comment\nQUOTED=\"hello world\"\n")
+    c = EnvConfig(str(cfg_dir), environ={})
+    assert c.get("APP_NAME") == "test-app"
+    assert c.get("HTTP_PORT") == "8001"
+    assert c.get("QUOTED") == "hello world"
+    assert c.get("MISSING") is None
+    assert c.get_or_default("MISSING", "x") == "x"
+
+
+def test_local_env_overrides(tmp_path):
+    cfg_dir = tmp_path / "configs"
+    cfg_dir.mkdir()
+    write(cfg_dir / ".env", "A=base\nB=base\n")
+    write(cfg_dir / ".local.env", "A=local\n")
+    c = EnvConfig(str(cfg_dir), environ={})
+    assert c.get("A") == "local"
+    assert c.get("B") == "base"
+
+
+def test_app_env_selects_override_file(tmp_path):
+    cfg_dir = tmp_path / "configs"
+    cfg_dir.mkdir()
+    write(cfg_dir / ".env", "A=base\nAPP_ENV=staging\n")
+    write(cfg_dir / ".staging.env", "A=staging\n")
+    write(cfg_dir / ".local.env", "A=local\n")
+    c = EnvConfig(str(cfg_dir), environ={})
+    assert c.get("A") == "staging"
+
+
+def test_process_env_wins(tmp_path):
+    cfg_dir = tmp_path / "configs"
+    cfg_dir.mkdir()
+    write(cfg_dir / ".env", "A=file\n")
+    c = EnvConfig(str(cfg_dir), environ={"A": "proc"})
+    assert c.get("A") == "proc"
+
+
+def test_missing_dir_ok(tmp_path):
+    c = EnvConfig(str(tmp_path / "nope"), environ={"X": "1"})
+    assert c.get("X") == "1"
+    assert c.get("Y") is None
+
+
+def test_typed_getters():
+    c = new_mock_config({"I": "5", "F": "2.5", "B": "true", "BAD": "zz"})
+    assert c.get_int("I", 1) == 5
+    assert c.get_int("BAD", 7) == 7
+    assert c.get_int("MISSING", 3) == 3
+    assert c.get_float("F", 0.0) == 2.5
+    assert c.get_bool("B") is True
+    assert c.get_bool("MISSING", True) is True
+
+
+def test_map_config_set():
+    c = MapConfig()
+    c.set("K", "V")
+    assert c.get("K") == "V"

@@ -1,0 +1,86 @@
+"""Logging tests. Mirrors reference logging/logger_test.go strategy of
+asserting emitted output (testutil.StdoutOutputForFunc analogue: MockLogger
+captures streams directly)."""
+
+import io
+import json
+
+from gofr_tpu import logging as gl
+
+
+def test_levels_filtering():
+    log = gl.new_mock_logger(level=gl.WARN)
+    log.debug("d")
+    log.info("i")
+    log.warn("w")
+    log.error("e")
+    assert log.messages() == ["w", "e"]
+
+
+def test_json_output_shape():
+    out, err = io.StringIO(), io.StringIO()
+    log = gl.Logger(level=gl.DEBUG, out=out, err=err, pretty=False)
+    log.info("hello", request_id="abc")
+    rec = json.loads(out.getvalue())
+    assert rec["level"] == "INFO"
+    assert rec["message"] == "hello"
+    assert rec["request_id"] == "abc"
+    assert rec["time"].endswith("Z")
+
+
+def test_error_goes_to_stderr():
+    out, err = io.StringIO(), io.StringIO()
+    log = gl.Logger(level=gl.DEBUG, out=out, err=err, pretty=False)
+    log.info("fine")
+    log.error("boom")
+    log.fatal("dead")
+    assert "fine" in out.getvalue()
+    assert "boom" in err.getvalue()
+    assert "dead" in err.getvalue()
+    assert "boom" not in out.getvalue()
+
+
+def test_pretty_print_hook():
+    class QueryLog:
+        def pretty_print(self, writer):
+            writer.write("QUERY select-1 2ms")
+
+    out = io.StringIO()
+    log = gl.Logger(level=gl.DEBUG, out=out, err=io.StringIO(), pretty=True)
+    log.info(QueryLog())
+    assert "QUERY select-1 2ms" in out.getvalue()
+
+
+def test_structured_payload_to_log_dict():
+    class RequestLog:
+        def to_log_dict(self):
+            return {"method": "GET", "uri": "/x"}
+
+    out = io.StringIO()
+    log = gl.Logger(level=gl.DEBUG, out=out, err=io.StringIO(), pretty=False)
+    log.info(RequestLog())
+    rec = json.loads(out.getvalue())
+    assert rec["message"] == {"method": "GET", "uri": "/x"}
+
+
+def test_change_level():
+    log = gl.new_mock_logger(level=gl.INFO)
+    log.debug("hidden")
+    log.change_level(gl.DEBUG)
+    log.debug("shown")
+    assert log.messages() == ["shown"]
+
+
+def test_level_from_string():
+    assert gl.level_from_string("debug") == gl.DEBUG
+    assert gl.level_from_string("FATAL") == gl.FATAL
+    assert gl.level_from_string("bogus") == gl.INFO
+    assert gl.level_from_string(None) == gl.INFO
+
+
+def test_file_logger(tmp_path):
+    p = tmp_path / "app.log"
+    log = gl.new_file_logger(str(p), level=gl.INFO)
+    log.info("to-file")
+    log._out.flush()
+    assert "to-file" in p.read_text()

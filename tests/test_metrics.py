@@ -1,0 +1,94 @@
+"""Metrics tests. Mirrors reference metrics/metrics_test.go +
+exporters/exporter_test.go concerns: instrument registry, verb API by name,
+prometheus exposition."""
+
+import urllib.request
+
+from gofr_tpu import metrics as gm
+from gofr_tpu.logging import new_mock_logger
+from gofr_tpu.metrics.server import MetricsServer
+
+
+def test_counter_and_labels():
+    m = gm.new_metrics_manager()
+    m.new_counter("reqs", "total requests")
+    m.increment_counter("reqs", path="/a", method="GET")
+    m.increment_counter("reqs", path="/a", method="GET")
+    m.increment_counter("reqs", path="/b", method="GET")
+    text = m.render_prometheus()
+    assert 'reqs{method="GET",path="/a"} 2' in text
+    assert 'reqs{method="GET",path="/b"} 1' in text
+    assert "# TYPE reqs counter" in text
+
+
+def test_updown_and_gauge():
+    m = gm.new_metrics_manager()
+    m.new_updown_counter("inflight")
+    m.delta_updown_counter("inflight", 3)
+    m.delta_updown_counter("inflight", -1)
+    m.new_gauge("temp")
+    m.set_gauge("temp", 42.5, zone="a")
+    text = m.render_prometheus()
+    assert "inflight 2" in text
+    assert 'temp{zone="a"} 42.5' in text
+
+
+def test_histogram_exposition_cumulative():
+    m = gm.new_metrics_manager()
+    m.new_histogram("lat", "latency", buckets=(0.1, 0.5, 1.0))
+    for v in (0.05, 0.2, 0.7, 2.0):
+        m.record_histogram("lat", v)
+    text = m.render_prometheus()
+    assert 'lat_bucket{le="0.1"} 1' in text
+    assert 'lat_bucket{le="0.5"} 2' in text
+    assert 'lat_bucket{le="1"} 3' in text
+    assert 'lat_bucket{le="+Inf"} 4' in text
+    assert "lat_count 4" in text
+    assert "lat_sum 2.95" in text
+
+
+def test_histogram_percentile():
+    m = gm.new_metrics_manager()
+    h = m.new_histogram("p", buckets=(0.001, 0.01, 0.1, 1.0))
+    for _ in range(90):
+        h.record(0.005)
+    for _ in range(10):
+        h.record(0.5)
+    assert h.percentile(0.5) == 0.01
+    assert h.percentile(0.99) == 1.0
+
+
+def test_unregistered_metric_logs_error():
+    log = new_mock_logger()
+    m = gm.new_metrics_manager(log)
+    m.increment_counter("nope")
+    assert any("not registered" in msg for msg in log.messages())
+
+
+def test_duplicate_registration_returns_existing():
+    m = gm.new_metrics_manager()
+    a = m.new_counter("dup")
+    b = m.new_counter("dup")
+    assert a is b
+
+
+def test_metrics_server_scrape():
+    m = gm.new_metrics_manager()
+    m.new_counter("hits")
+    m.increment_counter("hits")
+    # runtime gauges are registered by the container normally; register here
+    for g in ("app_python_threads", "app_python_gc_gen0", "app_python_num_gc", "app_sys_memory_rss"):
+        m.new_gauge(g)
+    srv = MetricsServer(m, port=0, host="127.0.0.1")
+    srv.start()
+    try:
+        with urllib.request.urlopen(f"http://127.0.0.1:{srv.port}/metrics") as resp:
+            body = resp.read().decode()
+        assert "hits 1" in body
+        assert "app_python_threads" in body
+        with urllib.request.urlopen(f"http://127.0.0.1:{srv.port}/nope") as resp:
+            pass
+    except urllib.error.HTTPError as e:
+        assert e.code == 404
+    finally:
+        srv.shutdown()

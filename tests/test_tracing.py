@@ -1,0 +1,81 @@
+"""Tracing tests: span lifecycle, contextvar parenting, W3C propagation,
+batch export. Mirrors reference exporter_test.go / middleware/tracer_test.go
+concerns."""
+
+import time
+
+from gofr_tpu import tracing as gt
+from gofr_tpu.config import new_mock_config
+
+
+def test_span_basic():
+    t = gt.Tracer("svc")
+    s = t.start_span("op")
+    assert len(s.trace_id) == 32 and len(s.span_id) == 16
+    s.set_attribute("k", "v")
+    s.end()
+    assert s.end_ns >= s.start_ns
+    assert s.attributes["k"] == "v"
+
+
+def test_child_span_inherits_trace():
+    t = gt.Tracer("svc")
+    with t.start_span("parent") as parent:
+        child = t.start_span("child")
+        assert child.trace_id == parent.trace_id
+        assert child.parent_id == parent.span_id
+        child.end()
+    after = t.start_span("after")
+    assert after.trace_id != parent.trace_id
+    after.end()
+
+
+def test_traceparent_roundtrip():
+    t = gt.Tracer("svc")
+    s = t.start_span("op")
+    parsed = gt.parse_traceparent(s.traceparent)
+    assert parsed == (s.trace_id, s.span_id)
+    s.end()
+
+    child = t.start_span("remote-child", traceparent=s.traceparent)
+    assert child.trace_id == s.trace_id
+    assert child.parent_id == s.span_id
+    child.end()
+
+
+def test_parse_traceparent_invalid():
+    assert gt.parse_traceparent(None) is None
+    assert gt.parse_traceparent("") is None
+    assert gt.parse_traceparent("00-bad") is None
+    assert gt.parse_traceparent("00-" + "z" * 32 + "-" + "1" * 16 + "-01") is None
+    assert gt.parse_traceparent("00-" + "0" * 32 + "-" + "1" * 16 + "-01") is None
+
+
+def test_exception_marks_error():
+    t = gt.Tracer("svc")
+    try:
+        with t.start_span("boom") as s:
+            raise ValueError("x")
+    except ValueError:
+        pass
+    assert s.status == "ERROR"
+
+
+def test_memory_exporter_batches():
+    cfg = new_mock_config({"TRACE_EXPORTER": "memory", "APP_NAME": "t"})
+    t = gt.new_tracer(cfg)
+    for i in range(3):
+        t.start_span(f"s{i}").end()
+    deadline = time.time() + 5
+    while time.time() < deadline and len(t.exporter.spans) < 3:
+        time.sleep(0.05)
+        t._processor._flush()
+    assert len(t.exporter.spans) == 3
+    t.shutdown()
+
+
+def test_no_exporter_tracer():
+    cfg = new_mock_config({})
+    t = gt.new_tracer(cfg)
+    s = t.start_span("cheap")
+    s.end()  # must not raise
