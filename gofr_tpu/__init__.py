@@ -1,0 +1,59 @@
+"""gofr_tpu: a TPU-native opinionated microservice framework.
+
+The capability surface of GoFr (reference: /root/reference, an opinionated Go
+microservice framework) re-designed TPU-first: HTTP/gRPC/CLI/pub-sub handlers
+share one Context; a DI container wires logging/metrics/tracing/datasources;
+and the TPU is a first-class datasource — `ctx.tpu()` — with a model
+registry, AOT-compiled executables, dynamic batching, tensor-parallel
+sharding over a device mesh, and continuous-batching LLM decode.
+
+Quick start::
+
+    import gofr_tpu
+
+    app = gofr_tpu.new()
+
+    def greet(ctx):
+        return "Hello World!"
+
+    app.get("/greet", greet)
+    app.run()
+"""
+
+from .app import App, new
+from .context import Context
+from .http import (
+    ErrorEntityNotFound,
+    ErrorInvalidParam,
+    ErrorInvalidRoute,
+    ErrorMissingParam,
+    HTTPError,
+    Raw,
+)
+from .http.responder import FileResponse, Redirect, StreamingResponse
+from .version import FRAMEWORK
+
+__version__ = FRAMEWORK
+
+__all__ = [
+    "App",
+    "Context",
+    "ErrorEntityNotFound",
+    "ErrorInvalidParam",
+    "ErrorInvalidRoute",
+    "ErrorMissingParam",
+    "FileResponse",
+    "HTTPError",
+    "Raw",
+    "Redirect",
+    "StreamingResponse",
+    "new",
+    "new_cmd",
+]
+
+
+def new_cmd(config=None, configs_dir: str = "./configs"):
+    """CLI-app constructor (gofr.go:101). Lazy import: CMD apps skip servers."""
+    from .cmd import CMDApp
+
+    return CMDApp(config=config, configs_dir=configs_dir)

@@ -1,0 +1,315 @@
+"""App: the composition root.
+
+Parity: reference pkg/gofr/gofr.go — New()/NewCMD() (gofr.go:64,101), Run()
+(gofr.go:116), HTTP verbs (gofr.go:234-256), Subscribe (gofr.go:384),
+Migrate (gofr.go:281), AddCronJob (gofr.go:414), AddRESTHandlers
+(gofr.go:394), AddHTTPService (gofr.go:221), auth enablement
+(gofr.go:348-382), UseMiddleware (gofr.go:408), Shutdown (gofr.go:182),
+well-known route registration (gofr.go:137-150).
+
+Default ports (reference default.go:3-7): HTTP 8000, gRPC 9000, metrics 2121.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import signal
+import threading
+from typing import Any, Callable
+
+from .config import Config, EnvConfig
+from .container import Container
+from .context import Context
+from .handler import favicon_wire_handler, health_handler, live_handler, wrap_handler
+from .http.middleware import (
+    apikey_auth_middleware,
+    basic_auth_middleware,
+    cors_middleware,
+    logging_middleware,
+    metrics_middleware,
+    oauth_middleware,
+    tracer_middleware,
+)
+from .http.router import Router
+from .http.server import AsyncHTTPServer
+from .metrics.server import MetricsServer
+from .tracing import new_tracer
+
+
+class App:
+    def __init__(self, config: Config | None = None, configs_dir: str = "./configs"):
+        self.config: Config = config if config is not None else EnvConfig(configs_dir)
+        self.container = Container.create(self.config)
+        self.logger = self.container.logger
+        self.tracer = new_tracer(self.config, self.logger)
+        self.container.tracer = self.tracer  # type: ignore[attr-defined]
+
+        self.http_port = self.config.get_int("HTTP_PORT", 8000)
+        self.grpc_port = self.config.get_int("GRPC_PORT", 9000)
+        self.metrics_port = self.config.get_int("METRICS_PORT", 2121)
+        self.request_timeout = self.config.get_float("REQUEST_TIMEOUT", 5.0)
+
+        self.router = Router()
+        # Default chain, reference order (router.go:23-28): Tracer -> Logging -> CORS -> Metrics
+        self.router.use(tracer_middleware(self.tracer))
+        self.router.use(logging_middleware(self.logger))
+        self.router.use(cors_middleware(self._cors_overrides()))
+        self.router.use(metrics_middleware(self.container.metrics))
+
+        self.http_server = AsyncHTTPServer(self.router.dispatch, self.http_port, logger=self.logger)
+        self.metrics_server = MetricsServer(self.container.metrics, self.metrics_port)
+        self.grpc_server = None  # created on first register_service
+        self._grpc_registered = False
+
+        self._subscriptions: dict[str, Callable] = {}
+        self._cron = None
+        self._static_dirs: list[tuple[str, str]] = []
+        self._route_registered = False
+        self._shutdown_event: asyncio.Event | None = None
+        self._loop: asyncio.AbstractEventLoop | None = None
+        self._bg_tasks: list[asyncio.Task] = []
+
+    def _cors_overrides(self) -> dict[str, str]:
+        """ACCESS_CONTROL_ALLOW_* env overrides -> header names."""
+        out = {}
+        for key in ("ACCESS_CONTROL_ALLOW_ORIGIN", "ACCESS_CONTROL_ALLOW_HEADERS", "ACCESS_CONTROL_ALLOW_CREDENTIALS"):
+            v = self.config.get(key)
+            if v:
+                header = "-".join(w.capitalize() for w in key.split("_"))
+                out[header] = v
+        return out
+
+    # ---- route registration (gofr.go:234-256) ----
+    def _add(self, method: str, path: str, handler: Callable) -> None:
+        self._route_registered = True
+        self.router.add(method, path, wrap_handler(handler, self.container, self.request_timeout))
+
+    def get(self, path: str, handler: Callable) -> None:
+        self._add("GET", path, handler)
+
+    def post(self, path: str, handler: Callable) -> None:
+        self._add("POST", path, handler)
+
+    def put(self, path: str, handler: Callable) -> None:
+        self._add("PUT", path, handler)
+
+    def patch(self, path: str, handler: Callable) -> None:
+        self._add("PATCH", path, handler)
+
+    def delete(self, path: str, handler: Callable) -> None:
+        self._add("DELETE", path, handler)
+
+    def use_middleware(self, *mws) -> None:
+        for mw in mws:
+            self.router.use(mw)
+
+    # ---- auth (gofr.go:348-382) ----
+    def enable_basic_auth(self, *user_pass: str) -> None:
+        if len(user_pass) % 2 != 0:
+            self.logger.warn("enable_basic_auth: odd argument count; ignoring trailing username")
+        users = dict(zip(user_pass[::2], user_pass[1::2]))
+        self.router.use(basic_auth_middleware(users=users))
+
+    def enable_basic_auth_with_func(self, validate_func) -> None:
+        self.router.use(basic_auth_middleware(validate_func=validate_func))
+
+    def enable_api_key_auth(self, *keys: str) -> None:
+        self.router.use(apikey_auth_middleware(keys=list(keys)))
+
+    def enable_api_key_auth_with_func(self, validate_func) -> None:
+        self.router.use(apikey_auth_middleware(validate_func=validate_func))
+
+    def enable_oauth(self, jwks_url: str, refresh_interval_s: float = 300.0) -> None:
+        from .http.middleware.auth import JWKSProvider
+
+        self.router.use(oauth_middleware(JWKSProvider(jwks_url, refresh_interval_s)))
+
+    # ---- outbound services (gofr.go:221) ----
+    def add_http_service(self, name: str, address: str, *options) -> None:
+        from .service import new_http_service
+
+        if name in self.container.services:
+            self.logger.warn(f"service {name} already registered, overwriting")
+        self.container.services[name] = new_http_service(
+            address, self.logger, self.container.metrics, *options
+        )
+
+    # ---- TPU models (the build's ctx.TPU() registry) ----
+    def register_model(self, name: str, *args, **kwargs):
+        return self.container.tpu().register_model(name, *args, **kwargs)
+
+    # ---- pub/sub (gofr.go:384-392) ----
+    def subscribe(self, topic: str, handler: Callable) -> None:
+        if self.container.pubsub is None:
+            self.logger.error("subscriber not initialized in the container (set PUBSUB_BACKEND)")
+            return
+        self._subscriptions[topic] = handler
+
+    # ---- cron (gofr.go:414) ----
+    def add_cron_job(self, schedule: str, job_name: str, job: Callable) -> None:
+        from .cron import Cron
+
+        if self._cron is None:
+            self._cron = Cron(self.container)
+        self._cron.add_job(schedule, job_name, job)
+
+    # ---- migrations (gofr.go:281) ----
+    def migrate(self, migrations: dict[int, Any]) -> None:
+        from .migration import run as run_migrations
+
+        try:
+            run_migrations(migrations, self.container)
+        except Exception as e:  # noqa: BLE001 - parity: panic-recovery wrap (gofr.go:283)
+            self.logger.error(f"migration failed: {e!r}")
+            raise
+
+    # ---- CRUD (gofr.go:394) ----
+    def add_rest_handlers(self, entity_cls) -> None:
+        from .crud import register_crud_handlers
+
+        register_crud_handlers(self, entity_cls)
+
+    # ---- gRPC (gofr.go:57-61) ----
+    def register_service(self, add_servicer_fn, servicer) -> None:
+        """add_servicer_fn: generated add_XServicer_to_server(servicer, server)."""
+        from .grpcx import GRPCServer
+
+        if self.grpc_server is None:
+            self.grpc_server = GRPCServer(self.container, self.grpc_port, self.tracer)
+        self.grpc_server.register(add_servicer_fn, servicer)
+        self._grpc_registered = True
+
+    # ---- static files + swagger ----
+    def add_static_files(self, route: str, directory: str) -> None:
+        self._static_dirs.append((route, directory))
+
+    # ---- run / shutdown (gofr.go:116-202) ----
+    def _register_well_known(self) -> None:
+        self.get("/.well-known/health", health_handler)
+        self.get("/.well-known/alive", live_handler)
+        self.router.add("GET", "/favicon.ico", favicon_wire_handler)
+        from .swagger import register_swagger_routes
+
+        register_swagger_routes(self)
+        for route, directory in self._static_dirs:
+            from .staticfiles import register_static_route
+
+            register_static_route(self, route, directory)
+
+    async def serve(self) -> None:
+        """Start all servers and block until shutdown() (gofr.go:116-178)."""
+        self._loop = asyncio.get_running_loop()
+        self._shutdown_event = asyncio.Event()
+        self._register_well_known()
+        self.router.build()
+
+        self.metrics_server.start()
+        self.logger.info(f"Starting metrics server on :{self.metrics_server.port}")
+        await self.http_server.start()
+
+        if self._grpc_registered and self.grpc_server is not None:
+            self.grpc_server.start()
+            self.logger.info(f"gRPC server listening on :{self.grpc_server.port}")
+
+        for topic, handler in self._subscriptions.items():
+            self._bg_tasks.append(asyncio.ensure_future(self._run_subscriber(topic, handler)))
+
+        if self._cron is not None:
+            self._bg_tasks.append(asyncio.ensure_future(self._cron.run()))
+
+        tpu = self.container.tpu_runtime
+        if tpu is not None:
+            await tpu.start_batchers()
+
+        await self._shutdown_event.wait()
+        await self._stop_servers()
+
+    async def _run_subscriber(self, topic: str, handler: Callable) -> None:
+        """Per-topic subscription loop (subscriber.go:27-57): receive ->
+        Context -> handler -> commit on success, with panic recovery."""
+        from .datasource.pubsub import SubscribeContextRequest
+
+        pubsub = self.container.pubsub
+        assert pubsub is not None
+        while self._shutdown_event is not None and not self._shutdown_event.is_set():
+            try:
+                msg = await pubsub.subscribe(topic)
+            except asyncio.CancelledError:
+                return
+            except Exception as e:  # noqa: BLE001
+                self.logger.error(f"error while reading from topic {topic}: {e!r}")
+                await asyncio.sleep(1.0)
+                continue
+            if msg is None:
+                continue
+            self.container.metrics.increment_counter("app_pubsub_subscribe_total_count", topic=topic)
+            ctx = Context(SubscribeContextRequest(msg), self.container)
+            try:
+                if asyncio.iscoroutinefunction(handler):
+                    err = await handler(ctx)
+                else:
+                    err = await asyncio.get_running_loop().run_in_executor(None, handler, ctx)
+            except Exception as e:  # noqa: BLE001 - panic recovery (subscriber.go:46)
+                self.logger.error(f"error in subscriber handler for {topic}: {e!r}")
+                continue
+            if err is not None:
+                # Handler signaled failure by returning an error: do NOT
+                # commit, so the message is redelivered (subscriber.go:50-55).
+                self.logger.error(f"subscriber handler for {topic} returned error: {err!r}")
+                continue
+            msg.commit()
+            self.container.metrics.increment_counter("app_pubsub_subscribe_success_count", topic=topic)
+
+    async def _stop_servers(self) -> None:
+        for t in self._bg_tasks:
+            t.cancel()
+        await self.http_server.shutdown()
+        if self.grpc_server is not None:
+            self.grpc_server.shutdown()
+        self.metrics_server.shutdown()
+        tpu = self.container.tpu_runtime
+        if tpu is not None:
+            await tpu.stop_batchers()
+        self.tracer.shutdown()
+        self.container.close()
+        self.logger.info("Server shutdown complete")
+
+    def shutdown(self) -> None:
+        if self._loop is not None and self._shutdown_event is not None:
+            self._loop.call_soon_threadsafe(self._shutdown_event.set)
+
+    def run(self) -> None:
+        """Blocking entrypoint with signal-driven graceful shutdown."""
+
+        async def main():
+            loop = asyncio.get_running_loop()
+            for sig in (signal.SIGINT, signal.SIGTERM):
+                try:
+                    loop.add_signal_handler(sig, self.shutdown)
+                except (NotImplementedError, RuntimeError):
+                    pass
+            await self.serve()
+
+        asyncio.run(main())
+
+    # -- test helper: run the app in a daemon thread, return when ready --
+    def run_in_background(self) -> threading.Thread:
+        started = threading.Event()
+
+        async def main():
+            task = asyncio.ensure_future(self.serve())
+            while self.http_server._server is None and not task.done():
+                await asyncio.sleep(0.005)
+            started.set()
+            await task
+
+        t = threading.Thread(target=lambda: asyncio.run(main()), daemon=True)
+        t.start()
+        if not started.wait(timeout=30):
+            raise RuntimeError("app failed to start")
+        return t
+
+
+def new(config: Config | None = None, configs_dir: str = "./configs") -> App:
+    """gofr.New() analogue (gofr.go:64)."""
+    return App(config=config, configs_dir=configs_dir)

@@ -1,0 +1,123 @@
+"""CLI app mode: subcommand dispatch with the same Context as HTTP handlers.
+
+Parity: reference pkg/gofr/cmd.go:27-65 (NewCMD builds an app without
+servers; Run joins non-flag args into a command string and regex-matches
+registered subcommand patterns) and pkg/gofr/cmd/ (request.go:25-117 flag
+parsing ``-a=b``/``--flag`` into params, reflection Bind; Responder prints
+results to stdout, errors to stderr).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+import sys
+from typing import Any, Callable, get_type_hints
+
+from .config import Config, EnvConfig
+from .container import Container
+from .context import Context
+
+
+class CMDRequest:
+    """Parses argv: non-flag words form the command; -k=v / --k=v / -flag
+    become params (cmd/request.go:25-117)."""
+
+    def __init__(self, argv: list[str]):
+        self.params: dict[str, str] = {}
+        words: list[str] = []
+        for arg in argv:
+            if arg.startswith("-"):
+                key = arg.lstrip("-")
+                if "=" in key:
+                    k, _, v = key.partition("=")
+                    self.params[k] = v
+                elif key:
+                    self.params[key] = "true"
+            else:
+                words.append(arg)
+        self.command = " ".join(words)
+        self.context: dict[str, Any] = {}
+
+    def param(self, key: str) -> str:
+        return self.params.get(key, "")
+
+    def params_list(self, key: str) -> list[str]:
+        v = self.params.get(key)
+        return [v] if v is not None else []
+
+    # Context delegation surface
+    def path_param(self, key: str) -> str:
+        return self.params.get(key, "")
+
+    def header(self, _key: str) -> str:
+        return ""
+
+    def host_name(self) -> str:
+        return ""
+
+    def bind(self, target: Any = None) -> Any:
+        """Bind flags onto a dataclass by field name (cmd Bind analogue)."""
+        if target is None:
+            return dict(self.params)
+        if dataclasses.is_dataclass(target):
+            hints = get_type_hints(target)
+            kwargs = {}
+            for f in dataclasses.fields(target):
+                if f.name in self.params:
+                    v: Any = self.params[f.name]
+                    t = hints.get(f.name, str)
+                    if t is int:
+                        v = int(v)
+                    elif t is float:
+                        v = float(v)
+                    elif t is bool:
+                        v = str(v).lower() in ("1", "true", "yes", "on")
+                    kwargs[f.name] = v
+            return target(**kwargs)
+        raise TypeError("bind target must be a dataclass or None")
+
+
+class CMDApp:
+    """App without servers; run() dispatches one subcommand (cmd.go:27-52)."""
+
+    def __init__(self, config: Config | None = None, configs_dir: str = "./configs"):
+        self.config = config if config is not None else EnvConfig(configs_dir)
+        self.container = Container.create(self.config)
+        self.logger = self.container.logger
+        self._routes: list[tuple[re.Pattern, Callable, str]] = []
+
+    def sub_command(self, pattern: str, handler: Callable, description: str = "") -> None:
+        """Register a subcommand; pattern is a regex matched against the
+        joined non-flag args (gofr.go:277, cmd.go:56-65)."""
+        self._routes.append((re.compile(pattern), handler, description))
+
+    # alias matching the reference's SubCommand naming
+    add_sub_command = sub_command
+
+    def _help_text(self) -> str:
+        lines = ["Available commands:"]
+        for pat, _, desc in self._routes:
+            lines.append(f"  {pat.pattern}  {('- ' + desc) if desc else ''}")
+        return "\n".join(lines)
+
+    def run(self, argv: list[str] | None = None) -> int:
+        argv = argv if argv is not None else sys.argv[1:]
+        req = CMDRequest(argv)
+        if not req.command or req.command in ("help", "--help"):
+            print(self._help_text())
+            return 0
+        for pattern, handler, _desc in self._routes:
+            if pattern.fullmatch(req.command) or pattern.match(req.command):
+                ctx = Context(req, self.container)
+                try:
+                    result = handler(ctx)
+                except Exception as e:  # noqa: BLE001 - CLI error boundary
+                    print(str(e) or e.__class__.__name__, file=sys.stderr)
+                    return 1
+                if result is not None:
+                    print(result)
+                return 0
+        print(f"No Command Found! {req.command!r}", file=sys.stderr)
+        print(self._help_text(), file=sys.stderr)
+        return 1

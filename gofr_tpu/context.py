@@ -1,0 +1,112 @@
+"""Context: the per-request facade handed to every handler.
+
+Parity: reference pkg/gofr/context.go:12-71 — embeds the request, the
+container, and a trace hook; the same Context shape serves HTTP, gRPC, CLI
+and pub/sub handlers (context.go:23-26 states this design goal; we extend it
+to gRPC, fixing the reference's asymmetry noted in SURVEY.md §3.6).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from .container import Container
+
+
+class Context:
+    __slots__ = ("request", "container", "_responder", "_span", "deadline")
+
+    def __init__(self, request: Any, container: Container, responder: Any = None):
+        self.request = request
+        self.container = container
+        self._responder = responder
+        # request span (set by tracer middleware) used as trace parent when
+        # the contextvar didn't propagate (e.g. executor threads)
+        self._span = getattr(request, "context", {}).get("span") if request is not None else None
+        self.deadline: float | None = None
+
+    # -- request surface (delegation, context.go:53) --
+    def param(self, key: str) -> str:
+        return self.request.param(key)
+
+    def params(self, key: str) -> list[str]:
+        return self.request.params(key)
+
+    def path_param(self, key: str) -> str:
+        return self.request.path_param(key)
+
+    def bind(self, target: Any = None) -> Any:
+        return self.request.bind(target)
+
+    def header(self, key: str) -> str:
+        return self.request.header(key)
+
+    def host_name(self) -> str:
+        return self.request.host_name()
+
+    # -- container surface --
+    @property
+    def logger(self):
+        return self.container.logger
+
+    @property
+    def redis(self):
+        return self.container.redis
+
+    @property
+    def sql(self):
+        return self.container.sql
+
+    @property
+    def metrics(self):
+        return self.container.metrics
+
+    def tpu(self):
+        """The TPU datasource: model registry + batched inference.
+        The build's ctx.TPU() requirement (BASELINE.json north_star)."""
+        return self.container.tpu()
+
+    def get_http_service(self, name: str):
+        return self.container.get_http_service(name)
+
+    def get_publisher(self):
+        return self.container.get_publisher()
+
+    # -- tracing (context.go:45-51) --
+    def trace(self, name: str):
+        from .tracing import current_span
+
+        parent = current_span()
+        tracer = getattr(self.container, "tracer", None)
+        if tracer is None:
+            from .tracing import Tracer
+
+            tracer = Tracer(self.container.app_name)
+            self.container.tracer = tracer  # type: ignore[attr-defined]
+        span = tracer.start_span(name)
+        if parent is None and self._span is not None:
+            span.trace_id = self._span.trace_id
+            span.parent_id = self._span.span_id
+        return span
+
+    @property
+    def trace_id(self) -> str:
+        span = self.request.context.get("span") if hasattr(self.request, "context") else None
+        return span.trace_id if span else ""
+
+    # auth context populated by middleware
+    @property
+    def jwt_claims(self) -> dict | None:
+        if hasattr(self.request, "context"):
+            return self.request.context.get("JWTClaims")
+        return None
+
+    @property
+    def authenticated_user(self) -> str | None:
+        if hasattr(self.request, "context"):
+            return self.request.context.get("user")
+        return None
+
+
+def new_context(request: Any, container: Container, responder: Any = None) -> Context:
+    return Context(request, container, responder)

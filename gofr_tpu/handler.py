@@ -1,0 +1,87 @@
+"""Handler adaptation: user ``handler(ctx) -> result`` to wire handler.
+
+Parity: reference pkg/gofr/handler.go — Handler signature (handler.go:20),
+REQUEST_TIMEOUT enforcement (handler.go:41-76; default 5s, handler.go:18),
+built-in health/liveness/favicon/catch-all handlers (handler.go:78-113).
+
+Re-design note: the reference enforces timeout by abandoning the handler
+goroutine; here the handler is an asyncio task that gets cancelled, which
+also detaches any pending batch-future cleanly (the batch itself proceeds,
+SURVEY.md §7 hard-part 2).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextvars
+import inspect
+from typing import Any, Callable
+
+from .container import Container
+from .context import Context
+from .http.request import Request
+from .http.responder import Response, respond
+from .http.router import WireHandler
+
+FAVICON = (
+    # 1x1 transparent PNG; the reference embeds a real favicon (static/),
+    # behavioral parity (200 image response) is what its tests assert.
+    b"\x89PNG\r\n\x1a\n\x00\x00\x00\rIHDR\x00\x00\x00\x01\x00\x00\x00\x01\x08\x06"
+    b"\x00\x00\x00\x1f\x15\xc4\x89\x00\x00\x00\nIDATx\x9cc\x00\x01\x00\x00\x05\x00"
+    b"\x01\r\n-\xb4\x00\x00\x00\x00IEND\xaeB`\x82"
+)
+
+
+async def _call_handler(fn: Callable, ctx: Context) -> Any:
+    if inspect.iscoroutinefunction(fn):
+        return await fn(ctx)
+    loop = asyncio.get_running_loop()
+    # copy_context: propagate the active span contextvar into the executor
+    # thread so ctx.trace() parents correctly from sync handlers.
+    cvars = contextvars.copy_context()
+    return await loop.run_in_executor(None, lambda: cvars.run(fn, ctx))
+
+
+def wrap_handler(fn: Callable, container: Container, timeout_s: float | None) -> WireHandler:
+    """Build the wire handler for one user handler."""
+
+    async def h(req: Request) -> Response:
+        ctx = Context(req, container)
+        try:
+            if timeout_s and timeout_s > 0:
+                result = await asyncio.wait_for(_call_handler(fn, ctx), timeout=timeout_s)
+            else:
+                result = await _call_handler(fn, ctx)
+        except asyncio.TimeoutError:
+            from .http.errors import ErrorRequestTimeout
+
+            return respond(None, ErrorRequestTimeout(), req.method)
+        except Exception as e:  # noqa: BLE001 - error envelope boundary
+            if getattr(e, "status_code", None) is None:
+                # Unexpected exception: mask the message (parity with the
+                # reference's panic recovery, middleware/logger.go:127-152) —
+                # raw str(e) must not leak internals to clients.
+                import traceback
+
+                container.logger.error(f"panic recovered: {traceback.format_exc()}")
+                from .http.errors import ErrorPanicRecovery
+
+                return respond(None, ErrorPanicRecovery(), req.method)
+            return respond(None, e, req.method)
+        return respond(result, None, req.method)
+
+    return h
+
+
+# -- built-in handlers (handler.go:78-113) --
+
+def health_handler(ctx: Context) -> Any:
+    return ctx.container.health()
+
+
+def live_handler(_ctx: Context) -> Any:
+    return {"status": "UP"}
+
+
+async def favicon_wire_handler(_req: Request) -> Response:
+    return Response(200, [("Content-Type", "image/png")], FAVICON)

@@ -1,0 +1,38 @@
+"""HTTP plumbing: server, router, request/responder, middleware, errors.
+
+Parity: reference pkg/gofr/http/ (router.go, request.go, responder.go,
+errors.go, middleware/*). TPU-first difference: the server is a single
+asyncio event loop rather than a thread-per-connection model, because the
+dynamic batcher (gofr_tpu/batching) coalesces concurrent in-flight requests
+into one device execution — requests must be cheap cooperative tasks, not
+threads.
+"""
+
+from .errors import (
+    ErrorEntityNotFound,
+    ErrorInvalidParam,
+    ErrorInvalidRoute,
+    ErrorMissingParam,
+    ErrorPanicRecovery,
+    ErrorRequestTimeout,
+    ErrorServiceUnavailable,
+    HTTPError,
+)
+from .request import Request
+from .responder import FileResponse, Raw, Redirect, Response
+
+__all__ = [
+    "ErrorEntityNotFound",
+    "ErrorInvalidParam",
+    "ErrorInvalidRoute",
+    "ErrorMissingParam",
+    "ErrorPanicRecovery",
+    "ErrorRequestTimeout",
+    "ErrorServiceUnavailable",
+    "FileResponse",
+    "HTTPError",
+    "Raw",
+    "Redirect",
+    "Request",
+    "Response",
+]

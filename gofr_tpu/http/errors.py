@@ -1,0 +1,92 @@
+"""HTTP error taxonomy with status codes.
+
+Parity: reference pkg/gofr/http/errors.go:11-60 — error types implementing
+StatusCode(); the responder maps them to HTTP statuses. Any exception with a
+``status_code`` attribute participates (the statusCodeResponder seam,
+responder.go:52-74).
+"""
+
+from __future__ import annotations
+
+
+class HTTPError(Exception):
+    status_code = 500
+
+    def __init__(self, message: str = ""):
+        super().__init__(message)
+        self.message = message or self.__class__.__name__
+
+
+class ErrorEntityNotFound(HTTPError):
+    """404. Parity: errors.go ErrorEntityNotFound."""
+
+    status_code = 404
+
+    def __init__(self, name: str = "", value: str = ""):
+        self.name, self.value = name, value
+        msg = f"No entity found with {name}: {value}" if name else "entity not found"
+        super().__init__(msg)
+
+
+class ErrorInvalidParam(HTTPError):
+    """400. Parity: errors.go ErrorInvalidParam."""
+
+    status_code = 400
+
+    def __init__(self, *params: str):
+        self.params = list(params)
+        super().__init__(f"'{len(self.params)}' invalid parameter(s): {', '.join(self.params)}")
+
+
+class ErrorMissingParam(HTTPError):
+    """400. Parity: errors.go ErrorMissingParam."""
+
+    status_code = 400
+
+    def __init__(self, *params: str):
+        self.params = list(params)
+        super().__init__(f"'{len(self.params)}' missing parameter(s): {', '.join(self.params)}")
+
+
+class ErrorInvalidRoute(HTTPError):
+    """404. Parity: errors.go ErrorInvalidRoute."""
+
+    status_code = 404
+
+    def __init__(self):
+        super().__init__("route not registered")
+
+
+class ErrorRequestTimeout(HTTPError):
+    """408 — request exceeded REQUEST_TIMEOUT (reference handler.go:65-71)."""
+
+    status_code = 408
+
+    def __init__(self):
+        super().__init__("request timed out")
+
+
+class ErrorPanicRecovery(HTTPError):
+    """500 — unhandled exception in user handler (middleware/logger.go:127-152)."""
+
+    status_code = 500
+
+    def __init__(self):
+        super().__init__("some unexpected error has occurred")
+
+
+class ErrorServiceUnavailable(HTTPError):
+    """503 — dependency down / circuit open / batch queue full."""
+
+    status_code = 503
+
+    def __init__(self, message: str = "service unavailable"):
+        super().__init__(message)
+
+
+def status_from_error(err: BaseException) -> int:
+    """The statusCodeResponder seam: any error carrying status_code."""
+    code = getattr(err, "status_code", None)
+    if isinstance(code, int) and 100 <= code <= 599:
+        return code
+    return 500

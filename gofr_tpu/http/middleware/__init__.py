@@ -1,0 +1,20 @@
+"""Default + auth middleware for the HTTP server.
+
+Parity: reference pkg/gofr/http/middleware/ — chain order
+Tracer -> Logging -> CORS -> Metrics (router.go:23-28), panic recovery and
+request logging (logger.go), metrics by route template (metrics.go),
+basic/api-key/oauth auth (basic_auth.go, apikey_auth.go, oauth.go).
+"""
+
+from .core import cors_middleware, logging_middleware, metrics_middleware, tracer_middleware
+from .auth import apikey_auth_middleware, basic_auth_middleware, oauth_middleware
+
+__all__ = [
+    "apikey_auth_middleware",
+    "basic_auth_middleware",
+    "cors_middleware",
+    "logging_middleware",
+    "metrics_middleware",
+    "oauth_middleware",
+    "tracer_middleware",
+]

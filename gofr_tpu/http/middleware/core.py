@@ -1,0 +1,163 @@
+"""Tracer, logging (with recovery), CORS, metrics middleware.
+
+Parity: reference middleware/tracer.go:15-32, logger.go:69-152, cors.go:6-23,
+metrics.go:21-41.
+"""
+
+from __future__ import annotations
+
+import io
+import time
+import traceback
+
+from ...logging import Logger
+from ...metrics import Manager
+from ...tracing import Tracer
+from ..request import Request
+from ..responder import Response, to_json_bytes
+from ..router import WireHandler
+
+
+class RequestLog:
+    """Structured request log (middleware/logger.go:27-60)."""
+
+    __slots__ = ("trace_id", "span_id", "start_time", "response_time_us", "method", "uri", "response_code", "remote_addr")
+
+    def __init__(self, trace_id, span_id, start_time, response_time_us, method, uri, response_code, remote_addr):
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.start_time = start_time
+        self.response_time_us = response_time_us
+        self.method = method
+        self.uri = uri
+        self.response_code = response_code
+        self.remote_addr = remote_addr
+
+    def to_log_dict(self):
+        return {
+            "trace_id": self.trace_id,
+            "span_id": self.span_id,
+            "start_time": self.start_time,
+            "response_time": self.response_time_us,
+            "method": self.method,
+            "uri": self.uri,
+            "response_code": self.response_code,
+            "remote_addr": self.remote_addr,
+        }
+
+    def pretty_print(self, writer: io.TextIOBase) -> None:
+        color = 32 if self.response_code < 400 else (33 if self.response_code < 500 else 31)
+        writer.write(
+            f"\x1b[38;5;8m{self.trace_id}\x1b[0m "
+            f"\x1b[{color}m{self.response_code}\x1b[0m "
+            f"{self.response_time_us:>10}µs {self.method} {self.uri}"
+        )
+
+
+def tracer_middleware(tracer: Tracer):
+    """Extract W3C traceparent, open a span named 'METHOD /path' (the
+    template isn't known yet — tracing runs outermost, before route match)."""
+
+    def mw(next_handler: WireHandler) -> WireHandler:
+        async def h(req: Request) -> Response:
+            span = tracer.start_span(
+                f"{req.method} {req.path}",
+                traceparent=req.headers.get("traceparent"),
+            )
+            req.context["span"] = span
+            try:
+                resp = await next_handler(req)
+                span.set_attribute("http.status_code", resp.status)
+                if resp.status >= 500:
+                    span.set_status("ERROR")
+                return resp
+            finally:
+                span.end()
+
+        return h
+
+    return mw
+
+
+def logging_middleware(logger: Logger):
+    """Request log + panic recovery -> 500 envelope (logger.go:69-152).
+    Surfaces the trace id to clients as X-Correlation-ID (logger.go:77-79)."""
+
+    def mw(next_handler: WireHandler) -> WireHandler:
+        async def h(req: Request) -> Response:
+            start = time.perf_counter()
+            span = req.context.get("span")
+            trace_id = span.trace_id if span else ""
+            span_id = span.span_id if span else ""
+            try:
+                resp = await next_handler(req)
+            except Exception:  # noqa: BLE001 - recovery boundary
+                logger.error(f"panic recovered: {traceback.format_exc()}")
+                resp = Response(
+                    500,
+                    [("Content-Type", "application/json")],
+                    to_json_bytes({"error": {"message": "some unexpected error has occurred"}}),
+                )
+            elapsed_us = int((time.perf_counter() - start) * 1e6)
+            if trace_id:
+                resp.headers.append(("X-Correlation-ID", trace_id))
+            log = RequestLog(
+                trace_id, span_id,
+                time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+                elapsed_us, req.method, req.target, resp.status, req.remote_addr,
+            )
+            if resp.status >= 500:
+                logger.error(log)
+            else:
+                logger.info(log)
+            return resp
+
+        return h
+
+    return mw
+
+
+def cors_middleware(overrides: dict[str, str] | None = None):
+    """Wildcard CORS + preflight short-circuit (cors.go:6-23). Headers
+    overridable via config (ACCESS_CONTROL_ALLOW_* env, as the reference's
+    docs describe)."""
+    headers = {
+        "Access-Control-Allow-Origin": "*",
+        "Access-Control-Allow-Headers": "Authorization, Content-Type, x-requested-with, origin, true-client-ip, X-Correlation-ID",
+    }
+    if overrides:
+        headers.update(overrides)
+
+    def mw(next_handler: WireHandler) -> WireHandler:
+        async def h(req: Request) -> Response:
+            if req.method == "OPTIONS":
+                hs = [*headers.items(), ("Access-Control-Allow-Methods", "GET, POST, PUT, PATCH, DELETE, OPTIONS")]
+                return Response(200, hs, b"")
+            resp = await next_handler(req)
+            resp.headers.extend(headers.items())
+            return resp
+
+        return h
+
+    return mw
+
+
+def metrics_middleware(manager: Manager):
+    """app_http_response histogram labeled by route template (metrics.go:21-41)."""
+
+    def mw(next_handler: WireHandler) -> WireHandler:
+        async def h(req: Request) -> Response:
+            start = time.perf_counter()
+            resp = await next_handler(req)
+            manager.record_histogram(
+                "app_http_response",
+                time.perf_counter() - start,
+                path=req.route_template,
+                method=req.method,
+                status=str(resp.status),
+            )
+            return resp
+
+        return h
+
+    return mw

@@ -1,0 +1,117 @@
+"""Response serialization: envelope, status mapping, raw/file/stream types.
+
+Parity: reference pkg/gofr/http/responder.go:23-84 — success envelope
+{"data": ...}, error envelope {"error": {"message": ...}}, Raw/File
+passthrough types, method-based success codes (POST->201, DELETE->204),
+status from error via the status_code seam.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any, AsyncIterator
+
+from .errors import status_from_error
+
+
+class Response:
+    """Wire-level response: status, headers, body bytes or async chunk iterator."""
+
+    __slots__ = ("status", "headers", "body", "stream")
+
+    def __init__(
+        self,
+        status: int = 200,
+        headers: list[tuple[str, str]] | None = None,
+        body: bytes = b"",
+        stream: AsyncIterator[bytes] | None = None,
+    ):
+        self.status = status
+        self.headers = headers or []
+        self.body = body
+        self.stream = stream
+
+
+class Raw:
+    """Bare JSON payload without the {"data": ...} envelope (response.Raw)."""
+
+    __slots__ = ("data",)
+
+    def __init__(self, data: Any):
+        self.data = data
+
+
+class FileResponse:
+    """Bytes with a content type (response.File)."""
+
+    __slots__ = ("content", "content_type")
+
+    def __init__(self, content: bytes, content_type: str = "application/octet-stream"):
+        self.content = content
+        self.content_type = content_type
+
+
+class Redirect:
+    __slots__ = ("url", "status")
+
+    def __init__(self, url: str, status: int = 302):
+        self.url = url
+        self.status = status
+
+
+class StreamingResponse:
+    """Server-sent chunked body: async iterator of byte chunks. Used for
+    token-streaming LLM endpoints (no reference analogue; the TPU build's
+    server-streaming requirement, BASELINE.json config 3)."""
+
+    __slots__ = ("chunks", "content_type")
+
+    def __init__(self, chunks: AsyncIterator[bytes], content_type: str = "text/event-stream"):
+        self.chunks = chunks
+        self.content_type = content_type
+
+
+def _default_json(o: Any) -> Any:
+    if dataclasses.is_dataclass(o) and not isinstance(o, type):
+        return dataclasses.asdict(o)
+    if hasattr(o, "tolist"):  # numpy / jax arrays returned straight from models
+        return o.tolist()
+    if hasattr(o, "item") and getattr(o, "ndim", None) == 0:
+        return o.item()
+    if isinstance(o, bytes):
+        return o.decode("utf-8", "replace")
+    return str(o)
+
+
+def to_json_bytes(payload: Any) -> bytes:
+    return json.dumps(payload, default=_default_json, separators=(",", ":")).encode("utf-8")
+
+
+_METHOD_SUCCESS = {"POST": 201, "DELETE": 204}
+
+
+def respond(result: Any, err: BaseException | None, method: str = "GET") -> Response:
+    """Map a handler's (result, error) to a wire Response (responder.go:23-84)."""
+    if err is not None:
+        status = status_from_error(err)
+        msg = getattr(err, "message", None) or str(err) or err.__class__.__name__
+        body = to_json_bytes({"error": {"message": msg}})
+        return Response(status, [("Content-Type", "application/json")], body)
+
+    if isinstance(result, Response):
+        return result
+    if isinstance(result, Redirect):
+        return Response(result.status, [("Location", result.url)], b"")
+    if isinstance(result, FileResponse):
+        return Response(200, [("Content-Type", result.content_type)], result.content)
+    if isinstance(result, StreamingResponse):
+        return Response(200, [("Content-Type", result.content_type)], b"", stream=result.chunks)
+    if isinstance(result, Raw):
+        return Response(200, [("Content-Type", "application/json")], to_json_bytes(result.data))
+
+    status = _METHOD_SUCCESS.get(method, 200)
+    if status == 204 and result is None:
+        return Response(204, [], b"")
+    body = to_json_bytes({"data": result})
+    return Response(status, [("Content-Type", "application/json")], body)

@@ -1,0 +1,209 @@
+"""Router: method+path dispatch with {param} segments and middleware chain.
+
+Parity: reference pkg/gofr/http/router.go:14-49 (gorilla/mux wrapper with
+default middleware chain Tracer->Logging->CORS->Metrics, user middleware via
+UseMiddleware). Re-designed: a static-route hash fast path plus a segment
+trie, because route match is on the serving hot path in front of the batcher.
+
+Route templates use ``{name}`` segments (e.g. ``/users/{id}``) and a trailing
+``{rest...}`` catch-all. The matched template (not the URL) is used as the
+metrics label to avoid cardinality bombs (middleware/metrics.go:21-41);
+unmatched requests are labeled with the UNMATCHED constant for the same
+reason.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any, Awaitable, Callable
+
+from .request import Request
+from .responder import Response
+
+# A wire handler: async (Request) -> Response
+WireHandler = Callable[[Request], Awaitable[Response]]
+# Middleware: (WireHandler) -> WireHandler
+Middleware = Callable[[WireHandler], WireHandler]
+
+# route_template label for requests that matched no route (cardinality guard)
+UNMATCHED = "/__unmatched__"
+
+
+class _Route:
+    """One registered (method, template) endpoint at a trie leaf."""
+
+    __slots__ = ("handler", "template", "param_names")
+
+    def __init__(self, handler: WireHandler, template: str, param_names: list[str]):
+        self.handler = handler
+        self.template = template
+        self.param_names = param_names
+
+
+class _Node:
+    __slots__ = ("children", "param_child", "wild_routes", "routes")
+
+    def __init__(self):
+        self.children: dict[str, _Node] = {}
+        self.param_child: _Node | None = None
+        self.wild_routes: dict[str, _Route] = {}  # method -> catch-all route
+        self.routes: dict[str, _Route] = {}  # method -> route
+
+
+async def _default_404(_req: Request) -> Response:
+    from .responder import to_json_bytes
+
+    return Response(404, [("Content-Type", "application/json")], to_json_bytes({"error": {"message": "route not registered"}}))
+
+
+async def _default_405(_req: Request) -> Response:
+    from .responder import to_json_bytes
+
+    return Response(405, [("Content-Type", "application/json")], to_json_bytes({"error": {"message": "method not allowed"}}))
+
+
+class Router:
+    def __init__(self):
+        self._static: dict[tuple[str, str], _Route] = {}
+        self._static_paths: set[str] = set()
+        self._root = _Node()
+        self._middleware: list[Middleware] = []
+        self._built = False
+        self.not_found: WireHandler = _default_404
+        self.method_not_allowed: WireHandler = _default_405
+
+    def use(self, mw: Middleware) -> None:
+        """Append middleware. Applied outermost-first in registration order."""
+        if self._built:
+            raise RuntimeError("cannot add middleware after server start")
+        self._middleware.append(mw)
+
+    def add(self, method: str, template: str, handler: WireHandler) -> None:
+        if self._built:
+            raise RuntimeError("cannot add routes after server start")
+        method = method.upper()
+        template = "/" + template.strip("/") if template.strip("/") else "/"
+        if "{" not in template:
+            self._static[(method, template)] = _Route(handler, template, [])
+            self._static_paths.add(template)
+            return
+        param_names: list[str] = []
+        node = self._root
+        segs = template.strip("/").split("/")
+        for i, seg in enumerate(segs):
+            if seg.startswith("{") and seg.endswith("...}"):
+                if i != len(segs) - 1:
+                    raise ValueError(f"catch-all segment must be last: {template}")
+                param_names.append(seg[1:-4])
+                node.wild_routes[method] = _Route(handler, template, param_names)
+                return
+            if seg.startswith("{") and seg.endswith("}"):
+                param_names.append(seg[1:-1])
+                if node.param_child is None:
+                    node.param_child = _Node()
+                node = node.param_child
+            else:
+                node = node.children.setdefault(seg, _Node())
+        node.routes[method] = _Route(handler, template, param_names)
+
+    def routes(self) -> list[tuple[str, str]]:
+        out = [(m, p) for (m, p) in self._static]
+        stack = [self._root]
+        while stack:
+            n = stack.pop()
+            for m, r in n.routes.items():
+                out.append((m, r.template))
+            for m, r in n.wild_routes.items():
+                out.append((m, r.template))
+            stack.extend(n.children.values())
+            if n.param_child:
+                stack.append(n.param_child)
+        return sorted(out)
+
+    def _match(self, method: str, path: str) -> tuple[_Route | None, list[str], bool]:
+        """-> (route, param_values, path_exists_under_other_method)."""
+        r = self._static.get((method, path))
+        if r is not None:
+            return r, [], True
+        path_exists = path in self._static_paths
+
+        node = self._root
+        values: list[str] = []
+        segs = path.strip("/").split("/") if path != "/" else [""]
+        for i, seg in enumerate(segs):
+            if node.wild_routes:
+                rest = "/".join(segs[i:])
+                wr = node.wild_routes.get(method)
+                if wr is not None:
+                    return wr, [*values, rest], True
+                return None, [], True
+            nxt = node.children.get(seg)
+            if nxt is None and node.param_child is not None and seg != "":
+                values.append(seg)
+                nxt = node.param_child
+            if nxt is None:
+                return None, [], path_exists
+            node = nxt
+        if node.routes:
+            r = node.routes.get(method)
+            if r is None:
+                return None, [], True
+            return r, values, True
+        if node.wild_routes:
+            wr = node.wild_routes.get(method)
+            if wr is not None:
+                return wr, [*values, ""], True
+            return None, [], True
+        return None, [], path_exists
+
+    def build(self) -> None:
+        """Wrap every route handler in the middleware chain once, at startup."""
+        if self._built:
+            return
+        self._built = True
+
+        def wrap(h: WireHandler) -> WireHandler:
+            for mw in reversed(self._middleware):
+                h = mw(h)
+            return h
+
+        for r in self._static.values():
+            r.handler = wrap(r.handler)
+        stack = [self._root]
+        while stack:
+            n = stack.pop()
+            for r in n.routes.values():
+                r.handler = wrap(r.handler)
+            for r in n.wild_routes.values():
+                r.handler = wrap(r.handler)
+            stack.extend(n.children.values())
+            if n.param_child:
+                stack.append(n.param_child)
+        # 404/405 go through middleware too (logging + metrics see them)
+        self.not_found = wrap(self.not_found)
+        self.method_not_allowed = wrap(self.method_not_allowed)
+
+    async def dispatch(self, req: Request) -> Response:
+        if not self._built:
+            self.build()
+        route, values, path_exists = self._match(req.method, req.path)
+        if route is None:
+            req.route_template = UNMATCHED
+            if req.method == "OPTIONS" or not path_exists:
+                return await self.not_found(req)
+            return await self.method_not_allowed(req)
+        req.path_params = dict(zip(route.param_names, values))
+        req.route_template = route.template
+        return await route.handler(req)
+
+
+def ensure_async(fn: Callable[..., Any]) -> Callable[..., Awaitable[Any]]:
+    """Adapt a sync callable to async by running it in the default executor."""
+    if asyncio.iscoroutinefunction(fn):
+        return fn
+
+    async def runner(*args, **kwargs):
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(None, lambda: fn(*args, **kwargs))
+
+    return runner

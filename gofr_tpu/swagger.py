@@ -1,0 +1,59 @@
+"""OpenAPI serving.
+
+Parity: reference pkg/gofr/swagger.go:13-54 + gofr.go:141-145 — when
+./static/openapi.json exists, register /.well-known/openapi.json and a
+/.well-known/swagger UI. The reference embeds swagger-ui's JS bundle; we
+ship a dependency-free single-page renderer instead (no embedded third-party
+assets), which lists paths/operations and pretty-prints the spec.
+"""
+
+from __future__ import annotations
+
+import os
+
+from .http.request import Request
+from .http.responder import Response
+
+_UI_HTML = """<!DOCTYPE html>
+<html><head><title>API Docs</title><style>
+body{font-family:system-ui,sans-serif;margin:2rem;max-width:60rem}
+.op{border:1px solid #ddd;border-radius:6px;margin:.5rem 0;padding:.6rem 1rem}
+.m{display:inline-block;min-width:4.5rem;font-weight:700}
+.GET{color:#0b7285}.POST{color:#2b8a3e}.PUT{color:#e67700}.DELETE{color:#c92a2a}.PATCH{color:#862e9c}
+pre{background:#f8f9fa;padding:1rem;border-radius:6px;overflow:auto}
+summary{cursor:pointer}
+</style></head><body>
+<h1 id="title">API</h1><div id="ops"></div>
+<details><summary>Raw spec</summary><pre id="raw"></pre></details>
+<script>
+fetch('/.well-known/openapi.json').then(r=>r.json()).then(spec=>{
+  document.getElementById('title').textContent=(spec.info&&spec.info.title)||'API';
+  document.getElementById('raw').textContent=JSON.stringify(spec,null,2);
+  const ops=document.getElementById('ops');
+  for(const [path,item] of Object.entries(spec.paths||{})){
+    for(const [method,op] of Object.entries(item)){
+      const d=document.createElement('div');d.className='op';
+      const M=method.toUpperCase();
+      d.innerHTML=`<span class="m ${M}">${M}</span><code>${path}</code> — ${(op&&op.summary)||''}`;
+      ops.appendChild(d);
+    }
+  }
+});
+</script></body></html>""".encode("utf-8")
+
+
+def register_swagger_routes(app, static_dir: str = "./static") -> None:
+    spec_path = os.path.join(static_dir, "openapi.json")
+    if not os.path.isfile(spec_path):
+        return
+
+    async def openapi_handler(_req: Request) -> Response:
+        with open(spec_path, "rb") as f:
+            body = f.read()
+        return Response(200, [("Content-Type", "application/json")], body)
+
+    async def ui_handler(_req: Request) -> Response:
+        return Response(200, [("Content-Type", "text/html; charset=utf-8")], _UI_HTML)
+
+    app.router.add("GET", "/.well-known/openapi.json", openapi_handler)
+    app.router.add("GET", "/.well-known/swagger", ui_handler)

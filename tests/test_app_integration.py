@@ -1,0 +1,190 @@
+"""End-to-end app tests: boot the real server on an ephemeral port and make
+real HTTP calls — the reference's examples/*/main_test.go strategy
+(examples/http-server/main_test.go:21-53 asserts /greet, /.well-known/health,
+/favicon.ico)."""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+import gofr_tpu
+from gofr_tpu.config import new_mock_config
+
+
+@pytest.fixture(scope="module")
+def app_client():
+    cfg = new_mock_config({
+        "APP_NAME": "test-app",
+        "HTTP_PORT": "0",
+        "METRICS_PORT": "0",
+        "REQUEST_TIMEOUT": "2",
+    })
+    app = gofr_tpu.new(config=cfg)
+
+    def greet(ctx):
+        return "Hello World!"
+
+    async def async_greet(ctx):
+        return {"hi": ctx.param("name")}
+
+    def boom(ctx):
+        raise RuntimeError("kaboom")
+
+    def not_found(ctx):
+        raise gofr_tpu.ErrorEntityNotFound("id", ctx.path_param("id"))
+
+    def echo(ctx):
+        return ctx.bind()
+
+    app.get("/greet", greet)
+    app.get("/async-greet", async_greet)
+    app.get("/boom", boom)
+    app.get("/things/{id}", not_found)
+    app.post("/echo", echo)
+    app.run_in_background()
+
+    base = f"http://127.0.0.1:{app.http_server.port}"
+
+    def call(method, path, body=None, headers=None):
+        req = urllib.request.Request(base + path, method=method, data=body, headers=headers or {})
+        try:
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                return resp.status, dict(resp.headers), resp.read()
+        except urllib.error.HTTPError as e:
+            return e.code, dict(e.headers), e.read()
+
+    yield app, call
+    app.shutdown()
+
+
+def test_greet(app_client):
+    _, call = app_client
+    status, headers, body = call("GET", "/greet")
+    assert status == 200
+    assert json.loads(body) == {"data": "Hello World!"}
+    assert headers.get("X-Correlation-ID")
+
+
+def test_async_handler_and_params(app_client):
+    _, call = app_client
+    status, _, body = call("GET", "/async-greet?name=kim")
+    assert status == 200
+    assert json.loads(body) == {"data": {"hi": "kim"}}
+
+
+def test_panic_recovery_500(app_client):
+    _, call = app_client
+    status, _, body = call("GET", "/boom")
+    assert status == 500
+    assert "error" in json.loads(body)
+
+
+def test_error_status_mapping(app_client):
+    _, call = app_client
+    status, _, body = call("GET", "/things/9")
+    assert status == 404
+    assert json.loads(body)["error"]["message"] == "No entity found with id: 9"
+
+
+def test_post_echo_201(app_client):
+    _, call = app_client
+    status, _, body = call(
+        "POST", "/echo", body=json.dumps({"k": "v"}).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    assert status == 201
+    assert json.loads(body) == {"data": {"k": "v"}}
+
+
+def test_well_known_health(app_client):
+    _, call = app_client
+    status, _, body = call("GET", "/.well-known/health")
+    assert status == 200
+    data = json.loads(body)["data"]
+    assert data["app"]["status"] == "UP"
+    assert data["app"]["details"]["name"] == "test-app"
+
+
+def test_well_known_alive(app_client):
+    _, call = app_client
+    status, _, body = call("GET", "/.well-known/alive")
+    assert json.loads(body) == {"data": {"status": "UP"}}
+
+
+def test_favicon(app_client):
+    _, call = app_client
+    status, headers, body = call("GET", "/favicon.ico")
+    assert status == 200
+    assert headers["Content-Type"] == "image/png"
+    assert body.startswith(b"\x89PNG")
+
+
+def test_route_not_registered_404(app_client):
+    _, call = app_client
+    status, _, body = call("GET", "/definitely-missing")
+    assert status == 404
+    assert json.loads(body)["error"]["message"] == "route not registered"
+
+
+def test_method_not_allowed_405(app_client):
+    _, call = app_client
+    status, _, _ = call("DELETE", "/greet")
+    assert status == 405
+
+
+def test_cors_preflight(app_client):
+    _, call = app_client
+    status, headers, _ = call("OPTIONS", "/greet")
+    assert status == 200
+    assert headers["Access-Control-Allow-Origin"] == "*"
+    assert "GET" in headers["Access-Control-Allow-Methods"]
+
+
+def test_metrics_scrape(app_client):
+    app, call = app_client
+    call("GET", "/greet")
+    with urllib.request.urlopen(f"http://127.0.0.1:{app.metrics_server.port}/metrics", timeout=10) as resp:
+        text = resp.read().decode()
+    assert "app_http_response_bucket" in text
+    assert 'path="/greet"' in text
+    assert "app_info" in text
+
+
+def test_keep_alive_two_requests(app_client):
+    app, _ = app_client
+    import http.client
+
+    conn = http.client.HTTPConnection("127.0.0.1", app.http_server.port, timeout=10)
+    conn.request("GET", "/greet")
+    r1 = conn.getresponse()
+    r1.read()
+    conn.request("GET", "/greet")
+    r2 = conn.getresponse()
+    assert r1.status == r2.status == 200
+    conn.close()
+
+
+def test_request_timeout_408():
+    import time
+
+    cfg = new_mock_config({"HTTP_PORT": "0", "METRICS_PORT": "0", "REQUEST_TIMEOUT": "0.3"})
+    app = gofr_tpu.new(config=cfg)
+
+    def slow(ctx):
+        time.sleep(1.5)
+        return "late"
+
+    app.get("/slow", slow)
+    app.run_in_background()
+    try:
+        req = urllib.request.Request(f"http://127.0.0.1:{app.http_server.port}/slow")
+        try:
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                status = resp.status
+        except urllib.error.HTTPError as e:
+            status = e.code
+        assert status == 408
+    finally:
+        app.shutdown()

@@ -1,0 +1,142 @@
+"""Request binding + responder envelope tests. Mirrors reference
+http/request_test.go and http/responder_test.go."""
+
+import dataclasses
+import io
+import json
+import zipfile
+
+import pytest
+
+from gofr_tpu.fileutil import Zip
+from gofr_tpu.http.errors import ErrorInvalidParam
+from gofr_tpu.http.request import Request, UploadedFile
+from gofr_tpu.http.responder import Raw, Redirect, FileResponse, respond, StreamingResponse
+
+
+def test_query_params():
+    r = Request("GET", "/x?name=a&name=b&empty=", {})
+    assert r.param("name") == "a"
+    assert r.params("name") == ["a", "b"]
+    assert r.param("empty") == ""
+    assert r.param("missing") == ""
+
+
+def test_json_bind_plain():
+    body = json.dumps({"a": 1}).encode()
+    r = Request("POST", "/x", {"content-type": "application/json"}, body)
+    assert r.bind() == {"a": 1}
+
+
+def test_json_bind_dataclass():
+    @dataclasses.dataclass
+    class Person:
+        name: str
+        age: int = 0
+
+    body = json.dumps({"name": "kim", "age": "41"}).encode()
+    r = Request("POST", "/x", {"content-type": "application/json"}, body)
+    p = r.bind(Person)
+    assert p.name == "kim" and p.age == 41
+
+
+def test_json_bind_missing_required_field():
+    @dataclasses.dataclass
+    class Person:
+        name: str
+
+    r = Request("POST", "/x", {"content-type": "application/json"}, b"{}")
+    with pytest.raises(ErrorInvalidParam):
+        r.bind(Person)
+
+
+def test_bad_json_raises():
+    r = Request("POST", "/x", {"content-type": "application/json"}, b"{nope")
+    with pytest.raises(ErrorInvalidParam):
+        r.bind()
+
+
+def _multipart(parts):
+    boundary = "XbOuNdArYx"
+    out = []
+    for name, filename, content, ctype in parts:
+        head = f'Content-Disposition: form-data; name="{name}"'
+        if filename:
+            head += f'; filename="{filename}"'
+        if ctype:
+            head += f"\r\nContent-Type: {ctype}"
+        out.append(f"--{boundary}\r\n{head}\r\n\r\n".encode() + content + b"\r\n")
+    out.append(f"--{boundary}--\r\n".encode())
+    return b"".join(out), f"multipart/form-data; boundary={boundary}"
+
+
+def test_multipart_bind():
+    body, ctype = _multipart([
+        ("name", None, b"kim", None),
+        ("doc", "a.txt", b"hello", "text/plain"),
+    ])
+    r = Request("POST", "/up", {"content-type": ctype}, body)
+    data = r.bind()
+    assert data["name"] == "kim"
+    assert isinstance(data["doc"], UploadedFile)
+    assert data["doc"].content == b"hello"
+    assert data["doc"].filename == "a.txt"
+
+
+def test_multipart_dataclass_with_zip():
+    buf = io.BytesIO()
+    with zipfile.ZipFile(buf, "w") as zf:
+        zf.writestr("inner.txt", "zipped!")
+    body, ctype = _multipart([
+        ("title", None, b"t1", None),
+        ("archive", "a.zip", buf.getvalue(), "application/zip"),
+    ])
+
+    @dataclasses.dataclass
+    class Upload:
+        title: str
+        archive: Zip = None
+
+    r = Request("POST", "/up", {"content-type": ctype}, body)
+    u = r.bind(Upload)
+    assert u.title == "t1"
+    assert u.archive.files["inner.txt"] == b"zipped!"
+
+
+def test_respond_success_envelope():
+    resp = respond({"x": 1}, None, "GET")
+    assert resp.status == 200
+    assert json.loads(resp.body) == {"data": {"x": 1}}
+
+
+def test_respond_method_status():
+    assert respond({"id": 1}, None, "POST").status == 201
+    assert respond(None, None, "DELETE").status == 204
+
+
+def test_respond_error_envelope():
+    class Boom(Exception):
+        status_code = 418
+        message = "teapot"
+
+    resp = respond(None, Boom(), "GET")
+    assert resp.status == 418
+    assert json.loads(resp.body) == {"error": {"message": "teapot"}}
+
+
+def test_respond_raw_and_file_and_redirect():
+    raw = respond(Raw([1, 2]), None, "GET")
+    assert json.loads(raw.body) == [1, 2]
+    f = respond(FileResponse(b"png-bytes", "image/png"), None, "GET")
+    assert f.body == b"png-bytes"
+    assert ("Content-Type", "image/png") in f.headers
+    rd = respond(Redirect("/next"), None, "GET")
+    assert rd.status == 302 and ("Location", "/next") in rd.headers
+
+
+def test_respond_streaming():
+    async def gen():
+        yield b"a"
+
+    resp = respond(StreamingResponse(gen()), None, "GET")
+    assert resp.stream is not None

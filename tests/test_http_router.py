@@ -1,0 +1,119 @@
+"""Router unit tests: static/param/wildcard matching, 404/405, middleware
+order. Mirrors reference http/router_test.go concerns."""
+
+import asyncio
+
+from gofr_tpu.http.request import Request
+from gofr_tpu.http.responder import Response
+from gofr_tpu.http.router import Router
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def make_handler(tag, seen=None):
+    async def h(req):
+        if seen is not None:
+            seen.append((tag, dict(req.path_params)))
+        return Response(200, [], tag.encode())
+
+    return h
+
+
+def test_static_route_match():
+    r = Router()
+    r.add("GET", "/greet", make_handler("greet"))
+    resp = run(r.dispatch(Request("GET", "/greet", {})))
+    assert resp.status == 200 and resp.body == b"greet"
+
+
+def test_param_route_match():
+    seen = []
+    r = Router()
+    r.add("GET", "/users/{id}/posts/{pid}", make_handler("x", seen))
+    resp = run(r.dispatch(Request("GET", "/users/42/posts/7", {})))
+    assert resp.status == 200
+    assert seen[0][1] == {"id": "42", "pid": "7"}
+
+
+def test_wildcard_route():
+    seen = []
+    r = Router()
+    r.add("GET", "/static/{filepath...}", make_handler("s", seen))
+    resp = run(r.dispatch(Request("GET", "/static/css/app.css", {})))
+    assert resp.status == 200
+    assert seen[0][1] == {"filepath": "css/app.css"}
+
+
+def test_404_and_405():
+    r = Router()
+    r.add("GET", "/a", make_handler("a"))
+    assert run(r.dispatch(Request("GET", "/nope", {}))).status == 404
+    assert run(r.dispatch(Request("POST", "/a", {}))).status == 405
+
+
+def test_param_404_vs_405():
+    r = Router()
+    r.add("GET", "/u/{id}", make_handler("u"))
+    assert run(r.dispatch(Request("POST", "/u/5", {}))).status == 405
+    assert run(r.dispatch(Request("GET", "/u/5/extra", {}))).status == 404
+
+
+def test_static_beats_param():
+    r = Router()
+    seen = []
+    r.add("GET", "/u/{id}", make_handler("param", seen))
+    r.add("GET", "/u/me", make_handler("static", seen))
+    run(r.dispatch(Request("GET", "/u/me", {})))
+    assert seen[0][0] == "static"
+
+
+def test_middleware_order_and_wrapping():
+    calls = []
+
+    def mw(tag):
+        def factory(next_h):
+            async def h(req):
+                calls.append(f"{tag}-in")
+                resp = await next_h(req)
+                calls.append(f"{tag}-out")
+                return resp
+
+            return h
+
+        return factory
+
+    r = Router()
+    r.use(mw("outer"))
+    r.use(mw("inner"))
+    r.add("GET", "/x", make_handler("x"))
+    r.build()
+    run(r.dispatch(Request("GET", "/x", {})))
+    assert calls == ["outer-in", "inner-in", "inner-out", "outer-out"]
+
+
+def test_middleware_sees_404():
+    hits = []
+
+    def mw(next_h):
+        async def h(req):
+            hits.append(req.path)
+            return await next_h(req)
+
+        return h
+
+    r = Router()
+    r.use(mw)
+    r.build()
+    resp = run(r.dispatch(Request("GET", "/missing", {})))
+    assert resp.status == 404
+    assert hits == ["/missing"]
+
+
+def test_routes_listing():
+    r = Router()
+    r.add("GET", "/a", make_handler("a"))
+    r.add("POST", "/u/{id}", make_handler("u"))
+    assert ("GET", "/a") in r.routes()
+    assert ("POST", "/u/{id}") in r.routes()

@@ -1,0 +1,153 @@
+"""Regression tests for review findings on the HTTP/app layer."""
+
+import asyncio
+import json
+
+from gofr_tpu.http.request import Request
+from gofr_tpu.http.responder import Response
+from gofr_tpu.http.router import UNMATCHED, Router
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def test_sibling_param_names_bind_correctly():
+    seen = []
+
+    def make(tag):
+        async def h(req):
+            seen.append((tag, dict(req.path_params)))
+            return Response(200, [], b"")
+
+        return h
+
+    r = Router()
+    r.add("GET", "/a/{x}", make("one"))
+    r.add("GET", "/a/{y}/b", make("two"))
+    run(r.dispatch(Request("GET", "/a/VAL/b", {})))
+    run(r.dispatch(Request("GET", "/a/ONLY", {})))
+    assert ("two", {"y": "VAL"}) in seen
+    assert ("one", {"x": "ONLY"}) in seen
+
+
+def test_same_leaf_different_methods_param_names():
+    seen = []
+
+    def make(tag):
+        async def h(req):
+            seen.append((tag, dict(req.path_params)))
+            return Response(200, [], b"")
+
+        return h
+
+    r = Router()
+    r.add("GET", "/e/{gid}", make("get"))
+    r.add("POST", "/e/{pid}", make("post"))
+    run(r.dispatch(Request("GET", "/e/1", {})))
+    run(r.dispatch(Request("POST", "/e/2", {})))
+    assert ("get", {"gid": "1"}) in seen
+    assert ("post", {"pid": "2"}) in seen
+
+
+def test_unmatched_label_constant():
+    r = Router()
+    req = Request("GET", "/random/url/123", {})
+    run(r.dispatch(req))
+    assert req.route_template == UNMATCHED
+
+
+def test_500_message_masked():
+    """Unexpected exceptions must not leak str(e) to clients."""
+    from gofr_tpu.config import new_mock_config
+    from gofr_tpu.container import Container
+    from gofr_tpu.handler import wrap_handler
+
+    container = Container.create(new_mock_config({}))
+
+    def leaky(ctx):
+        raise ValueError("secret internal detail")
+
+    h = wrap_handler(leaky, container, None)
+    resp = run(h(Request("GET", "/x", {})))
+    assert resp.status == 500
+    body = json.loads(resp.body)
+    assert "secret" not in json.dumps(body)
+    assert body["error"]["message"] == "some unexpected error has occurred"
+
+
+def test_http_error_message_passes_through():
+    from gofr_tpu.config import new_mock_config
+    from gofr_tpu.container import Container
+    from gofr_tpu.handler import wrap_handler
+    from gofr_tpu.http.errors import ErrorEntityNotFound
+
+    container = Container.create(new_mock_config({}))
+
+    def nf(ctx):
+        raise ErrorEntityNotFound("id", "7")
+
+    h = wrap_handler(nf, container, None)
+    resp = run(h(Request("GET", "/x", {})))
+    assert resp.status == 404
+    assert json.loads(resp.body)["error"]["message"] == "No entity found with id: 7"
+
+
+def test_json_null_body_cached():
+    r = Request("POST", "/x", {"content-type": "application/json"}, b"null")
+    assert r.json() is None
+    assert r.json() is None  # second call hits cache, no re-parse crash
+
+
+def test_sync_handler_span_parenting():
+    """ctx.trace() from a sync handler must join the request trace."""
+    from gofr_tpu.config import new_mock_config
+    from gofr_tpu.container import Container
+    from gofr_tpu.context import Context
+    from gofr_tpu.tracing import Tracer
+
+    container = Container.create(new_mock_config({}))
+    tracer = Tracer("t")
+    container.tracer = tracer
+    req = Request("GET", "/x", {})
+    request_span = tracer.start_span("GET /x")
+    request_span.end()
+    req.context["span"] = request_span
+    ctx = Context(req, container)
+    child = ctx.trace("db-op")
+    assert child.trace_id == request_span.trace_id
+    child.end()
+
+
+def test_cmd_app():
+    from gofr_tpu.cmd import CMDApp
+    from gofr_tpu.config import new_mock_config
+
+    app = CMDApp(config=new_mock_config({}))
+    out = {}
+
+    def hello(ctx):
+        out["name"] = ctx.param("name")
+        return f"Hello {ctx.param('name')}"
+
+    app.sub_command("hello", hello, "greets")
+    rc = app.run(["hello", "-name=kim"])
+    assert rc == 0
+    assert out["name"] == "kim"
+    assert app.run(["unknown-cmd"]) == 1
+
+
+def test_cmd_bind_dataclass():
+    import dataclasses
+
+    from gofr_tpu.cmd import CMDRequest
+
+    @dataclasses.dataclass
+    class Args:
+        count: int = 0
+        verbose: bool = False
+
+    req = CMDRequest(["run", "-count=5", "--verbose"])
+    a = req.bind(Args)
+    assert a.count == 5 and a.verbose is True
+    assert req.command == "run"
