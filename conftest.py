@@ -1,12 +1,17 @@
-# Root conftest: force JAX onto a virtual 8-device CPU mesh BEFORE jax import.
-# Mirrors the reference's CI strategy of substituting real services with local
-# stand-ins (reference .github/workflows/go.yml:61-91 runs Kafka/Redis/MySQL
-# containers; our "service container" is the CPU PJRT backend).
+# Root conftest: force JAX onto a virtual 8-device CPU mesh BEFORE any test
+# imports jax. Mirrors the reference's CI strategy of substituting real
+# services with local stand-ins (reference .github/workflows/go.yml:61-91
+# runs Kafka/Redis/MySQL containers; our "service container" is the CPU PJRT
+# backend). Env vars alone don't stick in this image (a platform plugin
+# overrides JAX_PLATFORMS at import), so we set the jax config explicitly.
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,34 @@
+"""gofr_tpu.models — model zoo for the TPU datasource.
+
+Models are pure functions over pytree params (no module framework): that
+keeps them trivially shardable with jax.sharding, checkpointable with orbax,
+and jittable without object plumbing. The flagship is a Gemma-family decoder
+transformer (BASELINE.json configs 3/5); the MLP backs the MNIST single-chip
+config (BASELINE.json config 2).
+"""
+
+from .mlp import MLPConfig, mlp_forward, mlp_init
+from .transformer import (
+    KVCache,
+    TransformerConfig,
+    decode_step,
+    generate,
+    init_cache,
+    init_params,
+    prefill,
+    transformer_forward,
+)
+
+__all__ = [
+    "MLPConfig",
+    "mlp_init",
+    "mlp_forward",
+    "TransformerConfig",
+    "init_params",
+    "init_cache",
+    "KVCache",
+    "transformer_forward",
+    "prefill",
+    "decode_step",
+    "generate",
+]

@@ -1,0 +1,40 @@
+"""MNIST-class MLP — the minimum end-to-end serving slice (SURVEY.md §7.4,
+BASELINE.json config 2: "http-server + ctx.TPU() single-chip MLP")."""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class MLPConfig:
+    in_dim: int = 784
+    hidden: tuple[int, ...] = (512, 256)
+    out_dim: int = 10
+    dtype: jnp.dtype = jnp.bfloat16
+
+
+def mlp_init(rng: jax.Array, cfg: MLPConfig) -> dict:
+    dims = (cfg.in_dim, *cfg.hidden, cfg.out_dim)
+    params = {}
+    for i, (d_in, d_out) in enumerate(zip(dims[:-1], dims[1:])):
+        rng, wkey = jax.random.split(rng)
+        params[f"w{i}"] = (
+            jax.random.normal(wkey, (d_in, d_out), jnp.float32) / jnp.sqrt(d_in)
+        ).astype(cfg.dtype)
+        params[f"b{i}"] = jnp.zeros((d_out,), cfg.dtype)
+    return params
+
+
+def mlp_forward(params: dict, x: jnp.ndarray) -> jnp.ndarray:
+    """x: [batch, in_dim] -> logits [batch, out_dim]."""
+    n_layers = len(params) // 2
+    h = x.astype(next(iter(params.values())).dtype)
+    for i in range(n_layers):
+        h = h @ params[f"w{i}"] + params[f"b{i}"]
+        if i < n_layers - 1:
+            h = jax.nn.gelu(h)
+    return h.astype(jnp.float32)

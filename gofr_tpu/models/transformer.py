@@ -1,0 +1,308 @@
+"""Gemma-family decoder-only transformer, TPU-first.
+
+Design choices (all for XLA/TPU, none inherited from the reference repo,
+which contains no models — SURVEY.md §2.9):
+
+- **Pure functions over pytrees.** Params are nested dicts of arrays; no
+  module system. Sharding is a pytree of PartitionSpecs zipped over the same
+  structure (gofr_tpu.parallel.sharding).
+- **Layers stacked, scanned.** All layer weights carry a leading [n_layers]
+  axis and the layer stack is a single `lax.scan` — one compiled layer body
+  regardless of depth, which keeps compile times flat and lets XLA pipeline
+  the weight streams from HBM.
+- **Static shapes everywhere.** Prefill takes right-padded [batch, seq]
+  buckets with a length vector; decode is a fixed-shape single-token step
+  against a preallocated KV cache (ring position = per-sequence cursor).
+  Data-dependent work (sampling loops) uses lax.scan / lax.while_loop.
+- **bfloat16 activations & weights, float32 softmax/norms/logits.**
+
+Gemma conventions implemented: RMSNorm applied as (1+scale), embeddings
+scaled by sqrt(d_model), GeGLU MLP, RoPE, GQA/MQA, optional logit
+soft-capping (Gemma 2), tied input/output embeddings.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from ..ops import decode_attention, multi_head_attention, rms_norm, apply_rope
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 256_000
+    d_model: int = 2048
+    n_layers: int = 18
+    n_heads: int = 8
+    n_kv_heads: int = 1
+    head_dim: int = 256
+    d_ff: int = 16_384
+    rope_theta: float = 10_000.0
+    norm_eps: float = 1e-6
+    attn_logit_cap: float = 0.0  # gemma-2 style soft-capping; 0 disables
+    final_logit_cap: float = 0.0
+    dtype: Any = jnp.bfloat16
+
+    # ---- presets -------------------------------------------------------
+    @staticmethod
+    def gemma_2b() -> "TransformerConfig":
+        return TransformerConfig()
+
+    @staticmethod
+    def gemma_7b() -> "TransformerConfig":
+        return TransformerConfig(
+            d_model=3072, n_layers=28, n_heads=16, n_kv_heads=16, d_ff=24_576
+        )
+
+    @staticmethod
+    def tiny(vocab_size: int = 512) -> "TransformerConfig":
+        """CI-sized model: runs the identical code path on CPU in ms."""
+        return TransformerConfig(
+            vocab_size=vocab_size, d_model=64, n_layers=2, n_heads=4,
+            n_kv_heads=2, head_dim=16, d_ff=128, dtype=jnp.float32,
+        )
+
+
+class KVCache(NamedTuple):
+    """Preallocated per-layer KV with a per-sequence write cursor."""
+
+    k: jnp.ndarray  # [n_layers, batch, max_len, n_kv_heads, head_dim]
+    v: jnp.ndarray  # [n_layers, batch, max_len, n_kv_heads, head_dim]
+    length: jnp.ndarray  # [batch] int32 — tokens written so far
+
+
+def init_cache(cfg: TransformerConfig, batch: int, max_len: int) -> KVCache:
+    shape = (cfg.n_layers, batch, max_len, cfg.n_kv_heads, cfg.head_dim)
+    return KVCache(
+        k=jnp.zeros(shape, cfg.dtype),
+        v=jnp.zeros(shape, cfg.dtype),
+        length=jnp.zeros((batch,), jnp.int32),
+    )
+
+
+def init_params(rng: jax.Array, cfg: TransformerConfig) -> dict:
+    d, hd, hq, hkv, ff, L = (
+        cfg.d_model, cfg.head_dim, cfg.n_heads, cfg.n_kv_heads, cfg.d_ff, cfg.n_layers,
+    )
+    keys = jax.random.split(rng, 6)
+
+    def w(key, shape, fan_in):
+        return (jax.random.normal(key, shape, jnp.float32) / jnp.sqrt(fan_in)).astype(
+            cfg.dtype
+        )
+
+    return {
+        "embed": w(keys[0], (cfg.vocab_size, d), d),
+        "final_norm": jnp.zeros((d,), cfg.dtype),
+        "layers": {
+            "attn_norm": jnp.zeros((L, d), cfg.dtype),
+            "wq": w(keys[1], (L, d, hq * hd), d),
+            "wkv": w(keys[2], (L, d, 2 * hkv * hd), d),
+            "wo": w(keys[3], (L, hq * hd, d), hq * hd),
+            "mlp_norm": jnp.zeros((L, d), cfg.dtype),
+            "w_gate_up": w(keys[4], (L, d, 2 * ff), d),
+            "w_down": w(keys[5], (L, ff, d), ff),
+        },
+    }
+
+
+def _layer_body(
+    cfg: TransformerConfig,
+    x: jnp.ndarray,  # [b, s, d]
+    lp: dict,  # one layer's params (no leading L axis)
+    positions: jnp.ndarray,  # [b, s]
+    *,
+    k_cache: jnp.ndarray | None,  # [b, max_len, hkv, hd] or None
+    v_cache: jnp.ndarray | None,
+    cache_length: jnp.ndarray | None,  # [b]
+    kv_mask: jnp.ndarray | None,
+    decode: bool,
+):
+    b, s, d = x.shape
+    hq, hkv, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+
+    h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
+    q = (h @ lp["wq"]).reshape(b, s, hq, hd)
+    # wkv packs heads OUTERMOST ([hkv, 2, hd] per output column block) so a
+    # TP shard of the flat output dim holds whole (k, v) head pairs — keeps
+    # Megatron column-parallel layout collective-free inside the layer.
+    kv = (h @ lp["wkv"]).reshape(b, s, hkv, 2, hd)
+    k, v = kv[:, :, :, 0], kv[:, :, :, 1]
+    q = apply_rope(q, positions, cfg.rope_theta)
+    k = apply_rope(k, positions, cfg.rope_theta)
+    # Gemma queries are scaled by 1/sqrt(head_dim) (applied inside attention).
+
+    if decode:
+        # Write this step's k/v at each sequence's cursor, then attend over
+        # the valid prefix. vmap'd dynamic_update_slice = per-batch scatter.
+        upd = jax.vmap(lambda c, n, i: jax.lax.dynamic_update_slice(c, n, (i, 0, 0)))
+        k_cache = upd(k_cache, k.astype(k_cache.dtype), cache_length)
+        v_cache = upd(v_cache, v.astype(v_cache.dtype), cache_length)
+        attn = decode_attention(
+            q, k_cache, v_cache, cache_length + 1, logit_cap=cfg.attn_logit_cap
+        )
+        new_k, new_v = k_cache, v_cache
+    else:
+        # Right-padded prompts need no kv_mask here: pads sit AFTER real
+        # tokens, so causal masking already hides them from every real query;
+        # pad-position outputs are discarded (loss-masked / never read) and
+        # pad K/V in the cache is masked by cache.length at decode. Keeping
+        # the call dense is what lets the Pallas flash kernel engage.
+        attn = multi_head_attention(q, k, v, causal=True, logit_cap=cfg.attn_logit_cap)
+        # Prefill fills the cache from position 0 (right-padded batches).
+        new_k, new_v = k, v
+
+    x = x + (attn.reshape(b, s, hq * hd) @ lp["wo"]).astype(x.dtype)
+
+    h = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
+    gate_up = h @ lp["w_gate_up"]
+    gate, up = jnp.split(gate_up, 2, axis=-1)
+    x = x + (jax.nn.gelu(gate) * up) @ lp["w_down"]
+    return x, new_k, new_v
+
+
+def transformer_forward(
+    params: dict,
+    cfg: TransformerConfig,
+    tokens: jnp.ndarray,  # [b, s] int32
+    positions: jnp.ndarray,  # [b, s] int32
+    *,
+    cache: KVCache | None = None,
+    kv_mask: jnp.ndarray | None = None,  # [b, s] True = real token (prefill)
+    decode: bool = False,
+) -> tuple[jnp.ndarray, KVCache | None]:
+    """Returns (logits [b, s, vocab] float32, updated cache or None)."""
+    x = params["embed"][tokens].astype(cfg.dtype)
+    x = x * jnp.sqrt(jnp.asarray(cfg.d_model, jnp.float32)).astype(cfg.dtype)
+
+    if decode:
+        assert cache is not None
+
+        def body(xc, layer_in):
+            lp, kc, vc = layer_in
+            x, _ = xc
+            x, nk, nv = _layer_body(
+                cfg, x, lp, positions,
+                k_cache=kc, v_cache=vc, cache_length=cache.length,
+                kv_mask=None, decode=True,
+            )
+            return (x, None), (nk, nv)
+
+        (x, _), (ks, vs) = jax.lax.scan(
+            body, (x, None), (params["layers"], cache.k, cache.v)
+        )
+        new_cache = KVCache(k=ks, v=vs, length=cache.length + 1)
+    else:
+
+        def body(xc, lp):
+            x, _ = xc
+            x, nk, nv = _layer_body(
+                cfg, x, lp, positions,
+                k_cache=None, v_cache=None, cache_length=None,
+                kv_mask=kv_mask, decode=False,
+            )
+            return (x, None), (nk, nv)
+
+        (x, _), (ks, vs) = jax.lax.scan(body, (x, None), params["layers"])
+        if cache is not None:
+            max_len = cache.k.shape[2]
+            s = tokens.shape[1]
+            pad = [(0, 0), (0, 0), (0, max_len - s), (0, 0), (0, 0)]
+            lengths = (
+                kv_mask.sum(axis=-1).astype(jnp.int32)
+                if kv_mask is not None
+                else jnp.full((tokens.shape[0],), s, jnp.int32)
+            )
+            new_cache = KVCache(
+                k=jnp.pad(ks.astype(cache.k.dtype), pad),
+                v=jnp.pad(vs.astype(cache.v.dtype), pad),
+                length=lengths,
+            )
+        else:
+            new_cache = None
+
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    logits = (x @ params["embed"].T.astype(cfg.dtype)).astype(jnp.float32)
+    if cfg.final_logit_cap > 0.0:
+        logits = cfg.final_logit_cap * jnp.tanh(logits / cfg.final_logit_cap)
+    return logits, new_cache
+
+
+def prefill(
+    params: dict,
+    cfg: TransformerConfig,
+    tokens: jnp.ndarray,  # [b, s] right-padded
+    lengths: jnp.ndarray,  # [b]
+    max_cache_len: int,
+) -> tuple[jnp.ndarray, KVCache]:
+    """Process prompts, build the KV cache, return last-token logits [b, vocab]."""
+    b, s = tokens.shape
+    positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s))
+    kv_mask = positions < lengths[:, None]
+    cache = init_cache(cfg, b, max_cache_len)
+    logits, new_cache = transformer_forward(
+        params, cfg, tokens, positions, cache=cache, kv_mask=kv_mask
+    )
+    last = jnp.take_along_axis(logits, (lengths - 1)[:, None, None], axis=1)[:, 0]
+    return last, new_cache
+
+
+def decode_step(
+    params: dict,
+    cfg: TransformerConfig,
+    tokens: jnp.ndarray,  # [b] last sampled token per sequence
+    cache: KVCache,
+) -> tuple[jnp.ndarray, KVCache]:
+    """One token step for every sequence in the batch. [b] -> logits [b, vocab].
+
+    Precondition: every cache.length < max_len. dynamic_update_slice clamps
+    out-of-bounds starts, so a full cache would silently overwrite the last
+    slot — callers (the serving scheduler, generate) must bound steps by the
+    cache capacity; gofr_tpu.datasource.tpu enforces this at admission."""
+    positions = cache.length[:, None]
+    logits, new_cache = transformer_forward(
+        params, cfg, tokens[:, None], positions, cache=cache, decode=True
+    )
+    return logits[:, 0], new_cache
+
+
+def generate(
+    params: dict,
+    cfg: TransformerConfig,
+    prompt: jnp.ndarray,  # [b, s] right-padded
+    lengths: jnp.ndarray,  # [b]
+    max_new_tokens: int,
+    *,
+    temperature: float = 0.0,
+    rng: jax.Array | None = None,
+) -> jnp.ndarray:
+    """Greedy (temperature=0) or sampled generation. Fixed-trip lax.scan so
+    the whole thing is one compiled program; serving instead drives
+    decode_step per token for streaming."""
+    b, s = prompt.shape
+    last_logits, cache = prefill(params, cfg, prompt, lengths, s + max_new_tokens)
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+
+    def sample(logits, key):
+        if temperature > 0.0:
+            return jax.random.categorical(key, logits / temperature, axis=-1)
+        return jnp.argmax(logits, axis=-1)
+
+    def body(carry, key):
+        logits, cache = carry
+        tok = sample(logits, key).astype(jnp.int32)
+        logits, cache = decode_step(params, cfg, tok, cache)
+        return (logits, cache), tok
+
+    keys = jax.random.split(rng, max_new_tokens)
+    if max_new_tokens == 1:
+        return sample(last_logits, keys[0]).astype(jnp.int32)[:, None]
+    # Scan n-1 steps, sample the final token from the last logits directly —
+    # avoids paying a forward pass whose logits would be discarded.
+    (last_logits, _), toks = jax.lax.scan(body, (last_logits, cache), keys[:-1])
+    final = sample(last_logits, keys[-1]).astype(jnp.int32)
+    return jnp.concatenate([toks.T, final[:, None]], axis=1)  # [b, max_new_tokens]

@@ -1,0 +1,28 @@
+"""gofr_tpu.ops — TPU-first neural net ops.
+
+The compute path of the framework's model-serving datasource. Everything here
+is functional, jit-safe, static-shape. Hot ops (attention) have a Pallas TPU
+kernel with an XLA reference fallback selected at trace time by platform.
+
+The reference (maohieng/gofr) has no compute ops at all (SURVEY.md §2.9) —
+this package exists for the TPU north star (BASELINE.json).
+"""
+
+from .attention import (
+    decode_attention,
+    flash_attention,
+    mha_reference,
+    multi_head_attention,
+)
+from .norms import rms_norm
+from .rope import apply_rope, rope_frequencies
+
+__all__ = [
+    "multi_head_attention",
+    "mha_reference",
+    "flash_attention",
+    "decode_attention",
+    "rms_norm",
+    "apply_rope",
+    "rope_frequencies",
+]

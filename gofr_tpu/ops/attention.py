@@ -1,0 +1,300 @@
+"""Attention: Pallas flash-attention kernel for TPU + XLA reference path.
+
+Layout convention everywhere: [batch, seq, heads, head_dim] at module
+boundaries ("BSHD"); the flash kernel internally works per (batch, head)
+grid cell. GQA is supported natively — K/V carry n_kv_heads and the kernel's
+BlockSpec index_map points each query head at its KV group, so grouped KV is
+never materialized at full head count (saves HBM bandwidth, the usual TPU
+bottleneck).
+
+The flash kernel is the canonical online-softmax blockwise algorithm: grid
+(batch, q_heads, q_blocks, k_blocks) with the k dimension innermost;
+running max / normalizer / output accumulator live in VMEM scratch that
+persists across the sequential k iterations, finalized on the last k block.
+Causal masking skips fully-masked k blocks via pl.when.
+
+No counterpart in the reference repo (a Go web framework, SURVEY.md §2.9);
+this implements the TPU north star's compute path (BASELINE.json).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # TPU-specific memory spaces; absent in some CPU-only builds
+    from jax.experimental.pallas import tpu as pltpu
+
+    _HAS_PLTPU = True
+except ImportError:  # pragma: no cover
+    pltpu = None
+    _HAS_PLTPU = False
+
+NEG_INF = -2.3819763e38  # close to bf16 min; avoids nan from (-inf) - (-inf)
+
+
+# ---------------------------------------------------------------------------
+# Reference path (XLA). Used on CPU, for odd shapes, and as the test oracle.
+# ---------------------------------------------------------------------------
+
+
+def mha_reference(
+    q: jnp.ndarray,  # [b, sq, hq, d]
+    k: jnp.ndarray,  # [b, sk, hkv, d]
+    v: jnp.ndarray,  # [b, sk, hkv, d]
+    *,
+    causal: bool = True,
+    scale: float | None = None,
+    logit_cap: float = 0.0,
+    kv_mask: jnp.ndarray | None = None,  # [b, sk] bool, True = attend
+    q_positions: jnp.ndarray | None = None,  # [b, sq] absolute positions
+) -> jnp.ndarray:
+    b, sq, hq, d = q.shape
+    hkv = k.shape[2]
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    group = hq // hkv
+
+    qf = q.astype(jnp.float32) * scale
+    # [b, hkv, group, sq, d] x [b, hkv, sk, d] -> [b, hkv, group, sq, sk]
+    qg = qf.transpose(0, 2, 1, 3).reshape(b, hkv, group, sq, d)
+    kf = k.astype(jnp.float32).transpose(0, 2, 1, 3)  # [b, hkv, sk, d]
+    vf = v.astype(jnp.float32).transpose(0, 2, 1, 3)
+    logits = jnp.einsum("bkgqd,bksd->bkgqs", qg, kf)
+    if logit_cap > 0.0:
+        logits = logit_cap * jnp.tanh(logits / logit_cap)
+
+    sk = k.shape[1]
+    mask = jnp.ones((b, sq, sk), dtype=bool)
+    if causal:
+        qpos = (
+            q_positions
+            if q_positions is not None
+            else jnp.broadcast_to(jnp.arange(sq), (b, sq))
+        )
+        kpos = jnp.arange(sk)
+        mask = mask & (kpos[None, None, :] <= qpos[:, :, None])
+    if kv_mask is not None:
+        mask = mask & kv_mask[:, None, :]
+    logits = jnp.where(mask[:, None, None, :, :], logits, NEG_INF)
+
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bkgqs,bksd->bkgqd", probs, vf)
+    out = out.reshape(b, hq, sq, d).transpose(0, 2, 1, 3)
+    return out.astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Pallas flash attention (TPU prefill path)
+# ---------------------------------------------------------------------------
+
+
+def _flash_kernel(
+    q_ref,  # [block_q, d]
+    k_ref,  # [block_k, d]
+    v_ref,  # [block_k, d]
+    o_ref,  # [block_q, d]
+    m_scratch,  # [block_q, 128] f32  (lane-replicated running max)
+    l_scratch,  # [block_q, 128] f32  (lane-replicated running denom)
+    acc_scratch,  # [block_q, d] f32
+    *,
+    causal: bool,
+    scale: float,
+    logit_cap: float,
+    block_q: int,
+    block_k: int,
+    num_k_blocks: int,
+):
+    qi = pl.program_id(2)
+    ki = pl.program_id(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scratch[:] = jnp.full_like(m_scratch, NEG_INF)
+        l_scratch[:] = jnp.zeros_like(l_scratch)
+        acc_scratch[:] = jnp.zeros_like(acc_scratch)
+
+    # Causal: block is live iff some query position >= some key position,
+    # i.e. block_q_end >= block_k_start.
+    live = (qi + 1) * block_q - 1 >= ki * block_k if causal else True
+
+    @pl.when(live)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32) * scale  # [block_q, d]
+        k = k_ref[0, 0].astype(jnp.float32)  # [block_k, d]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [block_q, block_k]
+        if logit_cap > 0.0:
+            s = logit_cap * jnp.tanh(s / logit_cap)
+        if causal:
+            qpos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            kpos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(kpos <= qpos, s, NEG_INF)
+
+        m_prev = m_scratch[:, :1]  # [block_q, 1]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)  # [block_q, block_k]
+        alpha = jnp.exp(m_prev - m_new)  # [block_q, 1]
+        l_new = alpha * l_scratch[:, :1] + jnp.sum(p, axis=-1, keepdims=True)
+
+        m_scratch[:] = jnp.broadcast_to(m_new, m_scratch.shape)
+        l_scratch[:] = jnp.broadcast_to(l_new, l_scratch.shape)
+        acc_scratch[:] = acc_scratch[:] * alpha + jax.lax.dot_general(
+            p,
+            v_ref[0, 0].astype(jnp.float32),
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(ki == num_k_blocks - 1)
+    def _finalize():
+        denom = l_scratch[:, :1]
+        denom = jnp.where(denom == 0.0, 1.0, denom)  # fully-masked rows -> 0
+        o_ref[0, 0] = (acc_scratch[:] / denom).astype(o_ref.dtype)
+
+
+def flash_attention(
+    q: jnp.ndarray,  # [b, sq, hq, d]
+    k: jnp.ndarray,  # [b, sk, hkv, d]
+    v: jnp.ndarray,  # [b, sk, hkv, d]
+    *,
+    causal: bool = True,
+    scale: float | None = None,
+    logit_cap: float = 0.0,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    if not _HAS_PLTPU:
+        raise RuntimeError(
+            "flash_attention requires jax.experimental.pallas.tpu (scratch "
+            "memory spaces); use mha_reference / multi_head_attention instead"
+        )
+    b, sq, hq, d = q.shape
+    sk, hkv = k.shape[1], k.shape[2]
+    if sq % block_q or sk % block_k:
+        raise ValueError(f"seq lengths ({sq},{sk}) must divide blocks ({block_q},{block_k})")
+    if hq % hkv:
+        raise ValueError(f"q heads {hq} not a multiple of kv heads {hkv}")
+    group = hq // hkv
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    num_k_blocks = sk // block_k
+
+    # BHSD layout inside the kernel: contiguous [seq, d] slabs per head.
+    qt = q.transpose(0, 2, 1, 3)  # [b, hq, sq, d]
+    kt = k.transpose(0, 2, 1, 3)  # [b, hkv, sk, d]
+    vt = v.transpose(0, 2, 1, 3)
+
+    grid = (b, hq, sq // block_q, num_k_blocks)
+    kernel = functools.partial(
+        _flash_kernel,
+        causal=causal,
+        scale=scale,
+        logit_cap=logit_cap,
+        block_q=block_q,
+        block_k=block_k,
+        num_k_blocks=num_k_blocks,
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+            pl.BlockSpec(
+                (1, 1, block_k, d), lambda bi, hi, qi, ki: (bi, hi // group, ki, 0)
+            ),
+            pl.BlockSpec(
+                (1, 1, block_k, d), lambda bi, hi, qi, ki: (bi, hi // group, ki, 0)
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, block_q, d), lambda bi, hi, qi, ki: (bi, hi, qi, 0)
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, hq, sq, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ]
+        if _HAS_PLTPU
+        else [],
+        interpret=interpret,
+    )(qt, kt, vt)
+    return out.transpose(0, 2, 1, 3)
+
+
+# ---------------------------------------------------------------------------
+# Decode attention (single query step against a KV cache)
+# ---------------------------------------------------------------------------
+
+
+def decode_attention(
+    q: jnp.ndarray,  # [b, 1, hq, d]
+    k_cache: jnp.ndarray,  # [b, max_len, hkv, d]
+    v_cache: jnp.ndarray,  # [b, max_len, hkv, d]
+    lengths: jnp.ndarray,  # [b] int32 — valid prefix length per sequence
+    *,
+    scale: float | None = None,
+    logit_cap: float = 0.0,
+) -> jnp.ndarray:
+    """Decode is HBM-bandwidth-bound; a plain einsum lets XLA stream the
+    cache through the VPU fused with the mask — a hand kernel buys nothing
+    at these arithmetic intensities, so we keep the compiler-friendly form."""
+    max_len = k_cache.shape[1]
+    kv_mask = jnp.arange(max_len)[None, :] < lengths[:, None]  # [b, max_len]
+    return mha_reference(
+        q, k_cache, v_cache, causal=False, scale=scale, logit_cap=logit_cap, kv_mask=kv_mask
+    )
+
+
+# ---------------------------------------------------------------------------
+# Dispatcher
+# ---------------------------------------------------------------------------
+
+
+def _flash_ok(q: jnp.ndarray, k: jnp.ndarray, block_q: int, block_k: int) -> bool:
+    b, sq, hq, d = q.shape
+    sk = k.shape[1]
+    return (
+        _HAS_PLTPU
+        and jax.default_backend() == "tpu"
+        and sq % block_q == 0
+        and sk % block_k == 0
+        and d % 128 == 0
+    )
+
+
+def multi_head_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    causal: bool = True,
+    scale: float | None = None,
+    logit_cap: float = 0.0,
+    kv_mask: jnp.ndarray | None = None,
+    q_positions: jnp.ndarray | None = None,
+    block_q: int = 128,
+    block_k: int = 128,
+) -> jnp.ndarray:
+    """Platform dispatcher: Pallas flash kernel on TPU when shapes tile
+    cleanly onto the MXU, XLA reference otherwise. kv_mask/q_positions force
+    the reference path (the flash kernel assumes dense causal prefill)."""
+    if kv_mask is None and q_positions is None and _flash_ok(q, k, block_q, block_k):
+        return flash_attention(
+            q, k, v, causal=causal, scale=scale, logit_cap=logit_cap,
+            block_q=block_q, block_k=block_k,
+        )
+    return mha_reference(
+        q, k, v, causal=causal, scale=scale, logit_cap=logit_cap,
+        kv_mask=kv_mask, q_positions=q_positions,
+    )

@@ -1,0 +1,22 @@
+"""Normalization ops.
+
+RMSNorm in the Gemma convention: the learned scale is stored zero-centered
+and applied as (1 + scale), and the variance is computed in float32 even for
+bfloat16 activations (numerics matter more than the cast cost; XLA fuses the
+whole thing into neighbouring ops anyway, so a Pallas kernel buys nothing
+here — the win is in attention).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def rms_norm(x: jnp.ndarray, scale: jnp.ndarray, eps: float = 1e-6) -> jnp.ndarray:
+    """y = x / rms(x) * (1 + scale), computed in f32, cast back to x.dtype."""
+    dtype = x.dtype
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
+    normed = xf * jnp.reciprocal(jnp.sqrt(var + eps))
+    out = normed * (1.0 + scale.astype(jnp.float32))
+    return out.astype(dtype)

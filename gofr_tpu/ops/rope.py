@@ -1,0 +1,37 @@
+"""Rotary position embeddings (RoPE).
+
+Functional, shape-static, position-indexed so the same code path serves
+prefill (positions = arange) and decode (positions = per-sequence cursor),
+which is what keeps the decode step a single compiled executable.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def rope_frequencies(head_dim: int, theta: float = 10_000.0) -> jnp.ndarray:
+    """Inverse frequencies, shape [head_dim // 2], float32."""
+    exponent = jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim
+    return 1.0 / (theta**exponent)
+
+
+def apply_rope(
+    x: jnp.ndarray,  # [batch, seq, heads, head_dim]
+    positions: jnp.ndarray,  # [batch, seq] int32
+    theta: float = 10_000.0,
+) -> jnp.ndarray:
+    """Rotate pairs (x[..., :d/2], x[..., d/2:]) by position*freq.
+
+    Uses the "split halves" convention (as JAX/Gemma implementations do)
+    rather than interleaved pairs; consistent across prefill/decode so the
+    choice is unobservable from outside.
+    """
+    head_dim = x.shape[-1]
+    freqs = rope_frequencies(head_dim, theta)  # [d/2]
+    angles = positions[..., None].astype(jnp.float32) * freqs  # [b, s, d/2]
+    angles = angles[:, :, None, :]  # [b, s, 1, d/2] broadcast over heads
+    sin, cos = jnp.sin(angles), jnp.cos(angles)
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    rotated = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return rotated.astype(x.dtype)

@@ -1,0 +1,31 @@
+"""gofr_tpu.parallel — device meshes, shardings, and collectives.
+
+The reference's "distributed backend" is application-level HTTP/gRPC/Kafka
+(SURVEY.md §2.8 — no NCCL/MPI). Here the collective plane is XLA over
+ICI/DCN: pick a Mesh, annotate params/batch with PartitionSpecs, let GSPMD
+insert all-gather/reduce-scatter. Sequence parallelism (ring attention via
+shard_map + ppermute) makes long-context first-class.
+"""
+
+from .mesh import make_mesh, mesh_shape_for
+from .ring import ring_attention
+from .sharding import (
+    batch_spec,
+    param_specs,
+    shard_params,
+    with_shardings,
+)
+from .train import lm_loss, make_train_step, place_batch
+
+__all__ = [
+    "make_mesh",
+    "mesh_shape_for",
+    "param_specs",
+    "batch_spec",
+    "shard_params",
+    "with_shardings",
+    "ring_attention",
+    "make_train_step",
+    "place_batch",
+    "lm_loss",
+]

@@ -1,0 +1,104 @@
+"""Ring attention: causal attention with the sequence dim sharded over a
+mesh axis, K/V rotating around the ring via ppermute while every device
+accumulates its queries' online softmax. Memory per device is O(seq/N) and
+the K/V transfer overlaps with compute in XLA's pipeline — the TPU-native
+answer to long-context, replacing nothing in the reference (which has no
+sequence execution, SURVEY.md §5 "Long-context: absent").
+
+Algorithm (blockwise/ring attention, Liu et al. style): each of the N
+sequence shards holds q,k,v chunks of the globally-ordered sequence; step t
+lets shard i attend to the chunk originally owned by shard (i - t) mod N.
+Causality at chunk granularity: skip chunks from later positions, apply the
+triangular mask only on the diagonal (t == 0).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+NEG_INF = -2.3819763e38
+
+
+def _chunk_attn(q, k, v, scale, mask):
+    """q [b,sq,h,d] x k/v [b,sk,h,d] -> (scores-exp sum, max, weighted v).
+    mask: None (full) or [sq, sk] bool."""
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32) * scale, k.astype(jnp.float32))
+    if mask is not None:
+        logits = jnp.where(mask[None, None], logits, NEG_INF)
+    m = jnp.max(logits, axis=-1, keepdims=True)  # [b,h,q,1]
+    p = jnp.exp(logits - m)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    o = jnp.einsum("bhqk,bkhd->bhqd", p, v.astype(jnp.float32))
+    return m, l, o
+
+
+def ring_attention(
+    q: jnp.ndarray,  # [b, s, h, d] — s sharded over `axis`
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    mesh: Mesh,
+    axis: str = "seq",
+    causal: bool = True,
+    scale: float | None = None,
+) -> jnp.ndarray:
+    """Drop-in for multi_head_attention when seq is sharded. GQA: pass K/V
+    already expanded to q's head count (ring traffic is the cost anyway)."""
+    scale = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    n = mesh.shape[axis]
+
+    def local(qc, kc, vc):
+        axis_idx = jax.lax.axis_index(axis)
+        b, sq, h, d = qc.shape
+        tri = jnp.tril(jnp.ones((sq, sq), bool))
+
+        def step(t, carry):
+            kc, vc, m_acc, l_acc, o_acc = carry
+            src_idx = (axis_idx - t) % n  # chunk owner at this rotation
+            # Chunk-level causality: attend iff src chunk is not in the future.
+            live = src_idx <= axis_idx if causal else jnp.bool_(True)
+
+            def do(carry_in):
+                m_acc, l_acc, o_acc = carry_in
+                mask = jnp.where(
+                    jnp.logical_and(causal, src_idx == axis_idx), tri, jnp.ones_like(tri)
+                )
+                m_c, l_c, o_c = _chunk_attn(qc, kc, vc, scale, mask)
+                m_new = jnp.maximum(m_acc, m_c)
+                a_old = jnp.exp(m_acc - m_new)
+                a_new = jnp.exp(m_c - m_new)
+                return (
+                    m_new,
+                    l_acc * a_old + l_c * a_new,
+                    o_acc * a_old + o_c * a_new,
+                )
+
+            m_acc, l_acc, o_acc = jax.lax.cond(
+                live, do, lambda c: c, (m_acc, l_acc, o_acc)
+            )
+            # Rotate K/V to the next device; the collective permute rides ICI.
+            perm = [(i, (i + 1) % n) for i in range(n)]
+            kc = jax.lax.ppermute(kc, axis, perm)
+            vc = jax.lax.ppermute(vc, axis, perm)
+            return kc, vc, m_acc, l_acc, o_acc
+
+        # pcast-to-varying: accumulators are per-shard values (device-varying
+        # over the ring axis), matching branch outputs under the VMA check.
+        m0 = jax.lax.pcast(jnp.full((b, h, sq, 1), NEG_INF, jnp.float32), axis, to="varying")
+        l0 = jax.lax.pcast(jnp.zeros((b, h, sq, 1), jnp.float32), axis, to="varying")
+        o0 = jax.lax.pcast(jnp.zeros((b, h, sq, d), jnp.float32), axis, to="varying")
+        _, _, _, l_f, o_f = jax.lax.fori_loop(0, n, step, (kc, vc, m0, l0, o0))
+        l_f = jnp.where(l_f == 0.0, 1.0, l_f)
+        out = (o_f / l_f).astype(qc.dtype)  # [b,h,sq,d]
+        return out.transpose(0, 2, 1, 3)
+
+    spec = P(None, axis, None, None)
+    return shard_map(
+        local, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
+    )(q, k, v)

@@ -1,0 +1,92 @@
+"""Partition specs for the model zoo.
+
+Megatron-style tensor parallelism over the "model" axis:
+  - wq / w_gate_up: column-parallel (output features sharded)
+  - wo / w_down:    row-parallel (input features sharded)
+  - embed:          vocab-sharded (logit matmul reduces over model axis)
+  - norms:          replicated
+KV projections are sharded only when n_kv_heads divides the TP degree —
+with MQA (Gemma-2B, n_kv_heads=1) KV is replicated, the standard layout,
+so decode all-gathers ride ICI only for Q/O. wkv's output columns pack
+heads outermost ([hkv, 2, hd] blocks, transformer._layer_body), so each TP
+shard of the flat dim holds whole (k, v) head pairs — never K on one half
+of the group and V on the other.
+
+GSPMD inserts the collectives; we only annotate. Specs are pytrees shaped
+exactly like the params pytree from models.init_params.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models.transformer import TransformerConfig
+
+
+def param_specs(cfg: TransformerConfig, mesh: Mesh, *, model_axis: str = "model") -> dict:
+    tp = mesh.shape.get(model_axis, 1)
+    shard_kv = cfg.n_kv_heads % tp == 0 if tp > 1 else True
+    m = model_axis if tp > 1 else None
+    kv = m if shard_kv else None
+    return {
+        "embed": P(m, None),
+        "final_norm": P(None),
+        "layers": {
+            "attn_norm": P(None, None),
+            "wq": P(None, None, m),
+            "wkv": P(None, None, kv),
+            "wo": P(None, m, None),
+            "mlp_norm": P(None, None),
+            "w_gate_up": P(None, None, m),
+            "w_down": P(None, m, None),
+        },
+    }
+
+
+def mlp_param_specs(params: dict, mesh: Mesh, *, model_axis: str = "model") -> dict:
+    """Specs for models.mlp params: alternating column/row parallel (w0
+    column, w1 row, …); biases follow their weight's output sharding."""
+    tp = mesh.shape.get(model_axis, 1)
+    out = {}
+    for name in params:
+        idx = int(name[1:])
+        if tp <= 1:
+            out[name] = P() if name.startswith("b") else P(None, None)
+        elif name.startswith("w"):
+            out[name] = P(None, model_axis) if idx % 2 == 0 else P(model_axis, None)
+        else:
+            out[name] = P(model_axis) if idx % 2 == 0 else P(None)
+    return out
+
+
+def batch_spec(mesh: Mesh, *, data_axis: str = "data") -> P:
+    return P(data_axis if mesh.shape.get(data_axis, 1) > 1 else None)
+
+
+def shard_params(params: Any, mesh: Mesh, specs: Any) -> Any:
+    """device_put every leaf with its NamedSharding (committed, so later jit
+    calls respect the placement without in_shardings plumbing)."""
+    return jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+        params,
+        specs,
+        is_leaf=lambda x: x is None,
+    )
+
+
+def with_shardings(mesh: Mesh, fn, in_specs=None, out_specs=None, **jit_kw):
+    """jit fn with NamedSharding-resolved in/out specs (None = infer)."""
+
+    def resolve(tree):
+        if tree is None:
+            return None
+        return jax.tree.map(
+            lambda s: NamedSharding(mesh, s) if isinstance(s, P) else s,
+            tree,
+            is_leaf=lambda x: isinstance(x, P),
+        )
+
+    return jax.jit(fn, in_shardings=resolve(in_specs), out_shardings=resolve(out_specs), **jit_kw)

@@ -1,0 +1,78 @@
+"""Sharded training step for the model zoo.
+
+Serving is the north star (BASELINE.json), but the framework ships a real
+multi-chip train step: causal-LM cross-entropy, optax optimizer, params
+sharded by parallel.sharding's TP rules, batch sharded over "data". GSPMD
+derives the gradient psum over "data" and the TP collectives over "model"
+from the committed input shardings — no hand-written collectives.
+
+The driver's dryrun_multichip (__graft_entry__.py) compiles and runs this
+exact step on an N-virtual-device mesh.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from ..models.transformer import TransformerConfig, transformer_forward
+from .sharding import batch_spec, param_specs, shard_params
+
+
+def lm_loss(params: dict, cfg: TransformerConfig, tokens: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
+    """Next-token cross entropy over valid positions. tokens [b,s], mask [b,s]."""
+    b, s = tokens.shape
+    positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s))
+    logits, _ = transformer_forward(params, cfg, tokens, positions, kv_mask=mask)
+    targets = tokens[:, 1:]
+    logp = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32), axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    w = mask[:, 1:].astype(jnp.float32)
+    return jnp.sum(nll * w) / jnp.maximum(jnp.sum(w), 1.0)
+
+
+def make_train_step(
+    cfg: TransformerConfig,
+    mesh,
+    *,
+    optimizer: optax.GradientTransformation | None = None,
+    learning_rate: float = 3e-4,
+) -> tuple[Callable, Callable, Callable]:
+    """Returns (shard_fn, init_opt_fn, step_fn).
+
+    shard_fn(params)            -> params placed per TP specs
+    init_opt_fn(params)         -> opt_state (sharding inherited from params)
+    step_fn(params, opt_state, tokens, mask) -> (params, opt_state, loss)
+
+    Inputs carry committed shardings (device_put), so a bare jit suffices —
+    XLA propagates and inserts collectives. Data must be placed with
+    batch_spec(mesh) by the caller (parallel.shard_params or device_put).
+    """
+    opt = optimizer or optax.adamw(learning_rate)
+    specs = param_specs(cfg, mesh)
+
+    def shard_fn(params):
+        return shard_params(params, mesh, specs)
+
+    @jax.jit
+    def init_opt_fn(params):
+        return opt.init(params)
+
+    @jax.jit
+    def step_fn(params, opt_state, tokens, mask):
+        loss, grads = jax.value_and_grad(lm_loss)(params, cfg, tokens, mask)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return shard_fn, init_opt_fn, step_fn
+
+
+def place_batch(batch: Any, mesh) -> Any:
+    from jax.sharding import NamedSharding
+
+    spec = batch_spec(mesh)
+    return jax.tree.map(lambda x: jax.device_put(x, NamedSharding(mesh, spec)), batch)

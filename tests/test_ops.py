@@ -1,0 +1,103 @@
+"""Ops tests: flash kernel (interpret mode) and decode attention against the
+XLA reference — the test-oracle pattern the reference repo uses for its SQL
+mocks (SURVEY.md §4: seams tested against a stand-in implementation)."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from gofr_tpu.ops import (
+    apply_rope,
+    decode_attention,
+    flash_attention,
+    mha_reference,
+    multi_head_attention,
+    rms_norm,
+)
+
+
+def _qkv(b=2, sq=256, sk=256, hq=4, hkv=2, d=128, dtype=jnp.float32, seed=0):
+    ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+    q = jax.random.normal(ks[0], (b, sq, hq, d), dtype)
+    k = jax.random.normal(ks[1], (b, sk, hkv, d), dtype)
+    v = jax.random.normal(ks[2], (b, sk, hkv, d), dtype)
+    return q, k, v
+
+
+class TestFlashAttention:
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_matches_reference(self, causal):
+        q, k, v = _qkv()
+        ref = mha_reference(q, k, v, causal=causal)
+        out = flash_attention(q, k, v, causal=causal, interpret=True)
+        assert jnp.abs(ref - out).max() < 2e-5
+
+    def test_gqa_group_indexing(self):
+        # 8 query heads on 2 kv heads: head h reads kv group h // 4
+        q, k, v = _qkv(hq=8, hkv=2, seed=3)
+        ref = mha_reference(q, k, v, causal=True)
+        out = flash_attention(q, k, v, causal=True, interpret=True)
+        assert jnp.abs(ref - out).max() < 2e-5
+
+    def test_logit_cap(self):
+        q, k, v = _qkv(seed=5)
+        ref = mha_reference(q, k, v, causal=True, logit_cap=50.0)
+        out = flash_attention(q, k, v, causal=True, logit_cap=50.0, interpret=True)
+        assert jnp.abs(ref - out).max() < 2e-5
+
+    def test_rejects_untileable(self):
+        q, k, v = _qkv(sq=100, sk=100)
+        with pytest.raises(ValueError):
+            flash_attention(q, k, v, interpret=True)
+
+    def test_dispatcher_falls_back_on_cpu(self):
+        # On CPU backend the dispatcher must route to the reference path.
+        q, k, v = _qkv(b=1, sq=128, sk=128)
+        out = multi_head_attention(q, k, v, causal=True)
+        ref = mha_reference(q, k, v, causal=True)
+        assert jnp.abs(ref - out).max() < 1e-6
+
+
+class TestDecodeAttention:
+    def test_matches_masked_reference(self):
+        b, max_len, hq, hkv, d = 2, 32, 4, 2, 16
+        q, k, v = _qkv(b=b, sq=1, sk=max_len, hq=hq, hkv=hkv, d=d, seed=7)
+        lengths = jnp.array([5, 32], jnp.int32)
+        out = decode_attention(q, k, v, lengths)
+        kv_mask = jnp.arange(max_len)[None, :] < lengths[:, None]
+        ref = mha_reference(q, k, v, causal=False, kv_mask=kv_mask)
+        assert jnp.abs(ref - out).max() < 1e-6
+
+
+class TestRope:
+    def test_position_zero_is_identity(self):
+        x = jax.random.normal(jax.random.PRNGKey(0), (1, 1, 2, 8))
+        pos = jnp.zeros((1, 1), jnp.int32)
+        assert jnp.allclose(apply_rope(x, pos), x, atol=1e-6)
+
+    def test_relative_property(self):
+        # <rope(q, m), rope(k, n)> depends only on m - n
+        d = 16
+        q = jax.random.normal(jax.random.PRNGKey(1), (1, 1, 1, d))
+        k = jax.random.normal(jax.random.PRNGKey(2), (1, 1, 1, d))
+
+        def dot_at(m, n):
+            qm = apply_rope(q, jnp.array([[m]], jnp.int32))
+            kn = apply_rope(k, jnp.array([[n]], jnp.int32))
+            return float(jnp.sum(qm * kn))
+
+        assert abs(dot_at(5, 3) - dot_at(12, 10)) < 1e-4
+
+
+class TestRMSNorm:
+    def test_unit_rms_and_scale(self):
+        x = jax.random.normal(jax.random.PRNGKey(0), (4, 64))
+        out = rms_norm(x, jnp.zeros(64))
+        rms = jnp.sqrt(jnp.mean(out**2, axis=-1))
+        assert jnp.allclose(rms, 1.0, atol=1e-3)
+        out2 = rms_norm(x, jnp.ones(64))  # (1 + 1) doubles
+        assert jnp.allclose(out2, 2 * out, atol=1e-5)
+
+    def test_bf16_stays_bf16(self):
+        x = jax.random.normal(jax.random.PRNGKey(0), (4, 64), jnp.bfloat16)
+        assert rms_norm(x, jnp.zeros(64, jnp.bfloat16)).dtype == jnp.bfloat16
