@@ -1,0 +1,112 @@
+"""Benchmark harness. Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Measures end-to-end serving throughput of the MNIST-class MLP through the
+framework's TPU datasource — dynamic batcher, padding, scatter — i.e.
+BASELINE.json config 2 minus the HTTP socket (config 1's socket parity is
+benchmarked separately in examples/). The reference publishes no numbers
+(SURVEY.md §6), so vs_baseline is the ratio against the north-star floor of
+1,000 QPS/chip (BASELINE.json).
+
+Run on the real chip: python bench.py        (driver does this)
+CPU smoke:            JAX_PLATFORMS=cpu python bench.py --requests 200
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--requests", type=int, default=4096)
+    ap.add_argument("--concurrency", type=int, default=512)
+    ap.add_argument("--max-batch", type=int, default=64)
+    ap.add_argument("--max-inflight", type=int, default=32)
+    ap.add_argument("--max-delay-ms", type=float, default=1.0)
+    args = ap.parse_args()
+
+    import os
+
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        # The image's platform plugin overrides the env var; force it.
+        jax.config.update("jax_platforms", "cpu")
+
+    from gofr_tpu.datasource.tpu import TPURuntime
+    from gofr_tpu.logging import new_logger
+    from gofr_tpu.metrics import new_metrics_manager
+    from gofr_tpu.models import MLPConfig, mlp_forward, mlp_init
+
+    metrics = new_metrics_manager()
+    rt = TPURuntime(None, new_logger(level_name="ERROR"), metrics)
+    cfg = MLPConfig()  # 784 -> 512 -> 256 -> 10, bf16
+    params = mlp_init(jax.random.PRNGKey(0), cfg)
+    rt.register_model(
+        "mnist",
+        lambda p, x: mlp_forward(p, x),
+        params,
+        example_args=(np.zeros(cfg.in_dim, np.float32),),
+        max_batch=args.max_batch,
+        max_delay_ms=args.max_delay_ms,
+        max_inflight=args.max_inflight,
+        warmup_buckets=(1, args.max_batch // 4, args.max_batch),
+    )
+
+    rng = np.random.default_rng(0)
+    xs = rng.normal(size=(args.requests, cfg.in_dim)).astype(np.float32)
+    latencies: list[float] = []
+
+    async def one(sem, x):
+        async with sem:
+            t0 = time.perf_counter()
+            out = await rt.infer_async("mnist", x)
+            latencies.append(time.perf_counter() - t0)
+            return out
+
+    async def drive():
+        sem = asyncio.Semaphore(args.concurrency)
+        t0 = time.perf_counter()
+        outs = await asyncio.gather(*[one(sem, x) for x in xs])
+        wall = time.perf_counter() - t0
+        return outs, wall
+
+    # warm pass (fills executable cache for every bucket actually hit)
+    asyncio.run(drive())
+    latencies.clear()
+    outs, wall = asyncio.run(drive())
+    assert len(outs) == args.requests and outs[0].shape == (cfg.out_dim,)
+
+    qps = args.requests / wall
+    lat = np.array(sorted(latencies))
+    p50 = float(lat[int(0.50 * len(lat))]) * 1e3
+    p99 = float(lat[int(0.99 * len(lat))]) * 1e3
+    rt.close()
+
+    print(
+        json.dumps(
+            {
+                "metric": "mlp_serving_qps_per_chip",
+                "value": round(qps, 1),
+                "unit": "req/s",
+                "vs_baseline": round(qps / 1000.0, 3),
+                "detail": {
+                    "p50_ms": round(p50, 3),
+                    "p99_ms": round(p99, 3),
+                    "requests": args.requests,
+                    "platform": rt.platform,
+                    "device": rt.devices[0].device_kind if rt.devices else None,
+                },
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

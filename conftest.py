@@ -14,4 +14,8 @@ if "xla_force_host_platform_device_count" not in flags:
 
 import jax  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
+# Force CPU unless a developer explicitly chose a backend. "axon" is the
+# image's baked-in default (the real TPU tunnel), not a user choice — tests
+# must not burn the chip, so it is overridden too.
+if os.environ.get("JAX_PLATFORMS") in (None, "", "axon"):
+    jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,57 @@
+"""examples/tpu-mnist: the minimum end-to-end TPU serving slice
+(SURVEY.md §7.4, BASELINE.json config 2) — a stock new() app serving MLP
+inference through ctx.tpu() with dynamic batching.
+
+POST /infer  {"image": [784 floats]}  -> {"digit": d, "logits": [...]}
+GET  /model  -> registry + device health
+"""
+
+import sys
+
+sys.path.insert(0, "../..")  # run from examples/tpu-mnist: python main.py
+
+import numpy as np
+
+import gofr_tpu
+
+
+def register_model(app):
+    import jax
+
+    from gofr_tpu.models import MLPConfig, mlp_forward, mlp_init
+
+    cfg = MLPConfig()
+    params = mlp_init(jax.random.PRNGKey(0), cfg)
+    app.container.tpu().register_model(
+        "mnist",
+        lambda p, x: mlp_forward(p, x),
+        params,
+        example_args=(np.zeros(cfg.in_dim, np.float32),),
+    )
+    return cfg
+
+
+async def infer(ctx):
+    body = ctx.bind()
+    image = body.get("image") if isinstance(body, dict) else None
+    if image is None or len(image) != 784:
+        raise gofr_tpu.ErrorInvalidParam("image (need 784 floats)")
+    x = np.asarray(image, np.float32)
+    logits = await ctx.tpu().infer_async("mnist", x)
+    return {"digit": int(np.argmax(logits)), "logits": np.asarray(logits).tolist()}
+
+
+def model_info(ctx):
+    return ctx.tpu().health_check()
+
+
+def main():
+    app = gofr_tpu.new()
+    register_model(app)
+    app.post("/infer", infer)
+    app.get("/model", model_info)
+    app.run()
+
+
+if __name__ == "__main__":
+    main()

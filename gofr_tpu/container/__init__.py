@@ -51,6 +51,16 @@ class Container:
         c.app_name = config.get_or_default("APP_NAME", "gofr-tpu-app")
         c.app_version = config.get_or_default("APP_VERSION", "dev")
 
+        # TPU_PLATFORM=cpu|tpu pins the jax backend. Applied here — before
+        # any user code can touch jax — because backend choice is global and
+        # first-touch-wins (the runtime re-checks, but by then user model
+        # init may already have initialized the wrong platform).
+        platform = config.get("TPU_PLATFORM")
+        if platform:
+            from ..utils import pin_jax_platform
+
+            pin_jax_platform(platform, c.logger)
+
         c.logger = RemoteLevelLogger(
             gl.level_from_string(config.get("LOG_LEVEL")),
             config.get("REMOTE_LOG_URL") or None,

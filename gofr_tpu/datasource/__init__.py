@@ -1,0 +1,28 @@
+"""gofr_tpu.datasource — datasource seams wired into the Container.
+
+Parity: reference pkg/gofr/datasource/ — Health status consts
+(health.go:3-12), ErrorDB with 500 status (errors.go:10-34), the Logger
+seam. The TPU runtime is a first-class datasource alongside Redis/SQL
+(BASELINE.json north star: "ctx.TPU() as a datasource").
+"""
+
+from __future__ import annotations
+
+STATUS_UP = "UP"
+STATUS_DOWN = "DOWN"
+
+
+class ErrorDB(Exception):
+    """Datasource failure: maps to HTTP 500 (reference errors.go:10-34)."""
+
+    def __init__(self, message: str, cause: Exception | None = None):
+        super().__init__(message)
+        self.message = message
+        self.cause = cause
+
+    def status_code(self) -> int:
+        return 500
+
+
+def health(status: str, **details) -> dict:
+    return {"status": status, "details": details}

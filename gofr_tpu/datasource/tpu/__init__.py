@@ -1,0 +1,471 @@
+"""The TPU datasource: model registry + executable cache + dynamic batching.
+
+This is the build's `ctx.TPU()` (BASELINE.json north_star) — the TPU as a
+datasource with the same shape the reference gives SQL/Redis (SURVEY.md
+§2.4): constructor wired by the Container, per-call query-log + latency
+histogram (analogue of reference db.go:47-58), health check with device
+stats (analogue of sql/health.go:27-65), test seam via MockTPU.
+
+Architecture:
+- **Model registry.** `register_model(name, apply_fn, params)` device-puts
+  params (optionally sharded over a mesh), jits apply_fn, and warms the
+  executable cache per batch bucket so serving never eats a compile.
+- **Dynamic batcher.** One per model. Handlers await `infer_async`; a
+  collector thread coalesces up to TPU_BATCH_MAX_SIZE requests or
+  TPU_BATCH_MAX_DELAY_MS (env knobs, precedent: reference KAFKA_BATCH_*
+  container.go:107-109), pads the batch to a power-of-two bucket (one
+  compiled executable per bucket), runs ONE device execution, and scatters
+  per-request outputs back to the awaiting futures. This replaces the
+  reference's goroutine-per-request-does-all hot loop (handler.go:58-63)
+  with request-awaits-batch (SURVEY.md §7.5).
+- **Cancellation.** A request whose future was cancelled (client timeout)
+  is dropped at scatter time; the batch itself always completes — detaching
+  one request never kills the batch (SURVEY.md §7 hard part 2).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+from .. import STATUS_DOWN, STATUS_UP, health
+
+__all__ = ["TPURuntime", "Batcher", "MockTPU"]
+
+
+def _next_pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p *= 2
+    return p
+
+
+@dataclass
+class _Pending:
+    args: tuple  # single-example pytree args (no batch dim)
+    future: Any  # concurrent.futures.Future
+    enqueued: float = field(default_factory=time.perf_counter)
+
+
+class Batcher:
+    """Per-model dynamic batching queue, pipelined.
+
+    Requests are single examples (leaves WITHOUT the batch axis); the
+    collector stacks them, pads the batch dim to the next power of two
+    (static shapes -> one XLA executable per bucket), and dispatches ONE
+    device execution. Dispatch is asynchronous (XLA's launch model): the
+    collector immediately returns to assembling the next wave while a pool
+    of completion workers blocks on device->host readback and scatters rows
+    to the per-request futures. Waves therefore overlap — device compute,
+    host readback, and batch assembly pipeline instead of serializing,
+    which is what sustains QPS when the host<->device link has latency.
+    """
+
+    def __init__(
+        self,
+        name: str,
+        run_batch: Callable[[tuple, int], Any],  # (stacked_args, true_n) -> stacked_out (device, unfetched)
+        *,
+        max_batch: int = 64,
+        max_delay_ms: float = 2.0,
+        max_inflight: int = 8,
+        metrics=None,
+        logger=None,
+    ):
+        import concurrent.futures
+
+        self.name = name
+        self.run_batch = run_batch
+        self.max_batch = max_batch
+        self.max_delay = max_delay_ms / 1000.0
+        self.metrics = metrics
+        self.logger = logger
+        self.q: queue.Queue[_Pending | None] = queue.Queue()
+        self._inflight = threading.Semaphore(max_inflight)
+        self._completion = concurrent.futures.ThreadPoolExecutor(
+            max_workers=max_inflight, thread_name_prefix=f"tpu-complete-{name}"
+        )
+        self._thread = threading.Thread(
+            target=self._loop, name=f"tpu-batcher-{name}", daemon=True
+        )
+        self._closed = False
+        self._thread.start()
+
+    def submit(self, args: tuple) -> Any:
+        import concurrent.futures
+
+        if self._closed:
+            raise RuntimeError(f"batcher {self.name} is closed")
+        fut = concurrent.futures.Future()
+        self.q.put(_Pending(args=args, future=fut))
+        return fut
+
+    def _collect(self) -> list[_Pending]:
+        """Block for the first request, then linger up to max_delay (or until
+        max_batch) for co-travellers — the latency/throughput trade knob."""
+        first = self.q.get()
+        if first is None:
+            return []
+        batch = [first]
+        deadline = time.perf_counter() + self.max_delay
+        while len(batch) < self.max_batch:
+            remaining = deadline - time.perf_counter()
+            if remaining <= 0:
+                break
+            try:
+                item = self.q.get(timeout=remaining)
+            except queue.Empty:
+                break
+            if item is None:
+                self._closed = True
+                break
+            batch.append(item)
+        return batch
+
+    def _loop(self) -> None:
+        while True:
+            batch = self._collect()
+            if not batch:
+                break
+            self._dispatch(batch)
+            if self._closed:
+                break
+        # Drain anything that raced past close(): a submit() that read
+        # _closed as False but enqueued behind the shutdown sentinel must
+        # get an error, not hang its caller forever.
+        while True:
+            try:
+                item = self.q.get_nowait()
+            except queue.Empty:
+                break
+            if item is not None:
+                self._resolve(item, error=RuntimeError(f"batcher {self.name} is closed"))
+        self._completion.shutdown(wait=True)
+
+    def _dispatch(self, batch: list[_Pending]) -> None:
+        """Collector side: stack, launch on device, hand off to completion.
+        Bounded by max_inflight so waves can't pile up unboundedly."""
+        import jax
+        import numpy as np
+
+        n = len(batch)
+        t0 = time.perf_counter()
+        if self.metrics is not None:
+            self.metrics.record_histogram("app_tpu_batch_size", float(n), model=self.name)
+            for p in batch:
+                self.metrics.record_histogram(
+                    "app_tpu_queue_wait", t0 - p.enqueued, model=self.name
+                )
+        self._inflight.acquire()
+        try:
+            bucket = _next_pow2(n)
+            examples = [p.args for p in batch]
+            # pad with copies of the last example up to the bucket size
+            examples += [batch[-1].args] * (bucket - n)
+            stacked = jax.tree.map(lambda *xs: np.stack(xs), *examples)
+            out = self.run_batch(stacked, n)  # async dispatch, not fetched
+        except Exception as e:  # noqa: BLE001 — launch failure fans out now
+            self._inflight.release()
+            for p in batch:
+                self._resolve(p, error=e)
+            return
+        self._completion.submit(self._complete, batch, out, t0)
+
+    @staticmethod
+    def _resolve(pending: _Pending, result=None, error: Exception | None = None) -> None:
+        """Set a future's outcome, tolerating concurrent client cancellation
+        (cancelled() -> set_result races with the client's cancel; the
+        InvalidStateError must not leak and poison the rest of the batch)."""
+        try:
+            if error is not None:
+                pending.future.set_exception(error)
+            else:
+                pending.future.set_result(result)
+        except Exception:  # noqa: BLE001 — already cancelled/resolved: detach
+            pass
+
+    def _complete(self, batch: list[_Pending], out: Any, t0: float) -> None:
+        """Completion side: block on device->host readback, scatter rows."""
+        import jax
+        import numpy as np
+
+        try:
+            out = jax.tree.map(np.asarray, out)  # one readback per wave
+            for i, p in enumerate(batch):
+                self._resolve(p, result=jax.tree.map(lambda x: x[i], out))
+        except Exception as e:  # noqa: BLE001 — batch failure fans out to callers
+            for p in batch:
+                self._resolve(p, error=e)
+        finally:
+            self._inflight.release()
+        if self.metrics is not None:
+            self.metrics.record_histogram(
+                "app_tpu_stats", time.perf_counter() - t0, model=self.name, op="batch"
+            )
+        if self.logger is not None:
+            self.logger.debug(
+                f"TPU batch model={self.name} n={len(batch)} took "
+                f"{(time.perf_counter() - t0) * 1e3:.2f}ms"
+            )
+
+    def close(self) -> None:
+        if not self._closed:
+            self._closed = True
+            self.q.put(None)
+            self._thread.join(timeout=10)
+
+
+class _Model:
+    def __init__(self, name: str, jitted, params, batcher: Batcher | None, meta: dict):
+        self.name = name
+        self.jitted = jitted
+        self.params = params
+        self.batcher = batcher
+        self.meta = meta
+
+
+class TPURuntime:
+    """`ctx.tpu()` — constructed lazily by the Container (container seam:
+    gofr_tpu/container/__init__.py Container.tpu)."""
+
+    def __init__(self, config=None, logger=None, metrics=None):
+        import jax
+
+        self.logger = logger
+        self.metrics = metrics
+        self.config = config
+        get = (lambda k, d: config.get_or_default(k, d)) if config is not None else (lambda k, d: d)
+        # TPU_PLATFORM=cpu|tpu pins the jax backend before first device touch
+        # (needed where a platform plugin overrides JAX_PLATFORMS; also the
+        # dev/CI story: run the same app on the CPU backend). Normally done
+        # by Container.create; repeated for standalone runtimes.
+        from ...utils import pin_jax_platform
+
+        pin_jax_platform(get("TPU_PLATFORM", ""), logger)
+        self.default_max_batch = int(get("TPU_BATCH_MAX_SIZE", "64"))
+        self.default_max_delay_ms = float(get("TPU_BATCH_MAX_DELAY_MS", "2"))
+        self.default_max_inflight = int(get("TPU_BATCH_MAX_INFLIGHT", "8"))
+        self._models: dict[str, _Model] = {}
+        self._lock = threading.Lock()
+        if metrics is not None:
+            # Idempotent (Manager._register returns the existing instrument):
+            # normally done by the Container, repeated here so a standalone
+            # runtime still records its stats.
+            from ...metrics import TPU_BUCKETS
+
+            metrics.new_histogram("app_tpu_stats", "tpu execute time s", TPU_BUCKETS)
+            metrics.new_histogram(
+                "app_tpu_batch_size", "dynamic batch sizes",
+                (1, 2, 4, 8, 16, 32, 64, 128, 256),
+            )
+            metrics.new_histogram("app_tpu_queue_wait", "batch queue wait s", TPU_BUCKETS)
+        self.devices = jax.devices()
+        self.platform = self.devices[0].platform if self.devices else "none"
+        if logger is not None:
+            logger.info(
+                f"TPU runtime: {len(self.devices)} x {self.devices[0].device_kind}"
+                if self.devices
+                else "TPU runtime: no devices"
+            )
+
+    # -- registry ---------------------------------------------------------
+    def register_model(
+        self,
+        name: str,
+        apply_fn: Callable,  # (params, *batched_args) -> batched_out
+        params: Any,
+        *,
+        example_args: tuple | None = None,  # single example (no batch dim)
+        max_batch: int | None = None,
+        max_delay_ms: float | None = None,
+        max_inflight: int | None = None,
+        mesh=None,
+        param_specs: Any = None,
+        donate_params: bool = False,
+        warmup_buckets: tuple[int, ...] | None = None,
+    ) -> None:
+        """Move params to device (sharded if mesh+specs given), jit apply_fn,
+        optionally pre-compile batch buckets, and start the batcher."""
+        import jax
+
+        if mesh is not None and param_specs is not None:
+            from ...parallel.sharding import shard_params
+
+            params = shard_params(params, mesh, param_specs)
+        else:
+            params = jax.device_put(params)
+
+        jitted = jax.jit(apply_fn)
+        max_batch = max_batch or self.default_max_batch
+        max_delay_ms = (
+            max_delay_ms if max_delay_ms is not None else self.default_max_delay_ms
+        )
+
+        def run_batch(stacked_args, true_n: int):
+            # Launch only — XLA dispatch is async; the batcher's completion
+            # workers block on readback so waves pipeline.
+            return jitted(params, *stacked_args)
+
+        batcher = Batcher(
+            name,
+            run_batch,
+            max_batch=max_batch,
+            max_delay_ms=max_delay_ms,
+            max_inflight=max_inflight or self.default_max_inflight,
+            metrics=self.metrics,
+            logger=self.logger,
+        )
+        model = _Model(
+            name,
+            jitted,
+            params,
+            batcher,
+            meta={
+                "max_batch": max_batch,
+                "max_delay_ms": max_delay_ms,
+                "params_bytes": sum(
+                    x.size * x.dtype.itemsize for x in jax.tree.leaves(params)
+                ),
+            },
+        )
+        with self._lock:
+            if name in self._models:
+                self._models[name].batcher.close()
+            self._models[name] = model
+
+        if example_args is not None:
+            import numpy as np
+
+            if warmup_buckets is None:
+                # All power-of-two buckets the batcher can form, so serving
+                # never eats an XLA compile mid-traffic.
+                warmup_buckets = tuple(
+                    1 << i for i in range((max_batch).bit_length())
+                    if (1 << i) <= max_batch
+                )
+            for bucket in warmup_buckets:
+                stacked = jax.tree.map(
+                    lambda x: np.stack([np.asarray(x)] * bucket), example_args
+                )
+                jax.block_until_ready(jitted(params, *stacked))
+            if self.logger is not None:
+                self.logger.info(
+                    f"model '{name}' registered & warmed (buckets {warmup_buckets})"
+                )
+
+    def model(self, name: str) -> _Model:
+        try:
+            return self._models[name]
+        except KeyError:
+            raise KeyError(
+                f"model '{name}' not registered; known: {list(self._models)}"
+            ) from None
+
+    # -- inference --------------------------------------------------------
+    def infer(self, name: str, *batched_args) -> Any:
+        """Direct batched call (caller formed the batch). Sync, blocking."""
+        m = self.model(name)
+        t0 = time.perf_counter()
+        import jax
+
+        out = jax.block_until_ready(m.jitted(m.params, *batched_args))
+        if self.metrics is not None:
+            self.metrics.record_histogram(
+                "app_tpu_stats", time.perf_counter() - t0, model=name, op="execute"
+            )
+        return out
+
+    async def infer_async(self, name: str, *example_args) -> Any:
+        """Single-example call through the dynamic batcher. Awaitable."""
+        import asyncio
+
+        m = self.model(name)
+        fut = m.batcher.submit(example_args)
+        return await asyncio.wrap_future(fut)
+
+    def infer_one(self, name: str, *example_args, timeout: float | None = None) -> Any:
+        """Single-example call through the batcher, blocking (CLI/cron use)."""
+        m = self.model(name)
+        return m.batcher.submit(example_args).result(timeout=timeout)
+
+    # -- lifecycle hooks (App.serve/_stop_servers call these) --------------
+    async def start_batchers(self) -> None:
+        """Batchers are thread-backed and start at register_model; this hook
+        exists for the App lifecycle (and runtimes that defer startup)."""
+
+    async def stop_batchers(self) -> None:
+        for m in self._models.values():
+            m.batcher.close()
+
+    # -- health (analogue of reference sql/health.go:27-65) ---------------
+    def health_check(self) -> dict:
+        try:
+            details: dict[str, Any] = {
+                "platform": self.platform,
+                "device_count": len(self.devices),
+                "device_kind": self.devices[0].device_kind if self.devices else None,
+                "models": {
+                    n: dict(m.meta, queue_depth=m.batcher.q.qsize())
+                    for n, m in self._models.items()
+                },
+            }
+            stats = {}
+            try:
+                ms = self.devices[0].memory_stats()
+                if ms:
+                    stats = {
+                        "bytes_in_use": ms.get("bytes_in_use"),
+                        "bytes_limit": ms.get("bytes_limit"),
+                    }
+            except Exception:  # noqa: BLE001 — memory_stats unsupported on CPU
+                pass
+            details["memory"] = stats
+            return health(STATUS_UP, **details)
+        except Exception as e:  # noqa: BLE001
+            return health(STATUS_DOWN, error=str(e))
+
+    def close(self) -> None:
+        for m in self._models.values():
+            m.batcher.close()
+        self._models.clear()
+
+
+class MockTPU:
+    """Test seam: the analogue of the reference's MockDB/MockRedis
+    (container mock_container.go:19-32). Records calls, returns canned
+    outputs, no jax involved."""
+
+    def __init__(self, results: dict[str, Any] | None = None):
+        self.results = results or {}
+        self.calls: list[tuple[str, tuple]] = []
+
+    def register_model(self, name: str, *a, **k) -> None:
+        self.calls.append(("register_model", (name,)))
+        self.results.setdefault(name, None)
+
+    def infer(self, name: str, *args) -> Any:
+        self.calls.append(("infer", (name, *args)))
+        return self.results.get(name)
+
+    async def infer_async(self, name: str, *args) -> Any:
+        self.calls.append(("infer_async", (name, *args)))
+        return self.results.get(name)
+
+    def infer_one(self, name: str, *args, timeout=None) -> Any:
+        self.calls.append(("infer_one", (name, *args)))
+        return self.results.get(name)
+
+    def health_check(self) -> dict:
+        return health(STATUS_UP, platform="mock", device_count=0, models={})
+
+    async def start_batchers(self) -> None:
+        pass
+
+    async def stop_batchers(self) -> None:
+        pass
+
+    def close(self) -> None:
+        pass

@@ -119,7 +119,6 @@ def _layer_body(
     k_cache: jnp.ndarray | None,  # [b, max_len, hkv, hd] or None
     v_cache: jnp.ndarray | None,
     cache_length: jnp.ndarray | None,  # [b]
-    kv_mask: jnp.ndarray | None,
     decode: bool,
 ):
     b, s, d = x.shape
@@ -187,8 +186,7 @@ def transformer_forward(
             x, _ = xc
             x, nk, nv = _layer_body(
                 cfg, x, lp, positions,
-                k_cache=kc, v_cache=vc, cache_length=cache.length,
-                kv_mask=None, decode=True,
+                k_cache=kc, v_cache=vc, cache_length=cache.length, decode=True,
             )
             return (x, None), (nk, nv)
 
@@ -202,8 +200,7 @@ def transformer_forward(
             x, _ = xc
             x, nk, nv = _layer_body(
                 cfg, x, lp, positions,
-                k_cache=None, v_cache=None, cache_length=None,
-                kv_mask=kv_mask, decode=False,
+                k_cache=None, v_cache=None, cache_length=None, decode=False,
             )
             return (x, None), (nk, nv)
 

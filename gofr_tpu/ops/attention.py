@@ -224,9 +224,7 @@ def flash_attention(
             pltpu.VMEM((block_q, 128), jnp.float32),
             pltpu.VMEM((block_q, 128), jnp.float32),
             pltpu.VMEM((block_q, d), jnp.float32),
-        ]
-        if _HAS_PLTPU
-        else [],
+        ],
         interpret=interpret,
     )(qt, kt, vt)
     return out.transpose(0, 2, 1, 3)

@@ -11,6 +11,7 @@ from .mesh import make_mesh, mesh_shape_for
 from .ring import ring_attention
 from .sharding import (
     batch_spec,
+    mlp_param_specs,
     param_specs,
     shard_params,
     with_shardings,
@@ -21,6 +22,7 @@ __all__ = [
     "make_mesh",
     "mesh_shape_for",
     "param_specs",
+    "mlp_param_specs",
     "batch_spec",
     "shard_params",
     "with_shardings",

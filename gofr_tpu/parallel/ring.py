@@ -22,7 +22,7 @@ import jax.numpy as jnp
 from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
-NEG_INF = -2.3819763e38
+from ..ops.attention import NEG_INF
 
 
 def _chunk_attn(q, k, v, scale, mask):
@@ -58,17 +58,26 @@ def ring_attention(
         b, sq, h, d = qc.shape
         tri = jnp.tril(jnp.ones((sq, sq), bool))
 
-        def step(t, carry):
-            kc, vc, m_acc, l_acc, o_acc = carry
+        # pcast-to-varying: accumulators are per-shard values (device-varying
+        # over the ring axis), matching branch outputs under the VMA check.
+        m_acc = jax.lax.pcast(jnp.full((b, h, sq, 1), NEG_INF, jnp.float32), axis, to="varying")
+        l_acc = jax.lax.pcast(jnp.zeros((b, h, sq, 1), jnp.float32), axis, to="varying")
+        o_acc = jax.lax.pcast(jnp.zeros((b, h, sq, d), jnp.float32), axis, to="varying")
+
+        # Static unroll over the ring (n = mesh axis size, known at trace
+        # time): lets the diagonal mask be chosen statically and skips the
+        # pointless final rotation (n-1 ppermutes, not n).
+        for t in range(n):
             src_idx = (axis_idx - t) % n  # chunk owner at this rotation
             # Chunk-level causality: attend iff src chunk is not in the future.
             live = src_idx <= axis_idx if causal else jnp.bool_(True)
 
-            def do(carry_in):
+            def do(carry_in, kc=kc, vc=vc, t=t):
                 m_acc, l_acc, o_acc = carry_in
-                mask = jnp.where(
-                    jnp.logical_and(causal, src_idx == axis_idx), tri, jnp.ones_like(tri)
-                )
+                # Diagonal chunk (t == 0) needs the triangular mask; earlier
+                # chunks are fully visible (the cond already gated future
+                # chunks out), so no mask at all.
+                mask = tri if (causal and t == 0) else None
                 m_c, l_c, o_c = _chunk_attn(qc, kc, vc, scale, mask)
                 m_new = jnp.maximum(m_acc, m_c)
                 a_old = jnp.exp(m_acc - m_new)
@@ -82,20 +91,14 @@ def ring_attention(
             m_acc, l_acc, o_acc = jax.lax.cond(
                 live, do, lambda c: c, (m_acc, l_acc, o_acc)
             )
-            # Rotate K/V to the next device; the collective permute rides ICI.
-            perm = [(i, (i + 1) % n) for i in range(n)]
-            kc = jax.lax.ppermute(kc, axis, perm)
-            vc = jax.lax.ppermute(vc, axis, perm)
-            return kc, vc, m_acc, l_acc, o_acc
+            if t < n - 1:
+                # Rotate K/V to the next device; the permute rides ICI.
+                perm = [(i, (i + 1) % n) for i in range(n)]
+                kc = jax.lax.ppermute(kc, axis, perm)
+                vc = jax.lax.ppermute(vc, axis, perm)
 
-        # pcast-to-varying: accumulators are per-shard values (device-varying
-        # over the ring axis), matching branch outputs under the VMA check.
-        m0 = jax.lax.pcast(jnp.full((b, h, sq, 1), NEG_INF, jnp.float32), axis, to="varying")
-        l0 = jax.lax.pcast(jnp.zeros((b, h, sq, 1), jnp.float32), axis, to="varying")
-        o0 = jax.lax.pcast(jnp.zeros((b, h, sq, d), jnp.float32), axis, to="varying")
-        _, _, _, l_f, o_f = jax.lax.fori_loop(0, n, step, (kc, vc, m0, l0, o0))
-        l_f = jnp.where(l_f == 0.0, 1.0, l_f)
-        out = (o_f / l_f).astype(qc.dtype)  # [b,h,sq,d]
+        l_acc = jnp.where(l_acc == 0.0, 1.0, l_acc)
+        out = (o_acc / l_acc).astype(qc.dtype)  # [b,h,sq,d]
         return out.transpose(0, 2, 1, 3)
 
     spec = P(None, axis, None, None)

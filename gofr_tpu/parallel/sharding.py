@@ -5,7 +5,7 @@ Megatron-style tensor parallelism over the "model" axis:
   - wo / w_down:    row-parallel (input features sharded)
   - embed:          vocab-sharded (logit matmul reduces over model axis)
   - norms:          replicated
-KV projections are sharded only when n_kv_heads divides the TP degree —
+KV projections are sharded only when the TP degree divides n_kv_heads —
 with MQA (Gemma-2B, n_kv_heads=1) KV is replicated, the standard layout,
 so decode all-gathers ride ICI only for Q/O. wkv's output columns pack
 heads outermost ([hkv, 2, hd] blocks, transformer._layer_body), so each TP

@@ -1,0 +1,33 @@
+"""gofr_tpu.utils — small shared helpers."""
+
+from __future__ import annotations
+
+
+def pin_jax_platform(platform: str, logger=None) -> bool:
+    """Pin the jax backend (jax.config jax_platforms) and VERIFY it took.
+
+    jax.config.update silently no-ops once a backend is initialized, so the
+    only reliable failure signal is comparing jax.default_backend() after
+    the update. Returns True when the requested platform is active.
+    """
+    if not platform:
+        return True
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", platform)
+    except Exception as e:  # noqa: BLE001 — defensive; update may raise pre-0.9
+        if logger is not None:
+            logger.warn(f"TPU_PLATFORM={platform} not applied: {e}")
+        return False
+    active = jax.default_backend()
+    # jax_platforms may list fallbacks ("tpu,cpu"); accept any listed entry.
+    wanted = [p.strip() for p in platform.split(",") if p.strip()]
+    if active not in wanted and not (active == "tpu" and "axon" in wanted):
+        if logger is not None:
+            logger.warn(
+                f"TPU_PLATFORM={platform} ignored: jax already initialized "
+                f"on '{active}' (set it before any jax usage)"
+            )
+        return False
+    return True

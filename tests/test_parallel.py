@@ -5,13 +5,21 @@ import jax
 import jax.numpy as jnp
 import pytest
 
-from gofr_tpu.models import TransformerConfig, init_params, prefill
+from gofr_tpu.models import (
+    MLPConfig,
+    TransformerConfig,
+    init_params,
+    mlp_forward,
+    mlp_init,
+    prefill,
+)
 from gofr_tpu.ops import mha_reference
 from gofr_tpu.parallel import (
     lm_loss,
     make_mesh,
     make_train_step,
     mesh_shape_for,
+    mlp_param_specs,
     param_specs,
     place_batch,
     ring_attention,
@@ -60,6 +68,16 @@ class TestTensorParallel:
             sharded, toks, lens
         )
         assert jnp.abs(ref_logits - tp_logits).max() < 1e-3
+
+    def test_mlp_tp_matches_single_device(self):
+        cfg = MLPConfig(in_dim=16, hidden=(32, 64), out_dim=8, dtype=jnp.float32)
+        params = mlp_init(jax.random.PRNGKey(0), cfg)
+        x = jax.random.normal(jax.random.PRNGKey(1), (4, 16))
+        ref = mlp_forward(params, x)
+        mesh = make_mesh({"data": 1, "model": 8})
+        sharded = shard_params(params, mesh, mlp_param_specs(params, mesh))
+        out = jax.jit(mlp_forward)(sharded, x)
+        assert jnp.abs(ref - out).max() < 1e-4
 
     def test_mqa_kv_replicated(self):
         cfg = TransformerConfig.tiny()  # n_kv_heads=2, tp=8 -> replicate kv

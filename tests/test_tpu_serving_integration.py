@@ -1,0 +1,89 @@
+"""End-to-end TPU serving: boot a real app with a registered model, POST
+tensors over real HTTP, assert batched inference results — the full
+BASELINE.json config-2 slice (http-server + ctx.TPU() MLP endpoint)."""
+
+import concurrent.futures
+import json
+import urllib.request
+
+import jax
+import numpy as np
+import pytest
+
+import gofr_tpu
+from gofr_tpu.config import new_mock_config
+from gofr_tpu.models import MLPConfig, mlp_forward, mlp_init
+
+
+@pytest.fixture(scope="module")
+def served():
+    cfg = new_mock_config({
+        "APP_NAME": "tpu-test",
+        "HTTP_PORT": "0",
+        "METRICS_PORT": "0",
+        "TPU_BATCH_MAX_SIZE": "32",
+        "TPU_BATCH_MAX_DELAY_MS": "5",
+    })
+    app = gofr_tpu.new(config=cfg)
+    mcfg = MLPConfig(in_dim=16, hidden=(32,), out_dim=4, dtype=jax.numpy.float32)
+    params = mlp_init(jax.random.PRNGKey(0), mcfg)
+    app.container.tpu().register_model(
+        "m", lambda p, x: mlp_forward(p, x), params,
+        example_args=(np.zeros(16, np.float32),),
+    )
+
+    async def infer(ctx):
+        x = np.asarray(ctx.bind()["x"], np.float32)
+        logits = await ctx.tpu().infer_async("m", x)
+        return {"argmax": int(np.argmax(logits)), "logits": np.asarray(logits).tolist()}
+
+    app.post("/infer", infer)
+    app.get("/model", lambda ctx: ctx.tpu().health_check())
+    app.run_in_background()
+    base = f"http://127.0.0.1:{app.http_server.port}"
+    yield base, params, mcfg
+    app.shutdown()
+
+
+def _post(base, path, payload):
+    req = urllib.request.Request(
+        base + path, method="POST", data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=15) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+class TestTPUServing:
+    def test_single_inference_matches_model(self, served):
+        base, params, mcfg = served
+        x = np.random.default_rng(1).normal(size=16).astype(np.float32)
+        status, body = _post(base, "/infer", {"x": x.tolist()})
+        assert status == 201  # POST -> 201 (reference responder.go:54-61)
+        expect = mlp_forward(params, jax.numpy.asarray(x)[None])[0]
+        got = np.asarray(body["data"]["logits"])
+        assert np.abs(got - np.asarray(expect)).max() < 1e-4
+
+    def test_concurrent_requests_all_served_correctly(self, served):
+        """Many clients at once: the batcher must scatter the right rows to
+        the right requests (no cross-request leakage)."""
+        base, params, mcfg = served
+        rng = np.random.default_rng(2)
+        xs = rng.normal(size=(24, 16)).astype(np.float32)
+        expect = np.asarray(mlp_forward(params, jax.numpy.asarray(xs)))
+
+        def call(i):
+            return i, _post(base, "/infer", {"x": xs[i].tolist()})
+
+        with concurrent.futures.ThreadPoolExecutor(12) as ex:
+            for i, (status, body) in ex.map(call, range(24)):
+                assert status == 201  # POST -> 201 (reference responder.go:54-61)
+                got = np.asarray(body["data"]["logits"])
+                assert np.abs(got - expect[i]).max() < 1e-4, f"row {i} mismatch"
+
+    def test_model_health_endpoint(self, served):
+        base, *_ = served
+        with urllib.request.urlopen(base + "/model", timeout=10) as resp:
+            body = json.loads(resp.read())
+        assert body["data"]["status"] == "UP"
+        assert "m" in body["data"]["details"]["models"]
