@@ -1,0 +1,35 @@
+"""examples/grpc-server: framework-native JSON-over-gRPC handlers with the
+same Context shape as HTTP.
+
+Parity: reference examples/grpc-server/main.go:16 (RegisterHelloServer);
+generated-proto services register via app.register_service the same way.
+The streaming method is the token-streaming shape (BASELINE.json config 3).
+"""
+
+import sys
+
+sys.path.insert(0, "../..")
+
+import gofr_tpu
+
+
+def say_hello(ctx):
+    name = ctx.bind().get("name", "World")
+    return {"greeting": f"Hello {name}!"}
+
+
+def stream_squares(ctx):
+    n = int(ctx.bind().get("n", 5))
+    for i in range(n):
+        yield {"i": i, "square": i * i}
+
+
+def main():
+    app = gofr_tpu.new()
+    app.grpc_unary("Hello", "SayHello", say_hello)
+    app.grpc_server_stream("Hello", "Squares", stream_squares)
+    app.run()
+
+
+if __name__ == "__main__":
+    main()

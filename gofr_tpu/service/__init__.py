@@ -1,0 +1,363 @@
+"""Outbound HTTP service client.
+
+Parity: reference pkg/gofr/service/ — NewHTTPService(addr, logger, metrics,
+options...) (new.go:68-89), every verb funneling through one instrumented
+request path: span, traceparent injection, app_http_service_response
+histogram, structured log (new.go:135-195); decorator Options pattern
+(options.go:3-5); circuit breaker with open/closed states + background
+health probes (circuit_breaker.go:24-158); auth decorators (basic_auth.go,
+apikey_auth.go, oauth.go); custom default health endpoint
+(health_config.go:5-24); health feeding the container aggregate
+(health.go:18-49).
+
+Transport: urllib over a thread (stdlib; no aiohttp in this image). Async
+handlers await the a* methods; sync handlers call get/post/... directly.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import base64
+import json as jsonlib
+import threading
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Any, Callable
+
+from ..datasource import STATUS_DOWN, STATUS_UP, health
+
+__all__ = [
+    "HTTPService",
+    "new_http_service",
+    "Response",
+    "BasicAuth",
+    "APIKeyAuth",
+    "OAuth",
+    "CustomHeaders",
+    "HealthConfig",
+    "CircuitBreaker",
+    "CircuitOpenError",
+]
+
+
+class Response:
+    def __init__(self, status: int, headers: dict, body: bytes):
+        self.status_code = status
+        self.headers = headers
+        self.body = body
+
+    def json(self) -> Any:
+        return jsonlib.loads(self.body)
+
+    def text(self) -> str:
+        return self.body.decode("utf-8", "replace")
+
+
+class CircuitOpenError(Exception):
+    def __init__(self, address: str):
+        super().__init__(f"circuit breaker open for {address}")
+
+    def status_code(self) -> int:
+        return 503
+
+
+class HTTPService:
+    """Core client; options decorate it (options.go pattern: each option's
+    apply() mutates/wraps behavior)."""
+
+    def __init__(self, address: str, logger=None, metrics=None, tracer=None):
+        self.address = address.rstrip("/")
+        self.logger = logger
+        self.metrics = metrics
+        self.tracer = tracer
+        self.static_headers: dict[str, str] = {}
+        self.auth_header: Callable[[], dict[str, str]] | None = None
+        self.health_endpoint = ".well-known/alive"
+        self.circuit: CircuitBreaker | None = None
+
+    # -- request path (new.go:135-195) ------------------------------------
+    def _headers(self, headers: dict | None) -> dict:
+        out = dict(self.static_headers)
+        if self.auth_header is not None:
+            out.update(self.auth_header())
+        if headers:
+            out.update(headers)
+        # traceparent injection (new.go:158)
+        try:
+            from ..tracing import current_span
+
+            span = current_span()
+            if span is not None:
+                out.setdefault(
+                    "traceparent", f"00-{span.trace_id}-{span.span_id}-01"
+                )
+        except Exception:  # noqa: BLE001
+            pass
+        return out
+
+    def request(
+        self,
+        method: str,
+        path: str,
+        *,
+        params: dict | None = None,
+        json: Any = None,
+        body: bytes | None = None,
+        headers: dict | None = None,
+        timeout: float = 10.0,
+        _health_probe: bool = False,
+    ) -> Response:
+        if self.circuit is not None and not _health_probe:
+            self.circuit.precheck(self)
+        url = f"{self.address}/{path.lstrip('/')}"
+        if params:
+            url += "?" + urllib.parse.urlencode(params)
+        data = jsonlib.dumps(json).encode() if json is not None else body
+        hdrs = self._headers(headers)
+        if json is not None:
+            hdrs.setdefault("Content-Type", "application/json")
+        req = urllib.request.Request(url, method=method, data=data, headers=hdrs)
+        t0 = time.perf_counter()
+        status = 0
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as resp:
+                out = Response(resp.status, dict(resp.headers), resp.read())
+        except urllib.error.HTTPError as e:
+            out = Response(e.code, dict(e.headers), e.read())
+        except Exception:
+            if self.circuit is not None and not _health_probe:
+                self.circuit.record_failure(self)
+            self._observe(method, path, 0, t0)
+            raise
+        status = out.status_code
+        if self.circuit is not None and not _health_probe:
+            if status >= 500:
+                self.circuit.record_failure(self)
+            else:
+                self.circuit.record_success()
+        self._observe(method, path, status, t0)
+        return out
+
+    def _observe(self, method: str, path: str, status: int, t0: float) -> None:
+        dt = time.perf_counter() - t0
+        if self.metrics is not None:
+            self.metrics.record_histogram(
+                "app_http_service_response", dt,
+                path=path, method=method, status=str(status),
+            )
+        if self.logger is not None:
+            self.logger.debug(
+                {
+                    "type": "http-service", "method": method,
+                    "uri": f"{self.address}/{path.lstrip('/')}",
+                    "response_code": status,
+                    "response_time_us": round(dt * 1e6),
+                }
+            )
+
+    # -- verbs ------------------------------------------------------------
+    def get(self, path: str, **kw) -> Response:
+        return self.request("GET", path, **kw)
+
+    def post(self, path: str, **kw) -> Response:
+        return self.request("POST", path, **kw)
+
+    def put(self, path: str, **kw) -> Response:
+        return self.request("PUT", path, **kw)
+
+    def patch(self, path: str, **kw) -> Response:
+        return self.request("PATCH", path, **kw)
+
+    def delete(self, path: str, **kw) -> Response:
+        return self.request("DELETE", path, **kw)
+
+    # -- async facades ----------------------------------------------------
+    async def aget(self, path: str, **kw) -> Response:
+        return await asyncio.get_running_loop().run_in_executor(
+            None, lambda: self.get(path, **kw)
+        )
+
+    async def apost(self, path: str, **kw) -> Response:
+        return await asyncio.get_running_loop().run_in_executor(
+            None, lambda: self.post(path, **kw)
+        )
+
+    async def aput(self, path: str, **kw) -> Response:
+        return await asyncio.get_running_loop().run_in_executor(
+            None, lambda: self.put(path, **kw)
+        )
+
+    async def adelete(self, path: str, **kw) -> Response:
+        return await asyncio.get_running_loop().run_in_executor(
+            None, lambda: self.delete(path, **kw)
+        )
+
+    # -- health (service/health.go:18-49) ----------------------------------
+    def health_check_sync(self) -> dict:
+        try:
+            t0 = time.perf_counter()
+            resp = self.request("GET", self.health_endpoint, timeout=5.0, _health_probe=True)
+            ok = resp.status_code < 400
+            return health(
+                STATUS_UP if ok else STATUS_DOWN,
+                host=self.address,
+                status_code=resp.status_code,
+                latency_ms=round((time.perf_counter() - t0) * 1e3, 2),
+                **(
+                    {"circuit": self.circuit.state}
+                    if self.circuit is not None
+                    else {}
+                ),
+            )
+        except Exception as e:  # noqa: BLE001
+            return health(STATUS_DOWN, host=self.address, error=str(e))
+
+
+# -- options (decorator pattern, options.go) --------------------------------
+
+
+class BasicAuth:
+    def __init__(self, user: str, password: str):
+        self.user, self.password = user, password
+
+    def apply(self, svc: HTTPService) -> None:
+        token = base64.b64encode(f"{self.user}:{self.password}".encode()).decode()
+        svc.auth_header = lambda: {"Authorization": f"Basic {token}"}
+
+
+class APIKeyAuth:
+    def __init__(self, key: str):
+        self.key = key
+
+    def apply(self, svc: HTTPService) -> None:
+        svc.auth_header = lambda: {"X-API-KEY": self.key}
+
+
+class OAuth:
+    """Client-credentials flow (oauth.go:233-...): fetch + cache a bearer
+    token from token_url, refresh when expired."""
+
+    def __init__(self, client_id: str, client_secret: str, token_url: str, scopes: list[str] | None = None):
+        self.client_id = client_id
+        self.client_secret = client_secret
+        self.token_url = token_url
+        self.scopes = scopes or []
+        self._token: str | None = None
+        self._expiry = 0.0
+        self._lock = threading.Lock()
+
+    def _fetch(self) -> None:
+        data = urllib.parse.urlencode(
+            {
+                "grant_type": "client_credentials",
+                "client_id": self.client_id,
+                "client_secret": self.client_secret,
+                **({"scope": " ".join(self.scopes)} if self.scopes else {}),
+            }
+        ).encode()
+        req = urllib.request.Request(self.token_url, method="POST", data=data)
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            payload = jsonlib.loads(resp.read())
+        self._token = payload["access_token"]
+        self._expiry = time.time() + float(payload.get("expires_in", 3600)) - 30
+
+    def token(self) -> str:
+        with self._lock:
+            if self._token is None or time.time() >= self._expiry:
+                self._fetch()
+            assert self._token is not None
+            return self._token
+
+    def apply(self, svc: HTTPService) -> None:
+        svc.auth_header = lambda: {"Authorization": f"Bearer {self.token()}"}
+
+
+class CustomHeaders:
+    def __init__(self, headers: dict[str, str]):
+        self.headers = headers
+
+    def apply(self, svc: HTTPService) -> None:
+        svc.static_headers.update(self.headers)
+
+
+class HealthConfig:
+    def __init__(self, endpoint: str):
+        self.endpoint = endpoint
+
+    def apply(self, svc: HTTPService) -> None:
+        svc.health_endpoint = endpoint_strip(self.endpoint)
+
+
+def endpoint_strip(e: str) -> str:
+    return e.lstrip("/")
+
+
+class CircuitBreaker:
+    """Open after `threshold` consecutive 5xx/transport failures; while open,
+    requests fail fast with CircuitOpenError and a background thread probes
+    the health endpoint every `interval` seconds, closing on success
+    (circuit_breaker.go:24-158)."""
+
+    def __init__(self, threshold: int = 5, interval: float = 10.0):
+        self.threshold = threshold
+        self.interval = interval
+        self.failures = 0
+        self.state = "closed"
+        self._lock = threading.Lock()
+        self._probe_thread: threading.Thread | None = None
+
+    def apply(self, svc: HTTPService) -> None:
+        svc.circuit = self
+
+    def precheck(self, svc: HTTPService) -> None:
+        with self._lock:
+            if self.state == "open":
+                raise CircuitOpenError(svc.address)
+
+    def record_success(self) -> None:
+        with self._lock:
+            self.failures = 0
+            self.state = "closed"
+
+    def record_failure(self, svc: HTTPService) -> None:
+        with self._lock:
+            self.failures += 1
+            if self.failures >= self.threshold and self.state != "open":
+                self.state = "open"
+                self._start_probe(svc)
+
+    def _start_probe(self, svc: HTTPService) -> None:
+        if self._probe_thread is not None and self._probe_thread.is_alive():
+            return
+
+        def probe():
+            while True:
+                time.sleep(self.interval)
+                with self._lock:
+                    if self.state != "open":
+                        return
+                h = svc.health_check_sync()
+                if h["status"] == STATUS_UP:
+                    self.record_success()
+                    if svc.logger is not None:
+                        svc.logger.info(f"circuit closed for {svc.address}")
+                    return
+
+        self._probe_thread = threading.Thread(target=probe, daemon=True)
+        self._probe_thread.start()
+
+
+def new_http_service(address: str, logger=None, metrics=None, *options, tracer=None) -> HTTPService:
+    """NewHTTPService (new.go:68-89): construct + apply option decorators."""
+    if metrics is not None:
+        from ..metrics import HTTP_BUCKETS
+
+        metrics.new_histogram(
+            "app_http_service_response", "outbound http call time s", HTTP_BUCKETS
+        )
+    svc = HTTPService(address, logger, metrics, tracer)
+    for opt in options:
+        opt.apply(svc)
+    return svc

@@ -1,0 +1,125 @@
+"""gRPC server tests: framework-native unary + server-streaming handlers
+with Context, interceptor recovery, TPU-backed streaming."""
+
+import numpy as np
+import pytest
+
+import gofr_tpu
+from gofr_tpu.config import new_mock_config
+from gofr_tpu.grpcx import json_server_stream, json_unary
+
+
+@pytest.fixture(scope="module")
+def grpc_app():
+    cfg = new_mock_config({
+        "APP_NAME": "grpc-test", "HTTP_PORT": "0", "METRICS_PORT": "0",
+        "GRPC_PORT": "0",
+    })
+    app = gofr_tpu.new(config=cfg)
+
+    def hello(ctx):
+        body = ctx.bind()
+        return {"greeting": f"Hello {body.get('name', 'World')}!"}
+
+    async def async_hello(ctx):
+        return {"greeting": "async"}
+
+    def boom(ctx):
+        raise ValueError("kaboom")
+
+    def count_stream(ctx):
+        n = ctx.bind().get("n", 3)
+        for i in range(n):
+            yield {"i": i}
+
+    async def async_count_stream(ctx):
+        n = ctx.bind().get("n", 3)
+        for i in range(n):
+            yield {"i": i}
+
+    app.grpc_unary("Hello", "SayHello", hello)
+    app.grpc_unary("Hello", "AsyncHello", async_hello)
+    app.grpc_unary("Hello", "Boom", boom)
+    app.grpc_server_stream("Hello", "Count", count_stream)
+    app.grpc_server_stream("Hello", "AsyncCount", async_count_stream)
+    app.run_in_background()
+    target = f"127.0.0.1:{app.grpc_server.port}"
+    yield app, target
+    app.shutdown()
+
+
+class TestUnary:
+    def test_unary_roundtrip(self, grpc_app):
+        _, target = grpc_app
+        out = json_unary(target, "Hello", "SayHello", {"name": "TPU"})
+        assert out == {"greeting": "Hello TPU!"}
+
+    def test_async_handler(self, grpc_app):
+        _, target = grpc_app
+        assert json_unary(target, "Hello", "AsyncHello", {}) == {"greeting": "async"}
+
+    def test_recovery_interceptor_maps_to_internal(self, grpc_app):
+        import grpc as g
+
+        _, target = grpc_app
+        with pytest.raises(g.RpcError) as ei:
+            json_unary(target, "Hello", "Boom", {})
+        assert ei.value.code() == g.StatusCode.INTERNAL
+
+    def test_unknown_method_is_unimplemented(self, grpc_app):
+        import grpc as g
+
+        _, target = grpc_app
+        with pytest.raises(g.RpcError) as ei:
+            json_unary(target, "Hello", "Nope", {})
+        assert ei.value.code() == g.StatusCode.UNIMPLEMENTED
+
+
+class TestServerStream:
+    def test_stream_yields_chunks_in_order(self, grpc_app):
+        _, target = grpc_app
+        chunks = list(json_server_stream(target, "Hello", "Count", {"n": 5}))
+        assert chunks == [{"i": i} for i in range(5)]
+
+    def test_async_generator_handler(self, grpc_app):
+        _, target = grpc_app
+        chunks = list(json_server_stream(target, "Hello", "AsyncCount", {"n": 4}))
+        assert chunks == [{"i": i} for i in range(4)]
+
+
+class TestTPUStreaming:
+    def test_stream_model_outputs(self):
+        """Server-streaming + ctx.tpu(): per-chunk inference results — the
+        shape of token-streaming decode (BASELINE.json config 3)."""
+        import jax
+
+        from gofr_tpu.models import MLPConfig, mlp_forward, mlp_init
+
+        cfg = new_mock_config({
+            "APP_NAME": "grpc-tpu", "HTTP_PORT": "0", "METRICS_PORT": "0",
+            "GRPC_PORT": "0",
+        })
+        app = gofr_tpu.new(config=cfg)
+        mcfg = MLPConfig(in_dim=8, hidden=(16,), out_dim=4, dtype=jax.numpy.float32)
+        params = mlp_init(jax.random.PRNGKey(0), mcfg)
+        app.container.tpu().register_model(
+            "m", lambda p, x: mlp_forward(p, x), params,
+            example_args=(np.zeros(8, np.float32),),
+        )
+
+        def stream_infer(ctx):
+            xs = ctx.bind()["inputs"]
+            for x in xs:
+                out = ctx.tpu().infer_one("m", np.asarray(x, np.float32))
+                yield {"argmax": int(np.argmax(out))}
+
+        app.grpc_server_stream("Infer", "Stream", stream_infer)
+        app.run_in_background()
+        try:
+            target = f"127.0.0.1:{app.grpc_server.port}"
+            inputs = np.random.default_rng(0).normal(size=(3, 8)).tolist()
+            chunks = list(json_server_stream(target, "Infer", "Stream", {"inputs": inputs}))
+            assert len(chunks) == 3
+            assert all("argmax" in c for c in chunks)
+        finally:
+            app.shutdown()

@@ -1,0 +1,173 @@
+"""Migration runner + CRUD handler generation tests (reference
+migration/migration_test.go + crud_handlers_test.go strategies: run against
+a real engine, assert the tracking table and the generated routes)."""
+
+import json
+import urllib.error
+import urllib.request
+from dataclasses import dataclass
+
+import pytest
+
+import gofr_tpu
+from gofr_tpu.config import new_mock_config
+from gofr_tpu.migration import run as run_migrations
+
+
+def _mk_app():
+    cfg = new_mock_config({
+        "APP_NAME": "crud-test", "HTTP_PORT": "0", "METRICS_PORT": "0",
+        "DB_DIALECT": "sqlite",
+    })
+    return gofr_tpu.new(config=cfg)
+
+
+class TestMigrations:
+    def test_runs_in_order_and_records(self):
+        app = _mk_app()
+        order = []
+        migs = {
+            20240102: lambda ds: (order.append(2), ds.sql.exec("CREATE TABLE b (x INT)"))[-1],
+            20240101: lambda ds: (order.append(1), ds.sql.exec("CREATE TABLE a (x INT)"))[-1],
+        }
+        app.migrate(migs)
+        assert order == [1, 2]
+        rows = app.container.sql.query("SELECT version FROM gofr_migrations ORDER BY version")
+        assert [r["version"] for r in rows] == [20240101, 20240102]
+
+    def test_rerun_skips_applied(self):
+        app = _mk_app()
+        count = {"n": 0}
+
+        def up(ds):
+            count["n"] += 1
+            ds.sql.exec("CREATE TABLE IF NOT EXISTS t (x INT)")
+
+        app.migrate({1: up})
+        app.migrate({1: up})
+        assert count["n"] == 1
+
+    def test_failure_rolls_back_and_raises(self):
+        app = _mk_app()
+
+        def bad(ds):
+            ds.sql.exec("CREATE TABLE good (x INT)")
+            raise RuntimeError("boom")
+
+        with pytest.raises(RuntimeError):
+            app.migrate({5: bad})
+        # not recorded
+        rows = app.container.sql.query("SELECT * FROM gofr_migrations")
+        assert rows == []
+
+    def test_no_datasource_is_error(self):
+        cfg = new_mock_config({"APP_NAME": "x", "HTTP_PORT": "0", "METRICS_PORT": "0"})
+        app = gofr_tpu.new(config=cfg)
+        with pytest.raises(Exception, match="datasource"):
+            app.migrate({1: lambda ds: None})
+
+    def test_invalid_migration_rejected(self):
+        app = _mk_app()
+        with pytest.raises(Exception, match="UP"):
+            run_migrations({1: {"down": lambda ds: None}}, app.container)
+
+
+@dataclass
+class Book:
+    id: int = 0
+    title: str = ""
+    author: str = ""
+
+
+@pytest.fixture(scope="module")
+def crud_app():
+    app = _mk_app()
+    app.container.sql.exec(
+        "CREATE TABLE book (id INTEGER PRIMARY KEY, title TEXT, author TEXT)"
+    )
+    app.add_rest_handlers(Book)
+    app.run_in_background()
+    base = f"http://127.0.0.1:{app.http_server.port}"
+
+    def call(method, path, payload=None):
+        data = json.dumps(payload).encode() if payload is not None else None
+        req = urllib.request.Request(
+            base + path, method=method, data=data,
+            headers={"Content-Type": "application/json"} if data else {},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                return resp.status, json.loads(resp.read() or b"null")
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read() or b"null")
+
+    yield call
+    app.shutdown()
+
+
+class TestCRUD:
+    def test_create_and_get(self, crud_app):
+        status, body = crud_app("POST", "/book", {"id": 1, "title": "SICP", "author": "abelson"})
+        assert status == 201
+        status, body = crud_app("GET", "/book/1")
+        assert status == 200
+        assert body["data"]["title"] == "SICP"
+
+    def test_get_all(self, crud_app):
+        crud_app("POST", "/book", {"id": 2, "title": "TAPL", "author": "pierce"})
+        status, body = crud_app("GET", "/book")
+        assert status == 200
+        assert len(body["data"]) >= 2
+
+    def test_update(self, crud_app):
+        status, body = crud_app("PUT", "/book/1", {"title": "SICP 2e"})
+        assert status == 200
+        _, body = crud_app("GET", "/book/1")
+        assert body["data"]["title"] == "SICP 2e"
+
+    def test_delete(self, crud_app):
+        crud_app("POST", "/book", {"id": 9, "title": "tmp", "author": "x"})
+        status, _ = crud_app("DELETE", "/book/9")
+        assert status == 204
+        status, _ = crud_app("GET", "/book/9")
+        assert status == 404
+
+    def test_missing_id_404(self, crud_app):
+        status, body = crud_app("GET", "/book/777")
+        assert status == 404
+        status, _ = crud_app("PUT", "/book/777", {"title": "x"})
+        assert status == 404
+        status, _ = crud_app("DELETE", "/book/777")
+        assert status == 404
+
+
+class TestOverrides:
+    def test_table_and_path_override_and_custom_get(self):
+        app = _mk_app()
+        app.container.sql.exec("CREATE TABLE tomes (isbn TEXT PRIMARY KEY, title TEXT)")
+
+        class Tome:
+            isbn: str = ""
+            title: str = ""
+
+            @staticmethod
+            def table_name():
+                return "tomes"
+
+            @staticmethod
+            def rest_path():
+                return "library"
+
+            @staticmethod
+            def get(ctx):
+                return {"custom": True, "isbn": ctx.path_param("id")}
+
+        app.add_rest_handlers(Tome)
+        app.run_in_background()
+        base = f"http://127.0.0.1:{app.http_server.port}"
+        try:
+            with urllib.request.urlopen(base + "/library/abc", timeout=10) as resp:
+                body = json.loads(resp.read())
+            assert body["data"] == {"custom": True, "isbn": "abc"}
+        finally:
+            app.shutdown()

@@ -1,0 +1,120 @@
+"""Redis client tests against MiniRedis — the real wire protocol end to end
+(reference pattern: miniredis in http-server/main_test.go:57-62)."""
+
+import asyncio
+
+import pytest
+
+from gofr_tpu.config import new_mock_config
+from gofr_tpu.datasource.redis import Redis, new_client
+from gofr_tpu.testutil import MiniRedis
+
+
+@pytest.fixture(scope="module")
+def server():
+    s = MiniRedis().start()
+    yield s
+    s.stop()
+
+
+@pytest.fixture()
+def client(server):
+    c = Redis("127.0.0.1", server.port)
+    yield c
+    asyncio.run(c.flushdb())
+    c.close()
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+class TestRedisClient:
+    def test_set_get_delete(self, client):
+        async def flow():
+            assert await client.set("k", "v") == "OK"
+            assert await client.get("k") == b"v"
+            assert await client.exists("k") == 1
+            assert await client.delete("k") == 1
+            assert await client.get("k") is None
+
+        run(flow())
+
+    def test_expiry(self, client):
+        async def flow():
+            await client.set("e", "x", ex=100)
+            ttl = await client.ttl("e")
+            assert 0 < ttl <= 100
+            assert await client.ttl("missing") == -2
+
+        run(flow())
+
+    def test_incr(self, client):
+        async def flow():
+            assert await client.incr("n") == 1
+            assert await client.incr("n") == 2
+
+        run(flow())
+
+    def test_hash_ops(self, client):
+        async def flow():
+            await client.hset("h", "a", "1")
+            await client.hset("h", "b", "2")
+            assert await client.hget("h", "a") == b"1"
+            assert await client.hgetall("h") == {b"a": b"1", b"b": b"2"}
+
+        run(flow())
+
+    def test_list_ops(self, client):
+        async def flow():
+            await client.lpush("l", "x", "y")
+            assert await client.rpop("l") == b"x"  # LPUSH prepends: y, x
+
+        run(flow())
+
+    def test_keys_pattern(self, client):
+        async def flow():
+            await client.set("user:1", "a")
+            await client.set("user:2", "b")
+            await client.set("other", "c")
+            ks = sorted(await client.keys("user:*"))
+            assert ks == [b"user:1", b"user:2"]
+
+        run(flow())
+
+    def test_health(self, client):
+        h = run(client.health())
+        assert h["status"] == "UP"
+        assert "stats" in h["details"]
+
+    def test_health_down_when_unreachable(self):
+        c = Redis("127.0.0.1", 1)  # nothing listens on port 1
+        h = run(c.health())
+        assert h["status"] == "DOWN"
+
+    def test_reconnects_after_connection_loss(self, server, client):
+        async def flow():
+            await client.set("a", "1")
+            client._writer.close()  # simulate drop
+            await client._writer.wait_closed()
+            assert await client.get("a") == b"1"  # transparently reconnected
+
+        run(flow())
+
+
+class TestWiring:
+    def test_new_client_none_without_host(self):
+        assert new_client(new_mock_config({})) is None
+
+    def test_new_client_with_metrics(self, server):
+        from gofr_tpu.metrics import new_metrics_manager
+
+        m = new_metrics_manager()
+        c = new_client(
+            new_mock_config({"REDIS_HOST": "127.0.0.1", "REDIS_PORT": str(server.port)}),
+            metrics=m,
+        )
+        run(c.set("k", "v"))
+        hist = m.histogram("app_redis_stats")
+        assert sum(v[2] for _, v in hist.collect_histogram()) >= 1
+        c.close()

@@ -1,0 +1,133 @@
+"""Outbound HTTP service client tests: instrumented verbs, auth decorators,
+circuit breaker open/probe/close — against a real in-process app server
+(the reference tests these with httptest servers, service/*_test.go)."""
+
+import threading
+import time
+
+import pytest
+
+import gofr_tpu
+from gofr_tpu.config import new_mock_config
+from gofr_tpu.service import (
+    APIKeyAuth,
+    BasicAuth,
+    CircuitBreaker,
+    CircuitOpenError,
+    CustomHeaders,
+    HealthConfig,
+    new_http_service,
+)
+
+
+@pytest.fixture(scope="module")
+def upstream():
+    cfg = new_mock_config({"APP_NAME": "upstream", "HTTP_PORT": "0", "METRICS_PORT": "0"})
+    app = gofr_tpu.new(config=cfg)
+    state = {"fail": False}
+
+    def echo_headers(ctx):
+        return {
+            "auth": ctx.header("Authorization"),
+            "apikey": ctx.header("X-Api-Key") or ctx.header("X-API-KEY"),
+            "custom": ctx.header("X-Custom"),
+        }
+
+    def flaky(ctx):
+        if state["fail"]:
+            raise RuntimeError("upstream down")
+        return "ok"
+
+    app.get("/headers", echo_headers)
+    app.get("/flaky", flaky)
+    app.run_in_background()
+    yield f"http://127.0.0.1:{app.http_server.port}", state
+    app.shutdown()
+
+
+class TestVerbs:
+    def test_get_json(self, upstream):
+        base, _ = upstream
+        svc = new_http_service(base)
+        resp = svc.get("/headers")
+        assert resp.status_code == 200
+        assert "auth" in resp.json()["data"]
+
+    def test_health_check(self, upstream):
+        base, _ = upstream
+        svc = new_http_service(base)
+        h = svc.health_check_sync()
+        assert h["status"] == "UP"
+
+    def test_health_custom_endpoint(self, upstream):
+        base, _ = upstream
+        svc = new_http_service(base, None, None, HealthConfig("/headers"))
+        assert svc.health_endpoint == "headers"
+        assert svc.health_check_sync()["status"] == "UP"
+
+    def test_health_down_unreachable(self):
+        svc = new_http_service("http://127.0.0.1:1")
+        assert svc.health_check_sync()["status"] == "DOWN"
+
+
+class TestAuthOptions:
+    def test_basic_auth_header(self, upstream):
+        base, _ = upstream
+        svc = new_http_service(base, None, None, BasicAuth("user", "pass"))
+        got = svc.get("/headers").json()["data"]["auth"]
+        assert got.startswith("Basic ")
+
+    def test_api_key_header(self, upstream):
+        base, _ = upstream
+        svc = new_http_service(base, None, None, APIKeyAuth("sekrit"))
+        assert svc.get("/headers").json()["data"]["apikey"] == "sekrit"
+
+    def test_custom_headers(self, upstream):
+        base, _ = upstream
+        svc = new_http_service(base, None, None, CustomHeaders({"X-Custom": "yes"}))
+        assert svc.get("/headers").json()["data"]["custom"] == "yes"
+
+
+class TestCircuitBreaker:
+    def test_opens_after_threshold_then_recovers(self, upstream):
+        base, state = upstream
+        svc = new_http_service(
+            base, None, None, CircuitBreaker(threshold=3, interval=0.1)
+        )
+        state["fail"] = True
+        try:
+            for _ in range(3):
+                svc.get("/flaky")  # 500s
+            assert svc.circuit.state == "open"
+            with pytest.raises(CircuitOpenError) as ei:
+                svc.get("/flaky")
+            assert ei.value.status_code() == 503
+            # upstream recovers; background probe closes the circuit
+            state["fail"] = False
+            deadline = time.time() + 5
+            while svc.circuit.state == "open" and time.time() < deadline:
+                time.sleep(0.05)
+            assert svc.circuit.state == "closed"
+            assert svc.get("/flaky").status_code == 200
+        finally:
+            state["fail"] = False
+
+    def test_transport_failure_counts(self):
+        svc = new_http_service(
+            "http://127.0.0.1:1", None, None, CircuitBreaker(threshold=1, interval=60)
+        )
+        with pytest.raises(Exception):
+            svc.get("/x", timeout=0.2)
+        assert svc.circuit.state == "open"
+
+
+class TestContainerIntegration:
+    def test_app_service_in_health_aggregate(self, upstream):
+        base, _ = upstream
+        cfg = new_mock_config({"APP_NAME": "caller", "HTTP_PORT": "0", "METRICS_PORT": "0"})
+        app = gofr_tpu.new(config=cfg)
+        app.add_http_service("upstream", base)
+        h = app.container.health()
+        assert h["upstream"]["status"] == "UP"
+        svc = app.container.get_http_service("upstream")
+        assert svc is not None and svc.get("/headers").status_code == 200

@@ -1,0 +1,122 @@
+"""SQL datasource tests against real in-memory sqlite (the reference uses
+go-sqlmock; a real engine is the stronger oracle and costs nothing)."""
+
+import threading
+from dataclasses import dataclass
+
+import pytest
+
+from gofr_tpu.config import new_mock_config
+from gofr_tpu.datasource import ErrorDB
+from gofr_tpu.datasource.sql import DB, QueryBuilder, SQLConfig, new_sql, new_sql_mocks
+
+
+@pytest.fixture()
+def db():
+    d = new_sql_mocks()
+    d.exec("CREATE TABLE employee (id INTEGER PRIMARY KEY, name TEXT, dept TEXT)")
+    yield d
+    d.close()
+
+
+class TestDB:
+    def test_exec_and_query(self, db):
+        n = db.exec("INSERT INTO employee (name, dept) VALUES (?, ?)", "ada", "eng")
+        assert n == 1
+        rows = db.query("SELECT * FROM employee")
+        assert rows == [{"id": 1, "name": "ada", "dept": "eng"}]
+        assert db.query_row("SELECT name FROM employee WHERE id = ?", 1) == {"name": "ada"}
+        assert db.query_row("SELECT name FROM employee WHERE id = ?", 99) is None
+
+    def test_select_maps_to_class(self, db):
+        @dataclass
+        class Employee:
+            id: int = 0
+            name: str = ""
+            dept: str = ""
+
+        db.exec("INSERT INTO employee (name, dept) VALUES (?, ?)", "grace", "navy")
+        out = db.select(Employee, "SELECT * FROM employee")
+        assert len(out) == 1 and out[0].name == "grace" and out[0].dept == "navy"
+
+    def test_snake_case_mapping(self, db):
+        db.exec("CREATE TABLE t (first_name TEXT)")
+        db.exec("INSERT INTO t VALUES (?)", "x")
+
+        class Person:
+            firstName: str
+
+        out = db.select(Person, "SELECT * FROM t")
+        assert out[0].firstName == "x"
+
+    def test_transaction_commit_and_rollback(self, db):
+        tx = db.begin()
+        tx.exec("INSERT INTO employee (name, dept) VALUES (?, ?)", "t1", "a")
+        tx.commit()
+        assert len(db.query("SELECT * FROM employee")) == 1
+        tx = db.begin()
+        tx.exec("INSERT INTO employee (name, dept) VALUES (?, ?)", "t2", "b")
+        tx.rollback()
+        assert len(db.query("SELECT * FROM employee")) == 1
+
+    def test_bad_sql_raises_errordb(self, db):
+        with pytest.raises(ErrorDB) as ei:
+            db.query("SELECT * FROM nope")
+        assert ei.value.status_code() == 500
+
+    def test_threads_share_database(self, db):
+        db.exec("INSERT INTO employee (name, dept) VALUES (?, ?)", "main", "x")
+        seen = []
+
+        def worker():
+            seen.append(db.query("SELECT name FROM employee"))
+
+        t = threading.Thread(target=worker)
+        t.start()
+        t.join()
+        assert seen == [[{"name": "main"}]]
+
+    def test_two_instances_isolated(self):
+        a, b = new_sql_mocks(), new_sql_mocks()
+        a.exec("CREATE TABLE t (x INTEGER)")
+        with pytest.raises(ErrorDB):
+            b.query("SELECT * FROM t")
+        a.close(), b.close()
+
+    def test_health(self, db):
+        h = db.health_check()
+        assert h["status"] == "UP" and h["details"]["dialect"] == "sqlite"
+
+
+class TestQueryBuilder:
+    def test_sqlite_binds(self):
+        qb = QueryBuilder("sqlite")
+        assert qb.insert("t", ["a", "b"]) == "INSERT INTO t (a, b) VALUES (?, ?)"
+        assert qb.select_by("t", "id") == "SELECT * FROM t WHERE id = ?"
+        assert qb.update_by("t", ["a"], "id") == "UPDATE t SET a = ? WHERE id = ?"
+        assert qb.delete_by("t", "id") == "DELETE FROM t WHERE id = ?"
+
+    def test_postgres_dollar_binds(self):
+        qb = QueryBuilder("postgres")
+        assert qb.insert("t", ["a", "b"]) == "INSERT INTO t (a, b) VALUES ($1, $2)"
+        assert qb.update_by("t", ["a", "b"], "id") == "UPDATE t SET a = $1, b = $2 WHERE id = $3"
+
+
+class TestWiring:
+    def test_new_sql_from_config(self):
+        cfg = new_mock_config({"DB_DIALECT": "sqlite", "DB_NAME": ""})
+        db = new_sql(cfg)
+        assert db is not None and db.dialect == "sqlite"
+        db.close()
+
+    def test_metrics_recorded(self):
+        from gofr_tpu.metrics import new_metrics_manager
+
+        m = new_metrics_manager()
+        db = new_sql(new_mock_config({"DB_DIALECT": "sqlite"}), metrics=m)
+        db.exec("CREATE TABLE t (x INTEGER)")
+        db.query("SELECT * FROM t")
+        hist = m.histogram("app_sql_stats")
+        total = sum(v[2] for _, v in hist.collect_histogram())
+        assert total >= 2
+        db.close()
