@@ -1,15 +1,19 @@
 """Benchmark harness. Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
 
-Measures end-to-end serving throughput of the MNIST-class MLP through the
-framework's TPU datasource — dynamic batcher, padding, scatter — i.e.
-BASELINE.json config 2 minus the HTTP socket (config 1's socket parity is
-benchmarked separately in examples/). The reference publishes no numbers
-(SURVEY.md §6), so vs_baseline is the ratio against the north-star floor of
-1,000 QPS/chip (BASELINE.json).
+Default (--model gemma2b): steady-state Gemma-2B bf16 decode on one chip —
+the BASELINE.json metric ("QPS/chip + p50/p99 latency serving Gemma-2B on
+v5e"). The reference publishes no numbers (SURVEY.md §6), so vs_baseline
+normalizes against the north-star target: >=1k QPS/chip with ~16-token
+completions on a v5e-8 slice => 16k tok/s across 8 chips => 2,000 tok/s
+per chip. vs_baseline = measured tok/s / 2000.
 
-Run on the real chip: python bench.py        (driver does this)
-CPU smoke:            JAX_PLATFORMS=cpu python bench.py --requests 200
+--model mlp: end-to-end serving QPS of the MNIST MLP through the TPU
+datasource's dynamic batcher (BASELINE.json config 2 minus the socket);
+vs_baseline = QPS / 1000 (the north-star QPS floor).
+
+Run on the real chip: python bench.py          (driver does this)
+CPU smoke:            JAX_PLATFORMS=cpu python bench.py --model mlp --requests 200
 """
 
 from __future__ import annotations
@@ -17,27 +21,79 @@ from __future__ import annotations
 import argparse
 import asyncio
 import json
+import os
 import time
 
 import numpy as np
 
 
-def main() -> None:
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--requests", type=int, default=4096)
-    ap.add_argument("--concurrency", type=int, default=512)
-    ap.add_argument("--max-batch", type=int, default=64)
-    ap.add_argument("--max-inflight", type=int, default=32)
-    ap.add_argument("--max-delay-ms", type=float, default=1.0)
-    args = ap.parse_args()
-
-    import os
-
+def bench_gemma2b(args) -> dict:
     import jax
+    import jax.numpy as jnp
 
-    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
-        # The image's platform plugin overrides the env var; force it.
-        jax.config.update("jax_platforms", "cpu")
+    from gofr_tpu.models import TransformerConfig, decode_step, init_params, prefill
+
+    cfg = TransformerConfig.gemma_2b()
+    B, S, MAX = args.batch, args.prefill_len, args.prefill_len + args.decode_steps + 2
+    t0 = time.time()
+    params = jax.jit(lambda k: init_params(k, cfg))(jax.random.PRNGKey(0))
+    jax.block_until_ready(params)
+    init_s = time.time() - t0
+
+    prefill_fn = jax.jit(lambda p, t, l: prefill(p, cfg, t, l, MAX))
+    decode_fn = jax.jit(lambda p, t, c: decode_step(p, cfg, t, c), donate_argnums=(2,))
+
+    toks = jnp.zeros((B, S), jnp.int32)
+    lens = jnp.full((B,), S, jnp.int32)
+    t0 = time.time()
+    last, cache = prefill_fn(params, toks, lens)
+    jax.block_until_ready(last)
+    prefill_s = time.time() - t0  # includes compile
+
+    # measured prefill (steady)
+    t0 = time.time()
+    last, cache = prefill_fn(params, toks, lens)
+    _ = float(last[0, 0])
+    prefill_steady_ms = (time.time() - t0) * 1e3
+
+    lg, c2 = decode_fn(params, jnp.zeros((B,), jnp.int32), cache)
+    _ = float(lg[0, 0])  # compile + sync
+    t0 = time.time()
+    _ = float(lg[0, 0])
+    fetch_s = time.time() - t0  # host readback RPC overhead to subtract
+
+    n = args.decode_steps
+    t0 = time.time()
+    for _ in range(n):
+        lg, c2 = decode_fn(params, jnp.zeros((B,), jnp.int32), c2)
+    _ = float(lg[0, 0])
+    step_s = (time.time() - t0 - fetch_s) / n
+    tok_s = B / step_s
+
+    return {
+        "metric": "gemma2b_decode_throughput_per_chip",
+        "value": round(tok_s, 0),
+        "unit": "tok/s",
+        "vs_baseline": round(tok_s / 2000.0, 3),
+        "detail": {
+            "decode_step_ms": round(step_s * 1e3, 2),
+            "batch": B,
+            "prefill_len": S,
+            "prefill_steady_ms": round(prefill_steady_ms, 1),
+            "qps_equiv_16tok": round(tok_s / 16, 1),
+            "params_gb": round(
+                sum(x.size * x.dtype.itemsize for x in jax.tree.leaves(params)) / 1e9, 2
+            ),
+            "init_s": round(init_s, 1),
+            "first_prefill_s": round(prefill_s, 1),
+            "device": jax.devices()[0].device_kind,
+            "target_note": "vs_baseline = tok_s / 2000 (north-star 1k QPS/chip x 16-tok completions on v5e-8 = 2k tok/s/chip)",
+        },
+    }
+
+
+def bench_mlp(args) -> dict:
+    import jax
 
     from gofr_tpu.datasource.tpu import TPURuntime
     from gofr_tpu.logging import new_logger
@@ -56,7 +112,6 @@ def main() -> None:
         max_batch=args.max_batch,
         max_delay_ms=args.max_delay_ms,
         max_inflight=args.max_inflight,
-        warmup_buckets=(1, args.max_batch // 4, args.max_batch),
     )
 
     rng = np.random.default_rng(0)
@@ -74,38 +129,60 @@ def main() -> None:
         sem = asyncio.Semaphore(args.concurrency)
         t0 = time.perf_counter()
         outs = await asyncio.gather(*[one(sem, x) for x in xs])
-        wall = time.perf_counter() - t0
-        return outs, wall
+        return outs, time.perf_counter() - t0
 
-    # warm pass (fills executable cache for every bucket actually hit)
-    asyncio.run(drive())
+    asyncio.run(drive())  # warm every bucket actually hit
     latencies.clear()
     outs, wall = asyncio.run(drive())
     assert len(outs) == args.requests and outs[0].shape == (cfg.out_dim,)
 
     qps = args.requests / wall
     lat = np.array(sorted(latencies))
-    p50 = float(lat[int(0.50 * len(lat))]) * 1e3
-    p99 = float(lat[int(0.99 * len(lat))]) * 1e3
+    out = {
+        "metric": "mlp_serving_qps_per_chip",
+        "value": round(qps, 1),
+        "unit": "req/s",
+        "vs_baseline": round(qps / 1000.0, 3),
+        "detail": {
+            "p50_ms": round(float(lat[int(0.50 * len(lat))]) * 1e3, 3),
+            "p99_ms": round(float(lat[int(0.99 * len(lat))]) * 1e3, 3),
+            "requests": args.requests,
+            "platform": rt.platform,
+            "device": rt.devices[0].device_kind if rt.devices else None,
+        },
+    }
     rt.close()
+    return out
 
-    print(
-        json.dumps(
-            {
-                "metric": "mlp_serving_qps_per_chip",
-                "value": round(qps, 1),
-                "unit": "req/s",
-                "vs_baseline": round(qps / 1000.0, 3),
-                "detail": {
-                    "p50_ms": round(p50, 3),
-                    "p99_ms": round(p99, 3),
-                    "requests": args.requests,
-                    "platform": rt.platform,
-                    "device": rt.devices[0].device_kind if rt.devices else None,
-                },
-            }
-        )
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument(
+        "--model", choices=("gemma2b", "mlp"), default=None,
+        help="default: gemma2b on TPU, mlp on CPU (2B init on CPU is minutes)",
     )
+    # gemma knobs
+    ap.add_argument("--batch", type=int, default=64)
+    ap.add_argument("--prefill-len", type=int, default=128)
+    ap.add_argument("--decode-steps", type=int, default=48)
+    # mlp knobs
+    ap.add_argument("--requests", type=int, default=4096)
+    ap.add_argument("--concurrency", type=int, default=512)
+    ap.add_argument("--max-batch", type=int, default=64)
+    ap.add_argument("--max-inflight", type=int, default=32)
+    ap.add_argument("--max-delay-ms", type=float, default=1.0)
+    args = ap.parse_args()
+
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        # The image's platform plugin overrides the env var; force it.
+        jax.config.update("jax_platforms", "cpu")
+    if args.model is None:
+        args.model = "gemma2b" if jax.default_backend() == "tpu" else "mlp"
+
+    result = bench_gemma2b(args) if args.model == "gemma2b" else bench_mlp(args)
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":

@@ -1,0 +1,88 @@
+"""examples/grpc-gemma: token-streaming LLM decode over gRPC —
+BASELINE.json config 3 ("grpc-server unary + server-streaming Gemma-2B
+decode") through the continuous-batching engine.
+
+GEMMA_PRESET=tiny (default, CI/dev) | 2b | 7b chooses the config; weights
+are randomly initialized (no weight downloads in this environment) — the
+serving path is identical with real checkpoints loaded via orbax.
+
+Drive it:
+  unary:  json_unary(target, "Gemma", "Generate", {"tokens": [...], "max_new_tokens": 8})
+  stream: json_server_stream(target, "Gemma", "Stream", {...}) -> one token per chunk
+"""
+
+import os
+import sys
+
+sys.path.insert(0, "../..")
+
+import gofr_tpu
+
+
+def build_engine(app):
+    import jax
+
+    from gofr_tpu.models import TransformerConfig, init_params
+
+    preset = os.environ.get("GEMMA_PRESET", "tiny")
+    cfg = {
+        "tiny": TransformerConfig.tiny,
+        "2b": TransformerConfig.gemma_2b,
+        "7b": TransformerConfig.gemma_7b,
+    }[preset]()
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    kw = {}
+    n_dev = len(jax.devices())
+    if n_dev > 1:
+        from gofr_tpu.parallel import make_mesh, param_specs
+
+        mesh = make_mesh({"data": 1, "model": n_dev})
+        kw = {"mesh": mesh, "param_specs": param_specs(cfg, mesh)}
+    app.container.tpu().register_llm(
+        "gemma", cfg, params,
+        slots=int(os.environ.get("LLM_SLOTS", "4")),
+        max_seq_len=int(os.environ.get("LLM_MAX_SEQ", "256")),
+        prefill_buckets=(16, 64, 128),
+        **kw,
+    )
+
+
+def generate(ctx):
+    body = ctx.bind()
+    toks = ctx.tpu().llm("gemma").generate(
+        body["tokens"], max_new_tokens=int(body.get("max_new_tokens", 16)),
+        temperature=float(body.get("temperature", 0.0)),
+    )
+    return {"tokens": toks}
+
+
+async def stream(ctx):
+    from gofr_tpu.llm import GenRequest
+
+    body = ctx.bind()
+    req = ctx.tpu().llm("gemma").submit(
+        GenRequest(
+            body["tokens"],
+            max_new_tokens=int(body.get("max_new_tokens", 16)),
+            temperature=float(body.get("temperature", 0.0)),
+        )
+    )
+    async for tok in req.astream():
+        yield {"token": tok}
+
+
+def engine_stats(ctx):
+    return ctx.tpu().llm("gemma").stats()
+
+
+def main():
+    app = gofr_tpu.new()
+    build_engine(app)
+    app.grpc_unary("Gemma", "Generate", generate)
+    app.grpc_server_stream("Gemma", "Stream", stream)
+    app.get("/stats", engine_stats)
+    app.run()
+
+
+if __name__ == "__main__":
+    main()

@@ -11,16 +11,8 @@ get_all / get / update / delete methods (:17-35).
 
 from __future__ import annotations
 
-import re
-from typing import Any
-
 from .http.errors import ErrorEntityNotFound, ErrorInvalidParam
-
-_SNAKE_RE = re.compile(r"(?<!^)(?=[A-Z])")
-
-
-def _snake(name: str) -> str:
-    return _SNAKE_RE.sub("_", name).lower()
+from .utils import snake_case as _snake
 
 
 def _entity_info(entity_cls: type) -> tuple[str, str, list[str], str]:

@@ -185,7 +185,9 @@ class FilePubSub(_BasePubSub):
         self.group = group
         os.makedirs(directory, exist_ok=True)
         self._lock = threading.Lock()
-        self._positions: dict[str, int] = {}  # in-flight (uncommitted) cursor
+        # {topic: (line_offset, byte_offset)} — lets subscribe seek straight
+        # to the committed record instead of re-reading the whole log
+        self._seek: dict[str, tuple[int, int]] = {}
 
     def _log_path(self, topic: str) -> str:
         return os.path.join(self.dir, f"{topic}.jsonl")
@@ -216,19 +218,35 @@ class FilePubSub(_BasePubSub):
                 f.write(rec + "\n")
         self._log_pub(topic, raw, True)
 
+    def _read_at(self, topic: str, offset: int) -> str | None:
+        """Line at `offset`, O(1) amortized: seek from the cached byte
+        position when the wanted line is at/after it, else rescan once."""
+        line_off, byte_off = self._seek.get(topic, (0, 0))
+        if offset < line_off:
+            line_off, byte_off = 0, 0
+        try:
+            with open(self._log_path(topic)) as f:
+                f.seek(byte_off)
+                while line_off < offset:
+                    if not f.readline():
+                        return None
+                    line_off += 1
+                pos = f.tell()
+                line = f.readline()
+                self._seek[topic] = (line_off, pos)
+                return line if line else None
+        except FileNotFoundError:
+            return None
+
     async def subscribe(self, topic: str, timeout: float = 0.5) -> Message | None:
         import asyncio
 
         deadline = time.monotonic() + timeout
         while True:
             offset = self._committed(topic)
-            try:
-                with open(self._log_path(topic)) as f:
-                    lines = f.readlines()
-            except FileNotFoundError:
-                lines = []
-            if offset < len(lines):
-                rec = json.loads(lines[offset])
+            line = self._read_at(topic, offset)
+            if line:
+                rec = json.loads(line)
                 return Message(
                     topic,
                     rec["value"].encode(),
@@ -279,13 +297,11 @@ def new_pubsub(backend: str, config, logger=None, metrics=None):
             metrics=metrics,
         )
     if backend == "KAFKA":
-        try:
-            import kafka  # type: ignore  # noqa: F401
-        except ImportError:
-            raise RuntimeError(
-                "PUBSUB_BACKEND=KAFKA needs a kafka client library, none in "
-                "this environment; MEMORY and FILE backends are built in"
-            ) from None
+        raise RuntimeError(
+            "PUBSUB_BACKEND=KAFKA needs a kafka client library and a broker, "
+            "neither present in this environment; MEMORY and FILE backends "
+            "are built in"
+        )
     if backend in ("GOOGLE", "MQTT"):
         raise RuntimeError(
             f"PUBSUB_BACKEND={backend} needs its driver library, not present "

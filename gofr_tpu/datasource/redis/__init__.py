@@ -10,12 +10,15 @@ module) plays the miniredis role from the reference's tests
 (http-server/main_test.go:57-62).
 
 All commands are async (the framework's handlers run on asyncio); sync
-handlers can use the *_sync wrappers which drive a private loop.
+code (CLI/cron/migrations) uses execute_sync, which drives a private loop.
+Connections are per-event-loop, so concurrent callers on different loops
+(gRPC worker threads, tests) never share a socket.
 """
 
 from __future__ import annotations
 
 import asyncio
+import threading
 import time
 from typing import Any
 
@@ -75,50 +78,50 @@ class Redis:
         self.host, self.port, self.db = host, port, db
         self.logger = logger
         self.metrics = metrics
-        self._reader: asyncio.StreamReader | None = None
-        self._writer: asyncio.StreamWriter | None = None
-        self._loop: asyncio.AbstractEventLoop | None = None
-        self._io_lock: asyncio.Lock | None = None
+        # Asyncio streams and locks bind to the loop that created them, and
+        # callers legitimately arrive on different loops (the app loop, gRPC
+        # worker threads each running asyncio.run, tests): keep one
+        # connection + lock PER LOOP, with a threading.Lock guarding the map
+        # itself. No swapping, so loop A can never close the socket loop B
+        # is mid-command on.
+        self._per_loop: dict[int, list] = {}  # id(loop) -> [reader, writer, aio_lock]
+        self._map_lock = threading.Lock()
 
-    def _lock(self) -> asyncio.Lock:
-        # Streams and locks bind to the loop that created them; if the caller
-        # moved loops (tests, sync facades), drop and reconnect.
+    def _conn_state(self) -> list:
         loop = asyncio.get_running_loop()
-        if loop is not self._loop:
-            self._loop = loop
-            self._io_lock = asyncio.Lock()
-            if self._writer is not None:
-                try:
-                    self._writer.close()
-                except Exception:  # noqa: BLE001
-                    pass
-            self._reader = self._writer = None
-        assert self._io_lock is not None
-        return self._io_lock
+        key = id(loop)
+        with self._map_lock:
+            state = self._per_loop.get(key)
+            if state is None:
+                state = [None, None, asyncio.Lock()]
+                self._per_loop[key] = state
+        return state
 
-    async def _ensure(self) -> None:
-        if self._writer is None or self._writer.is_closing():
-            self._reader, self._writer = await asyncio.open_connection(self.host, self.port)
+    async def _ensure(self, state: list) -> None:
+        if state[1] is None or state[1].is_closing():
+            state[0], state[1] = await asyncio.open_connection(self.host, self.port)
             if self.db:
-                await self._call_locked("SELECT", self.db)
+                await self._call_on(state, "SELECT", self.db)
 
-    async def _call_locked(self, *parts) -> Any:
-        assert self._writer is not None and self._reader is not None
-        self._writer.write(_encode(parts))
-        await self._writer.drain()
-        return await _decode(self._reader)
+    @staticmethod
+    async def _call_on(state: list, *parts) -> Any:
+        reader, writer = state[0], state[1]
+        writer.write(_encode(parts))
+        await writer.drain()
+        return await _decode(reader)
 
     async def execute(self, *parts) -> Any:
         """One command over the wire, instrumented (hook.go:17-105)."""
         t0 = time.perf_counter()
         err: Exception | None = None
+        state = self._conn_state()
         try:
-            async with self._lock():
-                await self._ensure()
-                return await self._call_locked(*parts)
+            async with state[2]:
+                await self._ensure(state)
+                return await self._call_on(state, *parts)
         except (ConnectionError, OSError, asyncio.IncompleteReadError) as e:
             err = e
-            self._writer = None  # force reconnect next call
+            state[1] = None  # force reconnect next call on this loop
             raise
         finally:
             dt = time.perf_counter() - t0
@@ -215,19 +218,29 @@ class Redis:
             return asyncio.run(self.health())
         except RuntimeError:
             # already inside a loop: report connection state only
-            up = self._writer is not None and not self._writer.is_closing()
+            with self._map_lock:
+                up = any(
+                    s[1] is not None and not s[1].is_closing()
+                    for s in self._per_loop.values()
+                )
             return health(
                 STATUS_UP if up else STATUS_DOWN, host=f"{self.host}:{self.port}"
             )
 
+    def execute_sync(self, *parts, timeout: float = 10.0) -> Any:
+        """Sync facade for CLI/cron/migration code (own private loop)."""
+        return asyncio.run(asyncio.wait_for(self.execute(*parts), timeout))
+
     def close(self) -> None:
-        w = self._writer
-        self._writer = None
-        if w is not None:
-            try:
-                w.close()
-            except Exception:  # noqa: BLE001
-                pass
+        with self._map_lock:
+            states = list(self._per_loop.values())
+            self._per_loop.clear()
+        for s in states:
+            if s[1] is not None:
+                try:
+                    s[1].close()
+                except Exception:  # noqa: BLE001
+                    pass
 
 
 def new_client(config, logger=None, metrics=None) -> Redis | None:

@@ -21,13 +21,13 @@ async; the DB is thread-safe via a connection-per-thread pool for sqlite
 
 from __future__ import annotations
 
-import re
 import threading
 import time
 from dataclasses import dataclass
-from typing import Any, Callable, Iterable
+from typing import Callable
 
 from .. import STATUS_DOWN, STATUS_UP, ErrorDB, health
+from ...utils import snake_case as _snake
 
 __all__ = ["DB", "SQLConfig", "new_sql", "new_sql_mocks", "QueryBuilder"]
 
@@ -71,14 +71,17 @@ def _snake(name: str) -> str:
 
 
 class QueryBuilder:
-    """Dialect-aware statement builder (query_builder.go:8-70). Placeholders:
-    sqlite/mysql '?', postgres '$n' (bind.go:24-38)."""
+    """Dialect-aware statement builder (query_builder.go:8-70). Placeholders
+    match the PEP-249 paramstyle of the wired driver: sqlite '?' (qmark),
+    pymysql and psycopg2 both '%s' (format) — the reference's Go drivers use
+    '?'/'$n' (bind.go:24-38) but Python's don't, and the builder exists to
+    hide exactly that."""
 
     def __init__(self, dialect: str):
         self.dialect = dialect
 
     def bindvar(self, i: int) -> str:
-        return f"${i}" if self.dialect == "postgres" else "?"
+        return "?" if self.dialect == "sqlite" else "%s"
 
     def quote(self, ident: str) -> str:
         return f'"{ident}"' if self.dialect == "postgres" else f"`{ident}`" if self.dialect == "mysql" else f'"{ident}"'

@@ -391,6 +391,31 @@ class TPURuntime:
         m = self.model(name)
         return m.batcher.submit(example_args).result(timeout=timeout)
 
+    # -- LLM engines (continuous batching; gofr_tpu.llm) -------------------
+    def register_llm(self, name: str, cfg, params, **engine_kw):
+        """Register a continuous-batching text-generation engine alongside
+        the plain models; reachable as ctx.tpu().llm(name)."""
+        from ...llm import LLMEngine
+
+        if not hasattr(self, "_llms"):
+            self._llms: dict[str, Any] = {}
+        if name in self._llms:
+            self._llms[name].close()
+        engine = LLMEngine(
+            cfg, params, logger=self.logger, metrics=self.metrics, **engine_kw
+        )
+        self._llms[name] = engine
+        return engine
+
+    def llm(self, name: str):
+        llms = getattr(self, "_llms", {})
+        try:
+            return llms[name]
+        except KeyError:
+            raise KeyError(
+                f"LLM '{name}' not registered; known: {list(llms)}"
+            ) from None
+
     # -- lifecycle hooks (App.serve/_stop_servers call these) --------------
     async def start_batchers(self) -> None:
         """Batchers are thread-backed and start at register_model; this hook
@@ -410,6 +435,9 @@ class TPURuntime:
                 "models": {
                     n: dict(m.meta, queue_depth=m.batcher.q.qsize())
                     for n, m in self._models.items()
+                },
+                "llms": {
+                    n: eng.stats() for n, eng in getattr(self, "_llms", {}).items()
                 },
             }
             stats = {}
@@ -431,6 +459,10 @@ class TPURuntime:
         for m in self._models.values():
             m.batcher.close()
         self._models.clear()
+        for eng in getattr(self, "_llms", {}).values():
+            eng.close()
+        if hasattr(self, "_llms"):
+            self._llms.clear()
 
 
 class MockTPU:

@@ -43,7 +43,9 @@ class GRPCRequest:
         self._meta = dict(invocation_context.invocation_metadata() or [])
 
     def param(self, key: str) -> str:
-        return str(self._meta.get(key, ""))
+        # gRPC metadata keys are always lowercase on the wire; mirror the
+        # HTTP Request's case-insensitive lookup so shared handlers work.
+        return str(self._meta.get(key.lower(), ""))
 
     def params(self, key: str) -> list[str]:
         v = self.param(key)

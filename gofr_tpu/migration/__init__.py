@@ -116,9 +116,10 @@ def run(migrations: dict[int, Any], container) -> None:
             ms[version].up(ds)
             duration_ms = round((time.perf_counter() - t0) * 1e3, 3)
             if tx is not None:
+                bv = db.builder.bindvar
                 tx.exec(
                     "INSERT INTO gofr_migrations (version, method, start_time, duration_ms)"
-                    " VALUES (?, ?, ?, ?)",
+                    f" VALUES ({bv(1)}, {bv(2)}, {bv(3)}, {bv(4)})",
                     version, "UP", start_iso, duration_ms,
                 )
                 tx.commit()

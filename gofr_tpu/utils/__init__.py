@@ -2,6 +2,16 @@
 
 from __future__ import annotations
 
+import re
+
+_SNAKE_RE = re.compile(r"(?<!^)(?=[A-Z])")
+
+
+def snake_case(name: str) -> str:
+    """CamelCase -> snake_case; shared by ORM column mapping (datasource.sql)
+    and CRUD table/path derivation (crud) so the two never diverge."""
+    return _SNAKE_RE.sub("_", name).lower()
+
 
 def pin_jax_platform(platform: str, logger=None) -> bool:
     """Pin the jax backend (jax.config jax_platforms) and VERIFY it took.

@@ -95,11 +95,16 @@ class TestRedisClient:
     def test_reconnects_after_connection_loss(self, server, client):
         async def flow():
             await client.set("a", "1")
-            client._writer.close()  # simulate drop
-            await client._writer.wait_closed()
+            state = client._conn_state()
+            state[1].close()  # simulate drop
+            await state[1].wait_closed()
             assert await client.get("a") == b"1"  # transparently reconnected
 
         run(flow())
+
+    def test_execute_sync(self, client):
+        assert client.execute_sync("SET", "sk", "sv") == "OK"
+        assert client.execute_sync("GET", "sk") == b"sv"
 
 
 class TestWiring:

@@ -96,10 +96,15 @@ class TestQueryBuilder:
         assert qb.update_by("t", ["a"], "id") == "UPDATE t SET a = ? WHERE id = ?"
         assert qb.delete_by("t", "id") == "DELETE FROM t WHERE id = ?"
 
-    def test_postgres_dollar_binds(self):
-        qb = QueryBuilder("postgres")
-        assert qb.insert("t", ["a", "b"]) == "INSERT INTO t (a, b) VALUES ($1, $2)"
-        assert qb.update_by("t", ["a", "b"], "id") == "UPDATE t SET a = $1, b = $2 WHERE id = $3"
+    def test_postgres_mysql_format_binds(self):
+        # psycopg2 and pymysql both use the '%s' (format) paramstyle
+        for dialect in ("postgres", "mysql"):
+            qb = QueryBuilder(dialect)
+            assert qb.insert("t", ["a", "b"]) == "INSERT INTO t (a, b) VALUES (%s, %s)"
+            assert (
+                qb.update_by("t", ["a", "b"], "id")
+                == "UPDATE t SET a = %s, b = %s WHERE id = %s"
+            )
 
 
 class TestWiring:
