@@ -18,8 +18,10 @@ Connections are per-event-loop, so concurrent callers on different loops
 from __future__ import annotations
 
 import asyncio
+import itertools
 import threading
 import time
+import weakref
 from typing import Any
 
 from .. import STATUS_DOWN, STATUS_UP, health
@@ -70,6 +72,21 @@ async def _decode(reader: asyncio.StreamReader) -> Any:
     raise RESPError(f"bad RESP type byte {t!r}")
 
 
+_CLIENT_SEQ = itertools.count()
+
+
+class _ConnState:
+    """Per-event-loop connection state. Strongly referenced only by the loop
+    it belongs to, so it (and its socket) is collected when the loop is."""
+
+    __slots__ = ("reader", "writer", "lock", "__weakref__")
+
+    def __init__(self):
+        self.reader = None
+        self.writer = None
+        self.lock = asyncio.Lock()
+
+
 class Redis:
     """Minimal-but-real Redis client: GET/SET/DEL/EXISTS/EXPIRE/TTL/INCR/
     HSET/HGET/HGETALL/LPUSH/RPOP/KEYS/FLUSHDB/PING/INFO + raw execute()."""
@@ -81,31 +98,35 @@ class Redis:
         # Asyncio streams and locks bind to the loop that created them, and
         # callers legitimately arrive on different loops (the app loop, gRPC
         # worker threads each running asyncio.run, tests): keep one
-        # connection + lock PER LOOP, with a threading.Lock guarding the map
-        # itself. No swapping, so loop A can never close the socket loop B
-        # is mid-command on.
-        self._per_loop: dict[int, list] = {}  # id(loop) -> [reader, writer, aio_lock]
+        # connection + lock PER LOOP. The state lives as an attribute ON the
+        # loop object (not in a map keyed by id(loop) — a recycled id must
+        # never hand a new loop streams bound to a dead one, and any map
+        # value holding the streams would strongly reference the loop and
+        # leak it). A WeakSet tracks live states for close()/health only.
+        self._loop_attr = f"_gofr_redis_{next(_CLIENT_SEQ)}"  # never-recycled key
+        self._states: "weakref.WeakSet[_ConnState]" = weakref.WeakSet()
         self._map_lock = threading.Lock()
 
-    def _conn_state(self) -> list:
+    def _conn_state(self) -> "_ConnState":
         loop = asyncio.get_running_loop()
-        key = id(loop)
+        state = getattr(loop, self._loop_attr, None)
+        if state is None:
+            state = _ConnState()
+            setattr(loop, self._loop_attr, state)
         with self._map_lock:
-            state = self._per_loop.get(key)
-            if state is None:
-                state = [None, None, asyncio.Lock()]
-                self._per_loop[key] = state
+            # idempotent: re-register states that reconnect after close()
+            self._states.add(state)
         return state
 
-    async def _ensure(self, state: list) -> None:
-        if state[1] is None or state[1].is_closing():
-            state[0], state[1] = await asyncio.open_connection(self.host, self.port)
+    async def _ensure(self, state: "_ConnState") -> None:
+        if state.writer is None or state.writer.is_closing():
+            state.reader, state.writer = await asyncio.open_connection(self.host, self.port)
             if self.db:
                 await self._call_on(state, "SELECT", self.db)
 
     @staticmethod
-    async def _call_on(state: list, *parts) -> Any:
-        reader, writer = state[0], state[1]
+    async def _call_on(state: "_ConnState", *parts) -> Any:
+        reader, writer = state.reader, state.writer
         writer.write(_encode(parts))
         await writer.drain()
         return await _decode(reader)
@@ -116,12 +137,12 @@ class Redis:
         err: Exception | None = None
         state = self._conn_state()
         try:
-            async with state[2]:
+            async with state.lock:
                 await self._ensure(state)
                 return await self._call_on(state, *parts)
         except (ConnectionError, OSError, asyncio.IncompleteReadError) as e:
             err = e
-            state[1] = None  # force reconnect next call on this loop
+            state.writer = None  # force reconnect next call on this loop
             raise
         finally:
             dt = time.perf_counter() - t0
@@ -220,8 +241,8 @@ class Redis:
             # already inside a loop: report connection state only
             with self._map_lock:
                 up = any(
-                    s[1] is not None and not s[1].is_closing()
-                    for s in self._per_loop.values()
+                    s.writer is not None and not s.writer.is_closing()
+                    for s in self._states
                 )
             return health(
                 STATUS_UP if up else STATUS_DOWN, host=f"{self.host}:{self.port}"
@@ -233,12 +254,15 @@ class Redis:
 
     def close(self) -> None:
         with self._map_lock:
-            states = list(self._per_loop.values())
-            self._per_loop.clear()
+            states = list(self._states)
+            self._states.clear()
         for s in states:
-            if s[1] is not None:
+            # close() only; never null the attr — an in-flight command on the
+            # loop thread must see is_closing() (caught ConnectionError path),
+            # not a None writer (uncaught AttributeError).
+            if s.writer is not None:
                 try:
-                    s[1].close()
+                    s.writer.close()
                 except Exception:  # noqa: BLE001
                     pass
 

@@ -63,13 +63,6 @@ class SQLConfig:
         return f"{self.user}@{self.host}:{self.port}/{self.database}"
 
 
-_SNAKE_RE = re.compile(r"(?<!^)(?=[A-Z])")
-
-
-def _snake(name: str) -> str:
-    return _SNAKE_RE.sub("_", name).lower()
-
-
 class QueryBuilder:
     """Dialect-aware statement builder (query_builder.go:8-70). Placeholders
     match the PEP-249 paramstyle of the wired driver: sqlite '?' (qmark),

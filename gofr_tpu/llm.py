@@ -155,7 +155,17 @@ class LLMEngine:
             )
             return slot_cache._replace(k=k, v=v, length=length)
 
+        def _first_tok(last_logits, temps, rng):
+            # same sampling semantics as _decode so token #1 honors the
+            # request temperature (greedy only when temps == 0)
+            greedy = jnp.argmax(last_logits, axis=-1)
+            sampled = jax.random.categorical(
+                rng, last_logits / jnp.maximum(temps, 1e-4)[:, None], axis=-1
+            )
+            return jnp.where(temps > 0.0, sampled, greedy).astype(jnp.int32)
+
         self._prefill = jax.jit(_prefill)
+        self._first_tok = jax.jit(_first_tok)
         self._decode = jax.jit(_decode)
         self._insert = jax.jit(_insert)
         self._rng = jax.random.PRNGKey(0)
@@ -283,7 +293,13 @@ class LLMEngine:
                 lens[j] = n
             t0 = time.perf_counter()
             last_logits, new_cache = self._prefill(self.params, toks, lens)
-            first = np.asarray(self._jnp.argmax(last_logits, axis=-1), np.int32)
+            temps = np.zeros((nb,), np.float32)
+            for j, r in enumerate(reqs):
+                temps[j] = r.temperature
+            self._rng, sub = self._split(self._rng)
+            first = np.asarray(
+                self._first_tok(last_logits, self._jnp.asarray(temps), sub), np.int32
+            )
             if self.metrics is not None:
                 self.metrics.record_histogram(
                     "app_tpu_stats", time.perf_counter() - t0,

@@ -1,0 +1,112 @@
+"""Decompose Gemma-2B prefill/decode time on the real chip to find where
+the MFU goes. Run: python scripts/profile_prefill.py
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from gofr_tpu.models import TransformerConfig, init_params, prefill, decode_step
+from gofr_tpu.models.transformer import init_cache, transformer_forward
+from gofr_tpu.ops import multi_head_attention, flash_attention, rms_norm
+
+cfg = TransformerConfig.gemma_2b()
+B, S, MAX = 64, 128, 178
+print("device:", jax.devices()[0].device_kind, flush=True)
+
+t0 = time.time()
+params = jax.jit(lambda k: init_params(k, cfg))(jax.random.PRNGKey(0))
+jax.block_until_ready(params)
+print(f"init {time.time()-t0:.1f}s", flush=True)
+
+
+def _sync(out):
+    # block_until_ready does not actually block under the axon tunnel;
+    # force completion with a real device->host scalar fetch.
+    x = jax.tree.leaves(out)[0]
+    return float(x.ravel()[0])
+
+
+def timeit(name, fn, *args, n=5, **kw):
+    f = jax.jit(fn, **kw)
+    out = f(*args)
+    _sync(out)  # compile
+    _sync(f(*args))
+    t0 = time.perf_counter()
+    _sync(f(*args))
+    fetch = time.perf_counter() - t0  # RPC fetch overhead for 1 call
+    t0 = time.perf_counter()
+    for _ in range(n):
+        out = f(*args)
+    _sync(out)
+    dt = (time.perf_counter() - t0 - fetch * 0) / n
+    print(f"{name:40s} {dt*1e3:9.2f} ms   (1-call incl fetch {fetch*1e3:.2f} ms)", flush=True)
+    return dt
+
+
+toks = jnp.zeros((B, S), jnp.int32)
+lens = jnp.full((B,), S, jnp.int32)
+
+# full prefill
+dt_full = timeit("full prefill (w/ cache build)", lambda p, t, l: prefill(p, cfg, t, l, MAX), params, toks, lens)
+
+# forward without cache materialization
+def fwd_nocache(p, t):
+    pos = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
+    logits, _ = transformer_forward(p, cfg, t, pos, cache=None, unembed_positions=jnp.full((B,), S - 1, jnp.int32))
+    return logits
+
+dt_nc = timeit("forward, no cache pad", fwd_nocache, params, toks)
+
+# attention alone at prefill shapes, one layer's worth x n_layers
+q = jnp.zeros((B, S, cfg.n_heads, cfg.head_dim), cfg.dtype)
+k = jnp.zeros((B, S, cfg.n_kv_heads, cfg.head_dim), cfg.dtype)
+dt_attn = timeit("flash attn x1 layer", lambda q, k: multi_head_attention(q, k, k, causal=True), q, k)
+print(f"  -> x{cfg.n_layers} layers = {dt_attn*cfg.n_layers*1e3:.1f} ms", flush=True)
+
+# big matmuls alone (one layer, then scale)
+x = jnp.zeros((B, S, cfg.d_model), cfg.dtype)
+wgu = jnp.zeros((cfg.d_model, 2 * cfg.d_ff), cfg.dtype)
+wdn = jnp.zeros((cfg.d_ff, cfg.d_model), cfg.dtype)
+dt_mlp = timeit("mlp matmuls x1 layer", lambda x, a, b: (x @ a).reshape(B, S, cfg.d_ff, 2)[..., 0] @ b, x, wgu, wdn)
+print(f"  -> x{cfg.n_layers} = {dt_mlp*cfg.n_layers*1e3:.1f} ms", flush=True)
+
+wq = jnp.zeros((cfg.d_model, cfg.n_heads * cfg.head_dim), cfg.dtype)
+dt_qkvo = timeit("q+kv+o matmuls x1 layer", lambda x, a: ((x @ a) @ a.T) @ a, x, wq)
+
+# embed gather + unembed
+emb = params["embed"]
+dt_emb = timeit("embed gather", lambda e, t: e[t].astype(cfg.dtype), emb, toks)
+xl = jnp.zeros((B, 1, cfg.d_model), cfg.dtype)
+dt_unemb = timeit("unembed [B,1,d]@[d,V]", lambda x, e: (x @ e.T.astype(cfg.dtype)).astype(jnp.float32), xl, emb)
+
+# flops accounting
+n_params = sum(x.size for x in jax.tree.leaves(params))
+flops = 2 * B * S * (n_params - cfg.vocab_size * cfg.d_model) + 2 * B * 1 * cfg.vocab_size * cfg.d_model
+print(f"params {n_params/1e9:.2f}B  prefill flops {flops/1e12:.1f} TF", flush=True)
+print(f"MFU full: {flops/dt_full/197e12*100:.1f}%  (v5e peak 197 TF/s bf16)", flush=True)
+print(f"MFU nocache: {flops/dt_nc/197e12*100:.1f}%", flush=True)
+
+# decode
+cache = jax.jit(lambda p, t, l: prefill(p, cfg, t, l, MAX))(params, toks, lens)[1]
+dec = jax.jit(lambda p, t, c: decode_step(p, cfg, t, c), donate_argnums=(2,))
+tok = jnp.zeros((B,), jnp.int32)
+lg, c2 = dec(params, tok, cache)
+_sync(lg)
+t0 = time.perf_counter()
+lg, c2 = dec(params, tok, c2)
+_sync(lg)
+fetch = time.perf_counter() - t0
+t0 = time.perf_counter()
+N = 20
+for _ in range(N):
+    lg, c2 = dec(params, tok, c2)
+_sync(lg)
+dt_dec = (time.perf_counter() - t0) / N
+bytes_str = n_params * 2 + cfg.n_layers * B * MAX * cfg.n_kv_heads * cfg.head_dim * 2 * 2
+print(f"decode step {dt_dec*1e3:.2f} ms  -> {bytes_str/dt_dec/1e9:.0f} GB/s ({bytes_str/dt_dec/8.2e11*100:.0f}% of 820 GB/s)", flush=True)

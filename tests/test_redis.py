@@ -96,8 +96,8 @@ class TestRedisClient:
         async def flow():
             await client.set("a", "1")
             state = client._conn_state()
-            state[1].close()  # simulate drop
-            await state[1].wait_closed()
+            state.writer.close()  # simulate drop
+            await state.writer.wait_closed()
             assert await client.get("a") == b"1"  # transparently reconnected
 
         run(flow())
