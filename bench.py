@@ -1,19 +1,27 @@
 """Benchmark harness. Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
 
-Default (--model gemma2b): steady-state Gemma-2B bf16 decode on one chip —
-the BASELINE.json metric ("QPS/chip + p50/p99 latency serving Gemma-2B on
-v5e"). The reference publishes no numbers (SURVEY.md §6), so vs_baseline
-normalizes against the north-star target: >=1k QPS/chip with ~16-token
-completions on a v5e-8 slice => 16k tok/s across 8 chips => 2,000 tok/s
-per chip. vs_baseline = measured tok/s / 2000.
+Default (--model serving, on TPU): end-to-end Gemma-2B decode serving
+through the LLMEngine (slot continuous batching + fused decode chunks) —
+the BASELINE.json metric ("QPS/chip + p50/p99 latency serving Gemma-2B").
+vs_baseline normalizes against the north-star floor of >=1,000 QPS/chip
+(BASELINE.md): vs_baseline = measured QPS-equivalent / 1000, where a
+"query" is a 16-token completion. detail reports prefill MFU% and decode
+HBM-bandwidth utilization so perf regressions are visible.
 
 --model mlp: end-to-end serving QPS of the MNIST MLP through the TPU
 datasource's dynamic batcher (BASELINE.json config 2 minus the socket);
-vs_baseline = QPS / 1000 (the north-star QPS floor).
+vs_baseline = QPS / 1000 (same north-star floor).
+
+--model greet: BASELINE config 1 — boots the stock New() app and hammers
+GET /greet over real sockets; reports QPS (no reference number exists:
+the Go toolchain is absent, so parity is recorded as absolute QPS).
 
 Run on the real chip: python bench.py          (driver does this)
 CPU smoke:            JAX_PLATFORMS=cpu python bench.py --model mlp --requests 200
+
+NOTE on timing: block_until_ready does not reliably block under the axon
+TPU tunnel; every measurement below syncs via a real device->host fetch.
 """
 
 from __future__ import annotations
@@ -22,72 +30,158 @@ import argparse
 import asyncio
 import json
 import os
+import threading
 import time
 
 import numpy as np
 
+V5E_PEAK_BF16 = 197e12  # FLOP/s
+V5E_HBM_BW = 8.2e11  # B/s
 
-def bench_gemma2b(args) -> dict:
+
+def _percentile(xs: list[float], p: float) -> float:
+    xs = sorted(xs)
+    return xs[min(len(xs) - 1, int(p * len(xs)))]
+
+
+def bench_serving(args) -> dict:
     import jax
     import jax.numpy as jnp
 
-    from gofr_tpu.models import TransformerConfig, decode_step, init_params, prefill
+    from gofr_tpu.llm import GenRequest, LLMEngine
+    from gofr_tpu.models import TransformerConfig, init_params
 
-    cfg = TransformerConfig.gemma_2b()
-    B, S, MAX = args.batch, args.prefill_len, args.prefill_len + args.decode_steps + 2
+    on_tpu = jax.default_backend() == "tpu"
+    cfg = TransformerConfig.gemma_2b() if on_tpu else TransformerConfig.tiny()
     t0 = time.time()
     params = jax.jit(lambda k: init_params(k, cfg))(jax.random.PRNGKey(0))
-    jax.block_until_ready(params)
+    _ = float(np.asarray(params["final_norm"])[0])  # sync
     init_s = time.time() - t0
 
-    prefill_fn = jax.jit(lambda p, t, l: prefill(p, cfg, t, l, MAX))
-    decode_fn = jax.jit(lambda p, t, c: decode_step(p, cfg, t, c), donate_argnums=(2,))
+    S = args.prefill_len
+    eng = LLMEngine(
+        cfg, params, slots=args.batch, max_seq_len=S + args.new_tokens + 8,
+        prefill_buckets=(S,), decode_chunk=args.decode_chunk,
+        admit_cap=args.admit_cap,
+    )
+    n_params = sum(x.size for x in jax.tree.leaves(params))
+    params_bytes = sum(x.size * x.dtype.itemsize for x in jax.tree.leaves(params))
 
-    toks = jnp.zeros((B, S), jnp.int32)
-    lens = jnp.full((B,), S, jnp.int32)
-    t0 = time.time()
-    last, cache = prefill_fn(params, toks, lens)
-    jax.block_until_ready(last)
-    prefill_s = time.time() - t0  # includes compile
+    # -- raw fused decode: engine's own executable, all slots active -------
+    B = args.batch
+    active = jnp.ones((B,), bool)
+    temps = jnp.zeros((B,), jnp.float32)
+    toks0 = jnp.zeros((B,), jnp.int32)
+    cache = eng.cache
+    rng = jax.random.PRNGKey(7)
+    # make every slot's cursor real so decode attends over S tokens
+    cache = cache._replace(length=jnp.full((B,), S, jnp.int32))
+    toks, last, cache, rng = eng._chunk_op(eng.params, toks0, cache, active, temps, rng)
+    _ = np.asarray(last)  # compile + sync
+    n_chunks = max(1, args.decode_steps // args.decode_chunk)
+    t0 = time.perf_counter()
+    for _i in range(n_chunks):
+        toks, last, cache, rng = eng._chunk_op(eng.params, last, cache, active, temps, rng)
+    _ = np.asarray(last)
+    raw_chunk_s = (time.perf_counter() - t0) / n_chunks
+    raw_step_s = raw_chunk_s / args.decode_chunk
+    raw_tok_s = B / raw_step_s
+    # decode streams all weights + the live KV prefix each step
+    kv_bytes = cfg.n_layers * B * (S + args.decode_steps // 2) * cfg.n_kv_heads * cfg.head_dim * 2 * 2
+    decode_bytes = params_bytes + kv_bytes
+    bw_util = decode_bytes / raw_step_s / V5E_HBM_BW
+    # the raw loop's cache was built from donated buffers; rebuild engine state
+    eng.cache = cache._replace(length=jnp.zeros((B,), jnp.int32))
 
-    # measured prefill (steady)
-    t0 = time.time()
-    last, cache = prefill_fn(params, toks, lens)
-    _ = float(last[0, 0])
-    prefill_steady_ms = (time.time() - t0) * 1e3
+    # -- raw prefill MFU ---------------------------------------------------
+    ptoks = jnp.zeros((args.admit_cap, S), jnp.int32)
+    plens = jnp.full((args.admit_cap,), S, jnp.int32)
+    ptemps = jnp.zeros((args.admit_cap,), jnp.float32)
+    first, pc, _ = eng._prefill_op(eng.params, ptoks, plens, ptemps, rng)
+    _ = np.asarray(first)  # compile (the nb=admit_cap executable) + sync
+    t0 = time.perf_counter()
+    first, pc, _ = eng._prefill_op(eng.params, ptoks, plens, ptemps, rng)
+    _ = np.asarray(first)
+    prefill_s = time.perf_counter() - t0
+    # 2*T*P matmul FLOPs over non-embedding params + the last-token unembed
+    embed_params = cfg.vocab_size * cfg.d_model
+    prefill_flops = (
+        2 * args.admit_cap * S * (n_params - embed_params)
+        + 2 * args.admit_cap * embed_params
+    )
+    mfu = prefill_flops / prefill_s / V5E_PEAK_BF16
 
-    lg, c2 = decode_fn(params, jnp.zeros((B,), jnp.int32), cache)
-    _ = float(lg[0, 0])  # compile + sync
-    t0 = time.time()
-    _ = float(lg[0, 0])
-    fetch_s = time.time() - t0  # host readback RPC overhead to subtract
+    # -- serving: concurrent clients through submit/stream -----------------
+    rng_np = np.random.default_rng(0)
+    lat: list[float] = []
+    errors: list[BaseException] = []
+    lock = threading.Lock()
 
-    n = args.decode_steps
-    t0 = time.time()
-    for _ in range(n):
-        lg, c2 = decode_fn(params, jnp.zeros((B,), jnp.int32), c2)
-    _ = float(lg[0, 0])
-    step_s = (time.time() - t0 - fetch_s) / n
-    tok_s = B / step_s
+    def client(prompts: list[list[int]]):
+        try:
+            for prompt in prompts:
+                t0 = time.perf_counter()
+                req = eng.submit(GenRequest(prompt, max_new_tokens=args.new_tokens))
+                toks = req.tokens(timeout=600)
+                dt = time.perf_counter() - t0
+                assert len(toks) == args.new_tokens, f"short completion {len(toks)}"
+                with lock:
+                    lat.append(dt)
+        except BaseException as e:  # noqa: BLE001 — surface after join
+            with lock:
+                errors.append(e)
+
+    def run_wave(total: int, nthreads: int) -> tuple[int, float]:
+        nthreads = min(nthreads, total)
+        per = max(1, total // nthreads)
+        done = per * nthreads
+        # prompts drawn up-front on one thread (np Generator isn't thread-safe)
+        work = [
+            [rng_np.integers(1, cfg.vocab_size, size=S - 8).tolist() for _ in range(per)]
+            for _ in range(nthreads)
+        ]
+        ts = [threading.Thread(target=client, args=(w,)) for w in work]
+        t0 = time.perf_counter()
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        if errors:
+            raise RuntimeError(f"{len(errors)} bench clients failed: {errors[0]!r}")
+        return done, time.perf_counter() - t0
+
+    run_wave(min(args.requests, 2 * args.batch), args.clients)  # warm all paths
+    lat.clear()
+    done, wall = run_wave(args.requests, args.clients)
+    qps = done / wall
+    eng_tok_s = qps * args.new_tokens
+    eng.close()
 
     return {
-        "metric": "gemma2b_decode_throughput_per_chip",
-        "value": round(tok_s, 0),
-        "unit": "tok/s",
-        "vs_baseline": round(tok_s / 2000.0, 3),
+        "metric": "gemma2b_serving_qps_per_chip",
+        "value": round(qps, 1),
+        "unit": "req/s (16-tok completions)",
+        "vs_baseline": round(qps / 1000.0, 3),
         "detail": {
-            "decode_step_ms": round(step_s * 1e3, 2),
-            "batch": B,
+            "p50_ms": round(_percentile(lat, 0.50) * 1e3, 1),
+            "p99_ms": round(_percentile(lat, 0.99) * 1e3, 1),
+            "engine_tok_s": round(eng_tok_s, 0),
+            "raw_decode_tok_s": round(raw_tok_s, 0),
+            "engine_vs_raw": round(eng_tok_s / raw_tok_s, 3),
+            "decode_step_ms": round(raw_step_s * 1e3, 3),
+            "decode_hbm_bw_pct": round(bw_util * 100, 1),
+            f"prefill_ms_b{args.admit_cap}": round(prefill_s * 1e3, 1),
+            "prefill_mfu_pct": round(mfu * 100, 1),
+            "batch_slots": B,
+            "decode_chunk": args.decode_chunk,
             "prefill_len": S,
-            "prefill_steady_ms": round(prefill_steady_ms, 1),
-            "qps_equiv_16tok": round(tok_s / 16, 1),
-            "params_gb": round(
-                sum(x.size * x.dtype.itemsize for x in jax.tree.leaves(params)) / 1e9, 2
-            ),
+            "new_tokens": args.new_tokens,
+            "requests": done,
+            "clients": args.clients,
+            "params_b": round(n_params / 1e9, 2),
             "init_s": round(init_s, 1),
-            "first_prefill_s": round(prefill_s, 1),
             "device": jax.devices()[0].device_kind,
-            "target_note": "vs_baseline = tok_s / 2000 (north-star 1k QPS/chip x 16-tok completions on v5e-8 = 2k tok/s/chip)",
+            "target_note": "vs_baseline = QPS / 1000 (north-star floor: >=1k QPS/chip at 16-tok completions, BASELINE.md)",
         },
     }
 
@@ -137,15 +231,14 @@ def bench_mlp(args) -> dict:
     assert len(outs) == args.requests and outs[0].shape == (cfg.out_dim,)
 
     qps = args.requests / wall
-    lat = np.array(sorted(latencies))
     out = {
         "metric": "mlp_serving_qps_per_chip",
         "value": round(qps, 1),
         "unit": "req/s",
         "vs_baseline": round(qps / 1000.0, 3),
         "detail": {
-            "p50_ms": round(float(lat[int(0.50 * len(lat))]) * 1e3, 3),
-            "p99_ms": round(float(lat[int(0.99 * len(lat))]) * 1e3, 3),
+            "p50_ms": round(_percentile(latencies, 0.50) * 1e3, 3),
+            "p99_ms": round(_percentile(latencies, 0.99) * 1e3, 3),
             "requests": args.requests,
             "platform": rt.platform,
             "device": rt.devices[0].device_kind if rt.devices else None,
@@ -155,18 +248,82 @@ def bench_mlp(args) -> dict:
     return out
 
 
+def bench_greet(args) -> dict:
+    """BASELINE config 1: stock app, GET /greet over real sockets."""
+    import socket
+    import urllib.request
+
+    from gofr_tpu import App
+    from gofr_tpu.config import new_mock_config
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        mport = s.getsockname()[1]
+    app = App(config=new_mock_config({
+        "APP_NAME": "bench", "HTTP_PORT": str(port), "METRICS_PORT": str(mport),
+        "LOG_LEVEL": "ERROR",
+    }))
+    app.get("/greet", lambda ctx: "Hello World!")
+    app.run_in_background()
+    url = f"http://127.0.0.1:{port}/greet"
+
+    lat: list[float] = []
+    lock = threading.Lock()
+
+    def client(n: int):
+        for _ in range(n):
+            t0 = time.perf_counter()
+            with urllib.request.urlopen(url, timeout=5) as r:
+                assert r.status == 200
+                r.read()
+            dt = time.perf_counter() - t0
+            with lock:
+                lat.append(dt)
+
+    nthreads = args.clients
+    per = args.requests // nthreads
+    threads = [threading.Thread(target=client, args=(per,)) for _ in range(nthreads)]
+    t0 = time.perf_counter()
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    wall = time.perf_counter() - t0
+    app.shutdown()
+    qps = per * nthreads / wall
+    return {
+        "metric": "greet_qps_cpu",
+        "value": round(qps, 1),
+        "unit": "req/s",
+        "vs_baseline": 1.0,  # no reference number exists (BASELINE.md: none published; Go toolchain absent)
+        "detail": {
+            "p50_ms": round(_percentile(lat, 0.50) * 1e3, 3),
+            "p99_ms": round(_percentile(lat, 0.99) * 1e3, 3),
+            "requests": per * nthreads,
+            "clients": nthreads,
+        },
+    }
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument(
-        "--model", choices=("gemma2b", "mlp"), default=None,
-        help="default: gemma2b on TPU, mlp on CPU (2B init on CPU is minutes)",
+        "--model", choices=("serving", "mlp", "greet"), default=None,
+        help="default: serving on TPU, mlp on CPU (2B init on CPU is minutes)",
     )
-    # gemma knobs
-    ap.add_argument("--batch", type=int, default=64)
+    # gemma serving knobs
+    ap.add_argument("--batch", type=int, default=64, help="engine slots")
     ap.add_argument("--prefill-len", type=int, default=128)
-    ap.add_argument("--decode-steps", type=int, default=48)
-    # mlp knobs
-    ap.add_argument("--requests", type=int, default=4096)
+    ap.add_argument("--decode-steps", type=int, default=64)
+    ap.add_argument("--decode-chunk", type=int, default=16)
+    ap.add_argument("--admit-cap", type=int, default=32)
+    ap.add_argument("--new-tokens", type=int, default=16)
+    ap.add_argument("--clients", type=int, default=128)
+    # shared knobs
+    ap.add_argument("--requests", type=int, default=None)
     ap.add_argument("--concurrency", type=int, default=512)
     ap.add_argument("--max-batch", type=int, default=64)
     ap.add_argument("--max-inflight", type=int, default=32)
@@ -179,9 +336,13 @@ def main() -> None:
         # The image's platform plugin overrides the env var; force it.
         jax.config.update("jax_platforms", "cpu")
     if args.model is None:
-        args.model = "gemma2b" if jax.default_backend() == "tpu" else "mlp"
+        args.model = "serving" if jax.default_backend() == "tpu" else "mlp"
+    if args.requests is None:
+        args.requests = {"serving": 512, "mlp": 4096, "greet": 2000}[args.model]
 
-    result = bench_gemma2b(args) if args.model == "gemma2b" else bench_mlp(args)
+    result = {
+        "serving": bench_serving, "mlp": bench_mlp, "greet": bench_greet,
+    }[args.model](args)
     print(json.dumps(result))
 
 

@@ -163,6 +163,12 @@ class App:
             self.logger.error(f"migration failed: {e!r}")
             raise
 
+    # ---- external DB injection (externalDB.go:5-12) ----
+    def add_mongo(self, provider) -> None:
+        """Wire a user-constructed Mongo provider: the framework injects
+        logger + metrics, connects it, and exposes it as ctx.mongo."""
+        self.container.add_mongo(provider)
+
     # ---- CRUD (gofr.go:394) ----
     def add_rest_handlers(self, entity_cls) -> None:
         from .crud import register_crud_handlers

@@ -41,6 +41,7 @@ class Container:
         self.redis = None
         self.sql = None
         self.pubsub = None
+        self.mongo = None
         self.tpu_runtime = None
         self.start_time = time.time()
 
@@ -106,6 +107,7 @@ class Container:
         m.new_histogram("app_http_service_response", "outbound http call time s", HTTP_BUCKETS)
         m.new_histogram("app_redis_stats", "redis op time s", DATASOURCE_BUCKETS)
         m.new_histogram("app_sql_stats", "sql op time s", DATASOURCE_BUCKETS)
+        m.new_histogram("app_mongo_stats", "mongo op time s", DATASOURCE_BUCKETS)
         m.new_gauge("app_sql_open_connections", "open sql connections")
         m.new_gauge("app_sql_inuse_connections", "in-use sql connections")
         # TPU datasource metrics (the build's app_tpu_stats analogue of app_sql_stats)
@@ -137,6 +139,8 @@ class Container:
             out["redis"] = self.redis.health_check()
         if self.pubsub is not None:
             out["pubsub"] = self.pubsub.health()
+        if self.mongo is not None:
+            out["mongo"] = self.mongo.health_check()
         if self.tpu_runtime is not None:
             out["tpu"] = self.tpu_runtime.health_check()
         for name, svc in self.services.items():
@@ -170,8 +174,17 @@ class Container:
         assert self.metrics_manager is not None, "metrics not initialized"
         return self.metrics_manager
 
+    def add_mongo(self, provider) -> None:
+        """Wire a user-constructed Mongo provider (externalDB.go:5-12):
+        inject logger/metrics, connect, expose as ctx.mongo."""
+        from ..datasource.mongo import InstrumentedMongo
+
+        db = InstrumentedMongo(provider, self.logger, self.metrics_manager)
+        provider.connect()
+        self.mongo = db
+
     def close(self) -> None:
-        for attr in ("redis", "sql", "pubsub", "tpu_runtime"):
+        for attr in ("redis", "sql", "pubsub", "mongo", "tpu_runtime"):
             ds = getattr(self, attr)
             if ds is not None and hasattr(ds, "close"):
                 try:

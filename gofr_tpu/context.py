@@ -58,6 +58,10 @@ class Context:
         return self.container.sql
 
     @property
+    def mongo(self):
+        return self.container.mongo
+
+    @property
     def metrics(self):
         return self.container.metrics
 

@@ -1,0 +1,252 @@
+"""Mongo datasource: injectable provider + instrumented CRUD surface.
+
+Parity: reference pkg/gofr/datasource/mongo/mongo.go — the driver is NOT
+auto-wired from config; the user constructs a provider and hands it to
+`app.add_mongo(db)` (externalDB.go:5-12), the framework injects logger +
+metrics and calls connect() (UseLogger/UseMetrics/Connect pattern,
+mongo.go:41-74). The CRUD surface matches mongo.go:77-188: find/find_one/
+insert_one/insert_many/update_by_id/update_one/update_many/delete_one/
+delete_many/count_documents/drop_collection, with per-op QueryLog debug +
+`app_mongo_stats` histogram (mongo.go:190-205) and a health check.
+
+No Mongo driver library exists in this image, so the shipped provider is
+`InMemoryMongo`: a real document store speaking the Mongo query subset
+($eq-implicit, $ne/$gt/$gte/$lt/$lte/$in/$nin filters, $set/$inc updates,
+auto _id assignment). It plays the role MiniRedis plays for Redis — the
+dev/test backend behind the same seam a pymongo-backed provider would
+implement in a network-connected deployment.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import uuid
+from typing import Any, Protocol, runtime_checkable
+
+from .. import STATUS_DOWN, STATUS_UP, health
+
+__all__ = ["MongoProvider", "InMemoryMongo", "InstrumentedMongo"]
+
+
+@runtime_checkable
+class MongoProvider(Protocol):
+    """The seam a provider implements (datasource/mongo.go:8-69)."""
+
+    def connect(self) -> None: ...
+    def use_logger(self, logger) -> None: ...
+    def use_metrics(self, metrics) -> None: ...
+    def find(self, collection: str, filter: dict | None = None) -> list[dict]: ...
+    def find_one(self, collection: str, filter: dict | None = None) -> dict | None: ...
+    def insert_one(self, collection: str, document: dict) -> Any: ...
+    def insert_many(self, collection: str, documents: list[dict]) -> list[Any]: ...
+    def update_by_id(self, collection: str, id: Any, update: dict) -> int: ...
+    def update_one(self, collection: str, filter: dict, update: dict) -> int: ...
+    def update_many(self, collection: str, filter: dict, update: dict) -> int: ...
+    def delete_one(self, collection: str, filter: dict) -> int: ...
+    def delete_many(self, collection: str, filter: dict) -> int: ...
+    def count_documents(self, collection: str, filter: dict | None = None) -> int: ...
+    def drop_collection(self, collection: str) -> None: ...
+    def health_check(self) -> dict: ...
+
+
+def _matches(doc: dict, filter: dict | None) -> bool:
+    if not filter:
+        return True
+    for key, cond in filter.items():
+        val = doc.get(key)
+        if isinstance(cond, dict) and any(k.startswith("$") for k in cond):
+            for op, ref in cond.items():
+                try:
+                    ok = {
+                        "$eq": lambda: val == ref,
+                        "$ne": lambda: val != ref,
+                        "$gt": lambda: val is not None and val > ref,
+                        "$gte": lambda: val is not None and val >= ref,
+                        "$lt": lambda: val is not None and val < ref,
+                        "$lte": lambda: val is not None and val <= ref,
+                        "$in": lambda: val in ref,
+                        "$nin": lambda: val not in ref,
+                        "$exists": lambda: (key in doc) == bool(ref),
+                    }[op]()
+                except KeyError:
+                    raise ValueError(f"unsupported mongo operator {op!r}") from None
+                if not ok:
+                    return False
+        elif val != cond:
+            return False
+    return True
+
+
+def _apply_update(doc: dict, update: dict) -> None:
+    if not any(k.startswith("$") for k in update):
+        # replacement semantics (keep _id), as the real driver does
+        _id = doc.get("_id")
+        doc.clear()
+        doc.update(update)
+        doc["_id"] = _id
+        return
+    for op, fields in update.items():
+        if op == "$set":
+            doc.update(fields)
+        elif op == "$inc":
+            for k, v in fields.items():
+                doc[k] = doc.get(k, 0) + v
+        elif op == "$unset":
+            for k in fields:
+                doc.pop(k, None)
+        else:
+            raise ValueError(f"unsupported mongo update operator {op!r}")
+
+
+class InMemoryMongo:
+    """Thread-safe in-process document store implementing MongoProvider."""
+
+    def __init__(self, database: str = "test"):
+        self.database = database
+        self._collections: dict[str, list[dict]] = {}
+        self._lock = threading.RLock()
+        self._connected = False
+
+    def connect(self) -> None:
+        self._connected = True
+
+    def use_logger(self, logger) -> None:
+        pass  # instrumentation lives in InstrumentedMongo
+
+    def use_metrics(self, metrics) -> None:
+        pass
+
+    def _coll(self, name: str) -> list[dict]:
+        return self._collections.setdefault(name, [])
+
+    def find(self, collection: str, filter: dict | None = None) -> list[dict]:
+        with self._lock:
+            return [dict(d) for d in self._coll(collection) if _matches(d, filter)]
+
+    def find_one(self, collection: str, filter: dict | None = None) -> dict | None:
+        with self._lock:
+            for d in self._coll(collection):
+                if _matches(d, filter):
+                    return dict(d)
+        return None
+
+    def insert_one(self, collection: str, document: dict) -> Any:
+        with self._lock:
+            doc = dict(document)
+            doc.setdefault("_id", uuid.uuid4().hex)
+            self._coll(collection).append(doc)
+            return doc["_id"]
+
+    def insert_many(self, collection: str, documents: list[dict]) -> list[Any]:
+        return [self.insert_one(collection, d) for d in documents]
+
+    def update_by_id(self, collection: str, id: Any, update: dict) -> int:
+        return self.update_one(collection, {"_id": id}, update)
+
+    def update_one(self, collection: str, filter: dict, update: dict) -> int:
+        with self._lock:
+            for d in self._coll(collection):
+                if _matches(d, filter):
+                    _apply_update(d, update)
+                    return 1
+        return 0
+
+    def update_many(self, collection: str, filter: dict, update: dict) -> int:
+        n = 0
+        with self._lock:
+            for d in self._coll(collection):
+                if _matches(d, filter):
+                    _apply_update(d, update)
+                    n += 1
+        return n
+
+    def delete_one(self, collection: str, filter: dict) -> int:
+        with self._lock:
+            coll = self._coll(collection)
+            for i, d in enumerate(coll):
+                if _matches(d, filter):
+                    del coll[i]
+                    return 1
+        return 0
+
+    def delete_many(self, collection: str, filter: dict) -> int:
+        with self._lock:
+            coll = self._coll(collection)
+            keep = [d for d in coll if not _matches(d, filter)]
+            n = len(coll) - len(keep)
+            coll[:] = keep
+            return n
+
+    def count_documents(self, collection: str, filter: dict | None = None) -> int:
+        with self._lock:
+            return sum(1 for d in self._coll(collection) if _matches(d, filter))
+
+    def drop_collection(self, collection: str) -> None:
+        with self._lock:
+            self._collections.pop(collection, None)
+
+    def health_check(self) -> dict:
+        with self._lock:
+            stats = {name: len(docs) for name, docs in self._collections.items()}
+        return health(
+            STATUS_UP if self._connected else STATUS_DOWN,
+            backend="mongo-inmemory", database=self.database, collections=stats,
+        )
+
+
+_OPS = (
+    "find", "find_one", "insert_one", "insert_many", "update_by_id",
+    "update_one", "update_many", "delete_one", "delete_many",
+    "count_documents", "drop_collection",
+)
+
+
+class InstrumentedMongo:
+    """Wraps any MongoProvider with QueryLog + app_mongo_stats histogram
+    per operation (mongo.go:190-205). This is what the container stores and
+    what ctx.mongo returns."""
+
+    def __init__(self, provider, logger=None, metrics=None):
+        self._provider = provider
+        self.logger = logger
+        self.metrics = metrics
+        provider.use_logger(logger)
+        provider.use_metrics(metrics)
+
+    def __getattr__(self, name: str):
+        if name not in _OPS:
+            return getattr(self._provider, name)
+        fn = getattr(self._provider, name)
+
+        def wrapped(collection: str, *args, **kwargs):
+            t0 = time.perf_counter()
+            err: Exception | None = None
+            try:
+                return fn(collection, *args, **kwargs)
+            except Exception as e:  # noqa: BLE001
+                err = e
+                raise
+            finally:
+                dt = time.perf_counter() - t0
+                if self.metrics is not None:
+                    self.metrics.record_histogram(
+                        "app_mongo_stats", dt, operation=name, collection=collection
+                    )
+                if self.logger is not None:
+                    self.logger.debug(
+                        {
+                            "type": "mongo", "operation": name,
+                            "collection": collection,
+                            "duration_us": round(dt * 1e6),
+                            **({"error": str(err)} if err else {}),
+                        }
+                    )
+
+        return wrapped
+
+    def health_check(self) -> dict:
+        try:
+            return self._provider.health_check()
+        except Exception as e:  # noqa: BLE001
+            return health(STATUS_DOWN, backend="mongo", error=str(e))

@@ -14,9 +14,11 @@ Backends:
   a sidecar; durable, resumable, multi-process on one host. The at-least-
   once / resume-from-committed-offset semantics mirror Kafka consumer
   groups (SURVEY.md §5 checkpoint/resume analogue).
-- KAFKA/GOOGLE/MQTT — wired when their driver libraries exist in the
-  environment; otherwise construction fails with a clear message (this
-  image ships none of them; the capability surface stays).
+- KAFKA — real broker client speaking the Kafka wire protocol from scratch
+  (kafka.py): batched producer, consumer-group committed offsets, topic
+  admin, health (parity: reference kafka/kafka.go:83-268).
+- GOOGLE/MQTT — need driver libraries absent from this image; construction
+  fails with a clear message (the capability surface stays).
 """
 
 from __future__ import annotations
@@ -297,14 +299,12 @@ def new_pubsub(backend: str, config, logger=None, metrics=None):
             metrics=metrics,
         )
     if backend == "KAFKA":
-        raise RuntimeError(
-            "PUBSUB_BACKEND=KAFKA needs a kafka client library and a broker, "
-            "neither present in this environment; MEMORY and FILE backends "
-            "are built in"
-        )
+        from .kafka import KafkaConfig, KafkaPubSub
+
+        return KafkaPubSub(KafkaConfig(config), logger=logger, metrics=metrics)
     if backend in ("GOOGLE", "MQTT"):
         raise RuntimeError(
             f"PUBSUB_BACKEND={backend} needs its driver library, not present "
-            "in this environment; MEMORY and FILE backends are built in"
+            "in this environment; MEMORY, FILE and KAFKA backends are built in"
         )
     raise RuntimeError(f"unknown PUBSUB_BACKEND {backend!r}")

@@ -1,0 +1,617 @@
+"""Kafka wire protocol: minimal, from-scratch codec.
+
+Implements the subset of the Kafka binary protocol (framing, primitive
+types, and the pre-KIP-98 MessageSet v1 record format) needed for a real
+producer/consumer with durable consumer-group offsets:
+
+  Produce v2, Fetch v2, ListOffsets v1, Metadata v1, OffsetCommit v2,
+  OffsetFetch v1, FindCoordinator v0, CreateTopics v0, DeleteTopics v0.
+
+These are the semantics the reference's segmentio/kafka-go client provides
+to GoFr (reference pkg/gofr/datasource/pubsub/kafka/kafka.go:83-268):
+batched produce, per-topic consumer readers with committed offsets, topic
+create/delete, broker health. Shared by the client (kafka.py) and the
+in-process fake broker used in tests (testutil precedent: MiniRedis).
+
+No code is derived from any Kafka implementation; the codec follows the
+public protocol specification (kafka.apache.org/protocol).
+"""
+
+from __future__ import annotations
+
+import struct
+import zlib
+from dataclasses import dataclass, field
+
+# api_keys
+PRODUCE = 0
+FETCH = 1
+LIST_OFFSETS = 2
+METADATA = 3
+OFFSET_COMMIT = 8
+OFFSET_FETCH = 9
+FIND_COORDINATOR = 10
+CREATE_TOPICS = 19
+DELETE_TOPICS = 20
+
+# error codes (subset)
+NONE = 0
+OFFSET_OUT_OF_RANGE = 1
+UNKNOWN_TOPIC_OR_PARTITION = 3
+NOT_LEADER_FOR_PARTITION = 6
+TOPIC_ALREADY_EXISTS = 36
+
+EARLIEST = -2
+LATEST = -1
+
+
+class Writer:
+    """Big-endian primitive writer."""
+
+    def __init__(self):
+        self._parts: list[bytes] = []
+
+    def i8(self, v: int) -> "Writer":
+        self._parts.append(struct.pack(">b", v))
+        return self
+
+    def i16(self, v: int) -> "Writer":
+        self._parts.append(struct.pack(">h", v))
+        return self
+
+    def i32(self, v: int) -> "Writer":
+        self._parts.append(struct.pack(">i", v))
+        return self
+
+    def u32(self, v: int) -> "Writer":
+        self._parts.append(struct.pack(">I", v))
+        return self
+
+    def i64(self, v: int) -> "Writer":
+        self._parts.append(struct.pack(">q", v))
+        return self
+
+    def string(self, s: str | None) -> "Writer":
+        if s is None:
+            return self.i16(-1)
+        b = s.encode()
+        self.i16(len(b))
+        self._parts.append(b)
+        return self
+
+    def bytes_(self, b: bytes | None) -> "Writer":
+        if b is None:
+            return self.i32(-1)
+        self.i32(len(b))
+        self._parts.append(b)
+        return self
+
+    def raw(self, b: bytes) -> "Writer":
+        self._parts.append(b)
+        return self
+
+    def array(self, items, enc) -> "Writer":
+        self.i32(len(items))
+        for it in items:
+            enc(self, it)
+        return self
+
+    def build(self) -> bytes:
+        return b"".join(self._parts)
+
+
+class Reader:
+    """Big-endian primitive reader."""
+
+    def __init__(self, data: bytes):
+        self.data = data
+        self.pos = 0
+
+    def _take(self, n: int) -> bytes:
+        b = self.data[self.pos : self.pos + n]
+        if len(b) < n:
+            raise EOFError("short kafka frame")
+        self.pos += n
+        return b
+
+    def i8(self) -> int:
+        return struct.unpack(">b", self._take(1))[0]
+
+    def i16(self) -> int:
+        return struct.unpack(">h", self._take(2))[0]
+
+    def i32(self) -> int:
+        return struct.unpack(">i", self._take(4))[0]
+
+    def u32(self) -> int:
+        return struct.unpack(">I", self._take(4))[0]
+
+    def i64(self) -> int:
+        return struct.unpack(">q", self._take(8))[0]
+
+    def string(self) -> str | None:
+        n = self.i16()
+        if n < 0:
+            return None
+        return self._take(n).decode()
+
+    def bytes_(self) -> bytes | None:
+        n = self.i32()
+        if n < 0:
+            return None
+        return self._take(n)
+
+    def array(self, dec) -> list:
+        return [dec(self) for _ in range(self.i32())]
+
+    def remaining(self) -> int:
+        return len(self.data) - self.pos
+
+
+# ---------------------------------------------------------------------------
+# Framing: [i32 size][i16 api_key][i16 api_version][i32 correlation][str client]
+# ---------------------------------------------------------------------------
+
+
+def encode_request(api_key: int, api_version: int, corr_id: int, client_id: str,
+                   body: bytes) -> bytes:
+    w = Writer().i16(api_key).i16(api_version).i32(corr_id).string(client_id).raw(body)
+    payload = w.build()
+    return struct.pack(">i", len(payload)) + payload
+
+
+def encode_response(corr_id: int, body: bytes) -> bytes:
+    payload = struct.pack(">i", corr_id) + body
+    return struct.pack(">i", len(payload)) + payload
+
+
+# ---------------------------------------------------------------------------
+# MessageSet v1 (magic=1): offset i64 | size i32 | crc u32 | magic i8 |
+# attrs i8 | timestamp i64 | key bytes | value bytes. CRC covers magic..end.
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class Record:
+    key: bytes | None
+    value: bytes
+    timestamp: int = -1
+    offset: int = 0
+    headers: dict = field(default_factory=dict)  # carried out-of-band (not in v1 wire)
+
+
+def encode_message_set(records: list[Record]) -> bytes:
+    w = Writer()
+    for r in records:
+        inner = (
+            Writer().i8(1).i8(0).i64(r.timestamp).bytes_(r.key).bytes_(r.value).build()
+        )
+        crc = zlib.crc32(inner) & 0xFFFFFFFF
+        msg = Writer().u32(crc).raw(inner).build()
+        w.i64(r.offset).i32(len(msg)).raw(msg)
+    return w.build()
+
+
+def decode_message_set(data: bytes) -> list[Record]:
+    """Tolerates a trailing partial message (brokers may truncate at
+    max_bytes mid-message; the spec says discard the tail)."""
+    out: list[Record] = []
+    r = Reader(data)
+    while r.remaining() >= 12:
+        try:
+            offset = r.i64()
+            size = r.i32()
+            if r.remaining() < size:
+                break
+            msg = Reader(r._take(size))
+            crc = msg.u32()
+            rest = msg.data[msg.pos :]
+            if zlib.crc32(rest) & 0xFFFFFFFF != crc:
+                raise ValueError("kafka message CRC mismatch")
+            magic = msg.i8()
+            msg.i8()  # attributes (no compression support)
+            ts = msg.i64() if magic >= 1 else -1
+            key = msg.bytes_()
+            value = msg.bytes_()
+            out.append(Record(key=key, value=value or b"", timestamp=ts, offset=offset))
+        except EOFError:
+            break
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Request/response bodies. Encoders build the client->broker body; decoders
+# parse the broker->client body. The fake broker uses the mirror pair.
+# ---------------------------------------------------------------------------
+
+
+def enc_metadata_req(topics: list[str] | None) -> bytes:
+    w = Writer()
+    if topics is None:
+        w.i32(-1)  # all topics
+    else:
+        w.array(topics, lambda w, t: w.string(t))
+    return w.build()
+
+
+def dec_metadata_req(r: Reader) -> list[str] | None:
+    n = r.i32()
+    if n < 0:
+        return None
+    return [r.string() for _ in range(n)]
+
+
+def enc_metadata_resp(brokers, controller_id: int, topics) -> bytes:
+    """brokers: [(node_id, host, port)]; topics: [(err, name, [(perr, pid, leader)])]"""
+    w = Writer()
+    w.array(brokers, lambda w, b: w.i32(b[0]).string(b[1]).i32(b[2]).string(None))
+    w.i32(controller_id)
+
+    def enc_part(w, p):
+        w.i16(p[0]).i32(p[1]).i32(p[2]).array([p[2]], Writer.i32).array([p[2]], Writer.i32)
+
+    w.array(
+        topics,
+        lambda w, t: w.i16(t[0]).string(t[1]).i8(0).array(t[2], enc_part),
+    )
+    return w.build()
+
+
+def dec_metadata_resp(r: Reader) -> dict:
+    brokers = r.array(lambda r: (r.i32(), r.string(), r.i32(), r.string()))
+    controller = r.i32()
+
+    def dec_part(r):
+        err, pid, leader = r.i16(), r.i32(), r.i32()
+        r.array(Reader.i32)  # replicas
+        r.array(Reader.i32)  # isr
+        return {"error": err, "id": pid, "leader": leader}
+
+    topics = r.array(
+        lambda r: {
+            "error": r.i16(),
+            "name": r.string(),
+            "internal": r.i8(),
+            "partitions": r.array(dec_part),
+        }
+    )
+    return {
+        "brokers": {b[0]: (b[1], b[2]) for b in brokers},
+        "controller": controller,
+        "topics": {t["name"]: t for t in topics},
+    }
+
+
+def enc_produce_req(acks: int, timeout_ms: int,
+                    topics: dict[str, dict[int, bytes]]) -> bytes:
+    w = Writer().i16(acks).i32(timeout_ms)
+    w.array(
+        list(topics.items()),
+        lambda w, kv: w.string(kv[0]).array(
+            list(kv[1].items()), lambda w, pv: w.i32(pv[0]).bytes_(pv[1])
+        ),
+    )
+    return w.build()
+
+
+def dec_produce_req(r: Reader) -> tuple[int, int, dict[str, dict[int, bytes]]]:
+    acks, timeout = r.i16(), r.i32()
+    topics: dict[str, dict[int, bytes]] = {}
+    for _ in range(r.i32()):
+        name = r.string()
+        parts = {}
+        for _ in range(r.i32()):
+            pid = r.i32()
+            parts[pid] = r.bytes_() or b""
+        topics[name] = parts
+    return acks, timeout, topics
+
+
+def enc_produce_resp(topics: dict[str, dict[int, tuple[int, int]]]) -> bytes:
+    """topics: {name: {pid: (error, base_offset)}}"""
+    w = Writer()
+    w.array(
+        list(topics.items()),
+        lambda w, kv: w.string(kv[0]).array(
+            list(kv[1].items()),
+            lambda w, pv: w.i32(pv[0]).i16(pv[1][0]).i64(pv[1][1]).i64(-1),
+        ),
+    )
+    w.i32(0)  # throttle
+    return w.build()
+
+
+def dec_produce_resp(r: Reader) -> dict[str, dict[int, tuple[int, int]]]:
+    out: dict[str, dict[int, tuple[int, int]]] = {}
+    for _ in range(r.i32()):
+        name = r.string()
+        parts = {}
+        for _ in range(r.i32()):
+            pid, err, base = r.i32(), r.i16(), r.i64()
+            r.i64()  # log_append_time
+            parts[pid] = (err, base)
+        out[name] = parts
+    return out
+
+
+def enc_fetch_req(max_wait_ms: int, min_bytes: int,
+                  topics: dict[str, dict[int, tuple[int, int]]]) -> bytes:
+    """topics: {name: {pid: (offset, max_bytes)}}"""
+    w = Writer().i32(-1).i32(max_wait_ms).i32(min_bytes)
+    w.array(
+        list(topics.items()),
+        lambda w, kv: w.string(kv[0]).array(
+            list(kv[1].items()),
+            lambda w, pv: w.i32(pv[0]).i64(pv[1][0]).i32(pv[1][1]),
+        ),
+    )
+    return w.build()
+
+
+def dec_fetch_req(r: Reader) -> dict[str, dict[int, tuple[int, int]]]:
+    r.i32()  # replica_id
+    r.i32()  # max_wait
+    r.i32()  # min_bytes
+    topics: dict[str, dict[int, tuple[int, int]]] = {}
+    for _ in range(r.i32()):
+        name = r.string()
+        parts = {}
+        for _ in range(r.i32()):
+            pid = r.i32()
+            parts[pid] = (r.i64(), r.i32())
+        topics[name] = parts
+    return topics
+
+
+def enc_fetch_resp(topics: dict[str, dict[int, tuple[int, int, bytes]]]) -> bytes:
+    """topics: {name: {pid: (error, high_watermark, record_set)}}"""
+    w = Writer().i32(0)  # throttle
+    w.array(
+        list(topics.items()),
+        lambda w, kv: w.string(kv[0]).array(
+            list(kv[1].items()),
+            lambda w, pv: w.i32(pv[0]).i16(pv[1][0]).i64(pv[1][1]).bytes_(pv[1][2]),
+        ),
+    )
+    return w.build()
+
+
+def dec_fetch_resp(r: Reader) -> dict[str, dict[int, dict]]:
+    r.i32()  # throttle
+    out: dict[str, dict[int, dict]] = {}
+    for _ in range(r.i32()):
+        name = r.string()
+        parts = {}
+        for _ in range(r.i32()):
+            pid = r.i32()
+            parts[pid] = {
+                "error": r.i16(),
+                "high_watermark": r.i64(),
+                "records": r.bytes_() or b"",
+            }
+        out[name] = parts
+    return out
+
+
+def enc_list_offsets_req(topics: dict[str, dict[int, int]]) -> bytes:
+    """topics: {name: {pid: timestamp}} (EARLIEST/LATEST)"""
+    w = Writer().i32(-1)
+    w.array(
+        list(topics.items()),
+        lambda w, kv: w.string(kv[0]).array(
+            list(kv[1].items()), lambda w, pv: w.i32(pv[0]).i64(pv[1])
+        ),
+    )
+    return w.build()
+
+
+def dec_list_offsets_req(r: Reader) -> dict[str, dict[int, int]]:
+    r.i32()
+    topics: dict[str, dict[int, int]] = {}
+    for _ in range(r.i32()):
+        name = r.string()
+        parts = {}
+        for _ in range(r.i32()):
+            pid = r.i32()
+            parts[pid] = r.i64()
+        topics[name] = parts
+    return topics
+
+
+def enc_list_offsets_resp(topics: dict[str, dict[int, tuple[int, int]]]) -> bytes:
+    """topics: {name: {pid: (error, offset)}}"""
+    w = Writer()
+    w.array(
+        list(topics.items()),
+        lambda w, kv: w.string(kv[0]).array(
+            list(kv[1].items()),
+            lambda w, pv: w.i32(pv[0]).i16(pv[1][0]).i64(-1).i64(pv[1][1]),
+        ),
+    )
+    return w.build()
+
+
+def dec_list_offsets_resp(r: Reader) -> dict[str, dict[int, tuple[int, int]]]:
+    out: dict[str, dict[int, tuple[int, int]]] = {}
+    for _ in range(r.i32()):
+        name = r.string()
+        parts = {}
+        for _ in range(r.i32()):
+            pid, err = r.i32(), r.i16()
+            r.i64()  # timestamp
+            parts[pid] = (err, r.i64())
+        out[name] = parts
+    return out
+
+
+def enc_offset_commit_req(group: str, topics: dict[str, dict[int, int]]) -> bytes:
+    """v2, group-less 'simple consumer' commit: generation -1, member ''."""
+    w = Writer().string(group).i32(-1).string("").i64(-1)
+    w.array(
+        list(topics.items()),
+        lambda w, kv: w.string(kv[0]).array(
+            list(kv[1].items()),
+            lambda w, pv: w.i32(pv[0]).i64(pv[1]).string(None),
+        ),
+    )
+    return w.build()
+
+
+def dec_offset_commit_req(r: Reader) -> tuple[str, dict[str, dict[int, int]]]:
+    group = r.string()
+    r.i32()  # generation
+    r.string()  # member
+    r.i64()  # retention
+    topics: dict[str, dict[int, int]] = {}
+    for _ in range(r.i32()):
+        name = r.string()
+        parts = {}
+        for _ in range(r.i32()):
+            pid = r.i32()
+            parts[pid] = r.i64()
+            r.string()  # metadata
+        topics[name] = parts
+    return group, topics
+
+
+def enc_offset_commit_resp(topics: dict[str, dict[int, int]]) -> bytes:
+    """topics: {name: {pid: error}}"""
+    w = Writer()
+    w.array(
+        list(topics.items()),
+        lambda w, kv: w.string(kv[0]).array(
+            list(kv[1].items()), lambda w, pv: w.i32(pv[0]).i16(pv[1])
+        ),
+    )
+    return w.build()
+
+
+def dec_offset_commit_resp(r: Reader) -> dict[str, dict[int, int]]:
+    out: dict[str, dict[int, int]] = {}
+    for _ in range(r.i32()):
+        name = r.string()
+        parts = {}
+        for _ in range(r.i32()):
+            pid = r.i32()
+            parts[pid] = r.i16()
+        out[name] = parts
+    return out
+
+
+def enc_offset_fetch_req(group: str, topics: dict[str, list[int]]) -> bytes:
+    w = Writer().string(group)
+    w.array(
+        list(topics.items()),
+        lambda w, kv: w.string(kv[0]).array(kv[1], Writer.i32),
+    )
+    return w.build()
+
+
+def dec_offset_fetch_req(r: Reader) -> tuple[str, dict[str, list[int]]]:
+    group = r.string()
+    topics: dict[str, list[int]] = {}
+    for _ in range(r.i32()):
+        name = r.string()
+        topics[name] = r.array(Reader.i32)
+    return group, topics
+
+
+def enc_offset_fetch_resp(topics: dict[str, dict[int, tuple[int, int]]]) -> bytes:
+    """topics: {name: {pid: (offset, error)}} — offset -1 = none committed"""
+    w = Writer()
+    w.array(
+        list(topics.items()),
+        lambda w, kv: w.string(kv[0]).array(
+            list(kv[1].items()),
+            lambda w, pv: w.i32(pv[0]).i64(pv[1][0]).string(None).i16(pv[1][1]),
+        ),
+    )
+    return w.build()
+
+
+def dec_offset_fetch_resp(r: Reader) -> dict[str, dict[int, tuple[int, int]]]:
+    out: dict[str, dict[int, tuple[int, int]]] = {}
+    for _ in range(r.i32()):
+        name = r.string()
+        parts = {}
+        for _ in range(r.i32()):
+            pid, off = r.i32(), r.i64()
+            r.string()  # metadata
+            parts[pid] = (off, r.i16())
+        out[name] = parts
+    return out
+
+
+def enc_find_coordinator_req(group: str) -> bytes:
+    return Writer().string(group).build()
+
+
+def dec_find_coordinator_req(r: Reader) -> str:
+    return r.string()
+
+
+def enc_find_coordinator_resp(error: int, node_id: int, host: str, port: int) -> bytes:
+    return Writer().i16(error).i32(node_id).string(host).i32(port).build()
+
+
+def dec_find_coordinator_resp(r: Reader) -> tuple[int, int, str, int]:
+    return r.i16(), r.i32(), r.string(), r.i32()
+
+
+def enc_create_topics_req(topics: dict[str, int], timeout_ms: int = 5000) -> bytes:
+    """topics: {name: num_partitions}"""
+    w = Writer()
+    w.array(
+        list(topics.items()),
+        lambda w, kv: w.string(kv[0]).i32(kv[1]).i16(1).i32(0).i32(0),
+    )
+    w.i32(timeout_ms)
+    return w.build()
+
+
+def dec_create_topics_req(r: Reader) -> dict[str, int]:
+    topics: dict[str, int] = {}
+    for _ in range(r.i32()):
+        name = r.string()
+        nparts = r.i32()
+        r.i16()  # replication
+        r.i32()  # assignments (empty)
+        r.i32()  # configs (empty)
+        topics[name] = nparts
+    r.i32()  # timeout
+    return topics
+
+
+def enc_create_topics_resp(topics: dict[str, int]) -> bytes:
+    """topics: {name: error}"""
+    w = Writer()
+    w.array(list(topics.items()), lambda w, kv: w.string(kv[0]).i16(kv[1]))
+    return w.build()
+
+
+def dec_create_topics_resp(r: Reader) -> dict[str, int]:
+    return {name: err for name, err in (
+        (r.string(), r.i16()) for _ in range(r.i32())
+    )}
+
+
+def enc_delete_topics_req(topics: list[str], timeout_ms: int = 5000) -> bytes:
+    return Writer().array(topics, lambda w, t: w.string(t)).i32(timeout_ms).build()
+
+
+def dec_delete_topics_req(r: Reader) -> list[str]:
+    topics = r.array(Reader.string)
+    r.i32()
+    return topics
+
+
+def enc_delete_topics_resp(topics: dict[str, int]) -> bytes:
+    w = Writer()
+    w.array(list(topics.items()), lambda w, kv: w.string(kv[0]).i16(kv[1]))
+    return w.build()
+
+
+def dec_delete_topics_resp(r: Reader) -> dict[str, int]:
+    return {name: err for name, err in (
+        (r.string(), r.i16()) for _ in range(r.i32())
+    )}

@@ -6,21 +6,29 @@ reference repo — this is the TPU-native replacement for its goroutine-per-
 request model at the model-serving layer (SURVEY.md §7 hard part 5:
 "continuous batching / slot-based scheduler is the real design problem").
 
-Design (all shapes static; one compiled executable per op):
+Design (all shapes static; a bounded set of compiled executables):
 
 - **Slots.** A fixed decode batch of S slots with one persistent KV cache
-  [n_layers, S, max_seq_len, hkv, hd] on device. Every decode step advances
-  ALL slots in one `decode_step`; inactive slots are masked (their cursor is
-  pinned to 0 so they never overflow and their tokens are discarded).
+  [n_layers, S, max_seq_len, hkv, hd] on device. Inactive slots are masked
+  (their cursor stays pinned so they never overflow; their tokens are
+  discarded on host).
+- **Fused decode chunks.** Decode advances ALL slots K steps per dispatch
+  (`decode_chunk`, a lax.scan over decode_step with on-device sampling).
+  One host→device dispatch per K tokens amortizes dispatch latency — the
+  dominant cost at decode's arithmetic intensity — and the engine keeps up
+  to `lookahead` chunks in flight, chaining the next chunk's input tokens
+  from the previous chunk's on-device output so the device never waits for
+  host readback (the host processes chunk N while the device runs N+1).
 - **Admission.** Waiting requests are prefilled in length-bucketed batches
-  (powers of two), then their KV rows are inserted into free slots via
-  jitted dynamic_update_slice on the batch axis — the running decode batch
-  never recompiles as traffic changes.
-- **On-device sampling.** The decode wrapper samples (greedy or temperature)
-  on device and returns only the S int32 token ids, so the host loop syncs
-  one tiny transfer per step instead of a [S, vocab] logits matrix.
+  (powers-of-two capped at `admit_cap`), sampled on device (token #1 honors
+  the request temperature), then their KV rows are copied into free slots
+  via ONE jitted insert-many (scan of dynamic_update_slice) — the running
+  decode batch never recompiles as traffic changes. Admission first drains
+  in-flight chunks so the next dispatch sees a host-merged token vector.
+- **On-device sampling.** Greedy or temperature sampling happens inside the
+  chunk; the host syncs one [K, S] int32 array per chunk instead of logits.
 - **Streaming.** Each request owns a thread-safe queue; the engine thread
-  pushes tokens as they decode; consumers iterate stream() (sync) or
+  pushes tokens as chunks complete; consumers iterate stream() (sync) or
   astream() (async) and detach by cancelling — a detached request just
   frees its slot, never stalling the batch (same contract as the TPU
   datasource batcher).
@@ -36,6 +44,7 @@ import itertools
 import queue
 import threading
 import time
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, Iterator
 
@@ -91,9 +100,12 @@ class LLMEngine:
         cfg,
         params,
         *,
-        slots: int = 8,
+        slots: int = 32,
         max_seq_len: int = 512,
         prefill_buckets: tuple[int, ...] = (16, 64, 128),
+        decode_chunk: int = 8,
+        lookahead: int = 2,
+        admit_cap: int = 8,
         mesh=None,
         param_specs: Any = None,
         logger=None,
@@ -109,6 +121,9 @@ class LLMEngine:
         self.slots = slots
         self.max_seq_len = max_seq_len
         self.prefill_buckets = tuple(sorted(b for b in prefill_buckets if b <= max_seq_len))
+        self.decode_chunk = decode_chunk
+        self.lookahead = max(1, lookahead)
+        self.admit_cap = min(admit_cap, slots)
         self.logger = logger
         self.metrics = metrics
         if mesh is not None and param_specs is not None:
@@ -119,65 +134,95 @@ class LLMEngine:
             params = jax.device_put(params)
         self.params = params
 
-        # -- jitted programs ---------------------------------------------
-        def _prefill(params, tokens, lengths):
-            last_logits, cache = prefill(params, cfg, tokens, lengths, max_seq_len)
-            return last_logits, cache
+        # -- jitted programs (one dispatch each) --------------------------
+        topk = min(64, cfg.vocab_size)
 
-        def _decode(params, tokens, cache, active, temps, rng):
-            logits, new_cache = decode_step(params, cfg, tokens, cache)
+        def _sample(logits, temps, key):
+            """Greedy for temp==0; temperature sampling restricted to the
+            top-k logits otherwise. Full-vocab categorical would generate
+            batch x vocab Gumbel draws per step (millions of threefry
+            rounds for a 256k vocab) and dominates decode time; top-k keeps
+            the RNG work at batch x 64."""
             greedy = jnp.argmax(logits, axis=-1)
-            sampled = jax.random.categorical(
-                rng, logits / jnp.maximum(temps, 1e-4)[:, None], axis=-1
+            topv, topi = jax.lax.approx_max_k(logits, topk)
+            local = jax.random.categorical(
+                key, topv / jnp.maximum(temps, 1e-4)[:, None], axis=-1
             )
-            next_tok = jnp.where(temps > 0.0, sampled, greedy).astype(jnp.int32)
-            # inactive slots: pin cursor to 0 so they never hit the cache
-            # edge (decode_step docstring precondition), discard their token
-            new_length = jnp.where(active, new_cache.length, 0)
-            return next_tok, new_cache._replace(length=new_length)
-
-        def _insert(slot_cache, new_cache, slot_idx, row):
-            # copy row `row` of a prefill cache into slot `slot_idx`
-            k = jax.lax.dynamic_update_slice(
-                slot_cache.k,
-                jax.lax.dynamic_slice_in_dim(new_cache.k, row, 1, axis=1),
-                (0, slot_idx, 0, 0, 0),
-            )
-            v = jax.lax.dynamic_update_slice(
-                slot_cache.v,
-                jax.lax.dynamic_slice_in_dim(new_cache.v, row, 1, axis=1),
-                (0, slot_idx, 0, 0, 0),
-            )
-            length = jax.lax.dynamic_update_slice(
-                slot_cache.length,
-                jax.lax.dynamic_slice_in_dim(new_cache.length, row, 1, axis=0),
-                (slot_idx,),
-            )
-            return slot_cache._replace(k=k, v=v, length=length)
-
-        def _first_tok(last_logits, temps, rng):
-            # same sampling semantics as _decode so token #1 honors the
-            # request temperature (greedy only when temps == 0)
-            greedy = jnp.argmax(last_logits, axis=-1)
-            sampled = jax.random.categorical(
-                rng, last_logits / jnp.maximum(temps, 1e-4)[:, None], axis=-1
-            )
+            sampled = jnp.take_along_axis(topi, local[:, None], axis=1)[:, 0]
             return jnp.where(temps > 0.0, sampled, greedy).astype(jnp.int32)
 
-        self._prefill = jax.jit(_prefill)
-        self._first_tok = jax.jit(_first_tok)
-        self._decode = jax.jit(_decode)
-        self._insert = jax.jit(_insert)
+        def _prefill_op(params, tokens, lengths, temps, rng):
+            last_logits, cache = prefill(params, cfg, tokens, lengths, max_seq_len)
+            rng, sub = jax.random.split(rng)
+            first = _sample(last_logits, temps, sub)
+            return first, cache, rng
+
+        K = decode_chunk
+
+        def _chunk_op(params, tokens, cache, active, temps, rng):
+            """K decode steps fused in one executable. Slots advance only
+            while `live` (active AND below cache capacity); frozen slots
+            keep their cursor and re-emit their input token (discarded by
+            the host)."""
+            rng, sub = jax.random.split(rng)
+            keys = jax.random.split(sub, K)
+
+            def body(carry, key):
+                tok, cache = carry
+                live = active & (cache.length < max_seq_len)
+                logits, new_cache = decode_step(params, cfg, tok, cache)
+                nt = _sample(logits, temps, key)
+                nt = jnp.where(live, nt, tok)
+                new_len = jnp.where(live, new_cache.length, cache.length)
+                return (nt, new_cache._replace(length=new_len)), nt
+
+            (last, cache), toks = jax.lax.scan(body, (tokens, cache), keys)
+            return toks, last, cache, rng
+
+        M = self.admit_cap
+
+        def _insert_many(slot_cache, new_cache, slot_idx, rows):
+            """Copy new_cache row rows[i] into slot slot_idx[i] for i < M.
+            Padding entries duplicate entry 0 (idempotent rewrite)."""
+
+            def body(c, xs):
+                si, row = xs
+                k = jax.lax.dynamic_update_slice(
+                    c.k,
+                    jax.lax.dynamic_slice_in_dim(new_cache.k, row, 1, axis=1),
+                    (0, si, 0, 0, 0),
+                )
+                v = jax.lax.dynamic_update_slice(
+                    c.v,
+                    jax.lax.dynamic_slice_in_dim(new_cache.v, row, 1, axis=1),
+                    (0, si, 0, 0, 0),
+                )
+                length = jax.lax.dynamic_update_slice(
+                    c.length,
+                    jax.lax.dynamic_slice_in_dim(new_cache.length, row, 1, axis=0),
+                    (si,),
+                )
+                return c._replace(k=k, v=v, length=length), None
+
+            cache, _ = jax.lax.scan(body, slot_cache, (slot_idx, rows))
+            return cache
+
+        self._prefill_op = jax.jit(_prefill_op)
+        self._chunk_op = jax.jit(_chunk_op, donate_argnums=(2,))
+        self._insert_many = jax.jit(_insert_many, donate_argnums=(0,))
         self._rng = jax.random.PRNGKey(0)
-        self._split = jax.jit(lambda k: tuple(jax.random.split(k)))
 
         self.cache = init_cache(cfg, slots, max_seq_len)
-        self.cache = self.cache._replace(length=jnp.zeros((slots,), jnp.int32))
         self._slot_req: list[GenRequest | None] = [None] * slots
         self._last_tok = np.zeros((slots,), np.int32)
         self._temps = np.zeros((slots,), np.float32)
         self._admit_q: queue.Queue[GenRequest | None] = queue.Queue()
         self._stop = False
+        # in-flight decode chunks: deque of device [K, S] token arrays,
+        # oldest first; _tail is the newest chunk's on-device last-token
+        # vector (input for a chained speculative dispatch)
+        self._inflight: deque = deque()
+        self._tail = None
         self._jnp = jnp
         self._jax = jax
 
@@ -206,6 +251,8 @@ class LLMEngine:
             "active": sum(r is not None for r in self._slot_req),
             "waiting": self._admit_q.qsize(),
             "max_seq_len": self.max_seq_len,
+            "decode_chunk": self.decode_chunk,
+            "inflight_chunks": len(self._inflight),
         }
 
     def close(self) -> None:
@@ -215,34 +262,31 @@ class LLMEngine:
 
     # -- engine internals -------------------------------------------------
     def _warm(self) -> None:
-        import jax
-
         jnp = self._jnp
         t0 = time.perf_counter()
+        zero_rng = self._rng
         for b in self.prefill_buckets:
             toks = jnp.zeros((1, b), jnp.int32)
             lens = jnp.ones((1,), jnp.int32)
-            _, c = self._prefill(self.params, toks, lens)
-            self.cache = jax.block_until_ready(
-                self._insert(self.cache, c, 0, 0)
-            )
-        self.cache = self.cache._replace(
-            length=jnp.zeros((self.slots,), jnp.int32)
-        )
-        tok, self.cache = self._decode(
+            temps = jnp.zeros((1,), jnp.float32)
+            first, c, _ = self._prefill_op(self.params, toks, lens, temps, zero_rng)
+            idx = jnp.zeros((self.admit_cap,), jnp.int32)
+            self.cache = self._insert_many(self.cache, c, idx, idx)
+        toks, last, self.cache, _ = self._chunk_op(
             self.params,
             jnp.zeros((self.slots,), jnp.int32),
             self.cache,
             jnp.zeros((self.slots,), bool),
             jnp.zeros((self.slots,), jnp.float32),
-            self._rng,
+            zero_rng,
         )
-        jax.block_until_ready(tok)
+        _ = np.asarray(last)  # sync (block_until_ready is unreliable on axon)
         self.cache = self.cache._replace(length=jnp.zeros((self.slots,), jnp.int32))
         if self.logger is not None:
             self.logger.info(
                 f"LLM engine warmed in {time.perf_counter() - t0:.1f}s "
-                f"(buckets {self.prefill_buckets}, slots {self.slots})"
+                f"(buckets {self.prefill_buckets}, slots {self.slots}, "
+                f"chunk {self.decode_chunk})"
             )
 
     def _bucket_for(self, n: int) -> int:
@@ -254,15 +298,20 @@ class LLMEngine:
     def _free_slots(self) -> list[int]:
         return [i for i, r in enumerate(self._slot_req) if r is None]
 
-    def _admit(self) -> None:
-        """Pull waiting requests into free slots, prefilling per bucket."""
+    def _any_active(self) -> bool:
+        return any(r is not None for r in self._slot_req)
+
+    def _admit(self) -> bool:
+        """Pull waiting requests into free slots, prefilling per bucket.
+        Drains in-flight chunks first so the next dispatch starts from a
+        host-merged last-token vector."""
         jnp = self._jnp
         free = self._free_slots()
         pulled: list[GenRequest] = []
-        while free[len(pulled):] :
+        while len(pulled) < len(free):
             try:
                 # Block briefly only when fully idle; stay hot otherwise.
-                idle = all(r is None for r in self._slot_req) and not pulled
+                idle = not self._any_active() and not self._inflight and not pulled
                 req = self._admit_q.get(timeout=0.05) if idle else self._admit_q.get_nowait()
             except queue.Empty:
                 break
@@ -274,44 +323,59 @@ class LLMEngine:
                 continue
             pulled.append(req)
         if not pulled:
-            return
-        # group by bucket to share prefill executions
+            return False
+        self._flush()  # retire-complete + host-known last tokens
+        free = self._free_slots()
+        # group by bucket to share prefill executions; chunks of admit_cap
         by_bucket: dict[int, list[GenRequest]] = {}
         for r in pulled:
             by_bucket.setdefault(self._bucket_for(len(r.prompt_tokens)), []).append(r)
+        by_wave: list[tuple[int, list[GenRequest]]] = []
         for bucket, reqs in by_bucket.items():
-            # batch dim padded to a power of two: bounded executable count
-            # (|buckets| x log2(slots) shapes), never a per-burst compile
-            nb = 1
-            while nb < len(reqs):
-                nb *= 2
+            for i in range(0, len(reqs), self.admit_cap):
+                by_wave.append((bucket, reqs[i : i + self.admit_cap]))
+        for bucket, reqs in by_wave:
+            # batch dim: 1 for lone requests, admit_cap otherwise — two
+            # executables per bucket, never a per-burst compile
+            nb = 1 if len(reqs) == 1 else self.admit_cap
             toks = np.zeros((nb, bucket), np.int32)
             lens = np.ones((nb,), np.int32)  # pad rows: 1 token, discarded
+            temps = np.zeros((nb,), np.float32)
             for j, r in enumerate(reqs):
                 n = len(r.prompt_tokens)
                 toks[j, :n] = r.prompt_tokens
                 lens[j] = n
-            t0 = time.perf_counter()
-            last_logits, new_cache = self._prefill(self.params, toks, lens)
-            temps = np.zeros((nb,), np.float32)
-            for j, r in enumerate(reqs):
                 temps[j] = r.temperature
-            self._rng, sub = self._split(self._rng)
-            first = np.asarray(
-                self._first_tok(last_logits, self._jnp.asarray(temps), sub), np.int32
+            t0 = time.perf_counter()
+            first_dev, new_cache, self._rng = self._prefill_op(
+                self.params, jnp.asarray(toks), jnp.asarray(lens),
+                jnp.asarray(temps), self._rng,
             )
+            first = np.asarray(first_dev)
             if self.metrics is not None:
                 self.metrics.record_histogram(
                     "app_tpu_stats", time.perf_counter() - t0,
                     model="llm", op=f"prefill_{bucket}",
                 )
+            slot_idx = np.zeros((self.admit_cap,), np.int32)
+            rows = np.zeros((self.admit_cap,), np.int32)
+            taken: list[int] = []
             for j, r in enumerate(reqs):
                 slot = free.pop(0)
+                taken.append(slot)
                 self._slot_req[slot] = r
-                self.cache = self._insert(self.cache, new_cache, slot, j)
                 self._last_tok[slot] = first[j]
                 self._temps[slot] = r.temperature
+                slot_idx[j], rows[j] = slot, j
+            # pad entries duplicate entry 0 (idempotent)
+            for j in range(len(reqs), self.admit_cap):
+                slot_idx[j], rows[j] = slot_idx[0], rows[0]
+            self.cache = self._insert_many(
+                self.cache, new_cache, jnp.asarray(slot_idx), jnp.asarray(rows)
+            )
+            for j, slot in enumerate(taken):
                 self._emit(slot, int(first[j]))
+        return True
 
     def _emit(self, slot: int, token: int) -> None:
         r = self._slot_req[slot]
@@ -332,48 +396,75 @@ class LLMEngine:
         self._slot_req[slot] = None
         self._temps[slot] = 0.0
 
-    def _step(self) -> None:
+    def _dispatch(self) -> None:
+        """Launch one decode chunk. The first chunk of a chain starts from
+        the host-merged token vector; subsequent chunks chain from the
+        previous chunk's on-device output, so the device never stalls on
+        host readback."""
         jnp = self._jnp
-        active_mask = np.array([r is not None for r in self._slot_req])
-        if not active_mask.any():
-            return
-        self._rng, sub = self._split(self._rng)
-        t0 = time.perf_counter()
-        tok, self.cache = self._decode(
-            self.params,
-            jnp.asarray(self._last_tok),
-            self.cache,
-            jnp.asarray(active_mask),
-            jnp.asarray(self._temps),
-            sub,
+        src = self._tail if self._tail is not None else jnp.asarray(self._last_tok)
+        active = np.array([r is not None for r in self._slot_req])
+        toks, last, self.cache, self._rng = self._chunk_op(
+            self.params, src, self.cache,
+            jnp.asarray(active), jnp.asarray(self._temps), self._rng,
         )
-        tok_host = np.asarray(tok)
+        self._tail = last
+        self._inflight.append(toks)
+
+    def _process_one(self) -> None:
+        """Read back the oldest in-flight chunk and emit its tokens."""
+        toks_dev = self._inflight.popleft()
+        t0 = time.perf_counter()
+        toks = np.asarray(toks_dev)  # [K, S] — blocks; device runs next chunk
         if self.metrics is not None:
             self.metrics.record_histogram(
-                "app_tpu_stats", time.perf_counter() - t0, model="llm", op="decode"
+                "app_tpu_stats", time.perf_counter() - t0,
+                model="llm", op="decode_chunk",
             )
-        self._last_tok = tok_host.copy()
-        for slot in np.nonzero(active_mask)[0]:
-            r = self._slot_req[slot]
-            if r is None:
-                continue
-            if r.emitted + len(r.prompt_tokens) >= self.max_seq_len - 1:
-                self._retire(int(slot))  # cache capacity guard
-                continue
-            self._emit(int(slot), int(tok_host[slot]))
+        for k in range(toks.shape[0]):
+            for slot in range(self.slots):
+                r = self._slot_req[slot]
+                if r is None:
+                    continue
+                if r.emitted + len(r.prompt_tokens) >= self.max_seq_len - 1:
+                    self._retire(slot)  # cache capacity guard
+                    continue
+                self._emit(slot, int(toks[k, slot]))
+        self._last_tok = toks[-1].copy()
+        if not self._inflight:
+            self._tail = None
+
+    def _flush(self) -> None:
+        while self._inflight:
+            self._process_one()
+        self._tail = None
 
     def _loop(self) -> None:
         while not self._stop:
             try:
                 self._admit()
-                self._step()
+                if self._stop:
+                    break
+                if self._any_active():
+                    if not self._inflight:
+                        self._dispatch()
+                    # speculative chunk: only when no admission is possible
+                    # (otherwise the next loop iteration admits instead)
+                    can_admit = self._admit_q.qsize() > 0 and self._free_slots()
+                    while len(self._inflight) < self.lookahead and not can_admit:
+                        self._dispatch()
+                if self._inflight:
+                    self._process_one()
             except Exception as e:  # noqa: BLE001 — engine must not die silently
                 if self.logger is not None:
                     self.logger.error(f"LLM engine step failed: {e!r}")
+                self._inflight.clear()
+                self._tail = None
                 for slot in range(self.slots):
                     self._retire(slot)
                 time.sleep(0.1)
         # drain
+        self._flush()
         for slot in range(self.slots):
             self._retire(slot)
         while True:

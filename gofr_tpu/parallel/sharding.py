@@ -1,8 +1,8 @@
 """Partition specs for the model zoo.
 
 Megatron-style tensor parallelism over the "model" axis:
-  - wq / w_gate_up: column-parallel (output features sharded)
-  - wo / w_down:    row-parallel (input features sharded)
+  - wq / w_gate / w_up: column-parallel (output features sharded)
+  - wo / w_down:        row-parallel (input features sharded)
   - embed:          vocab-sharded (logit matmul reduces over model axis)
   - norms:          replicated
 KV projections are sharded only when the TP degree divides n_kv_heads —
@@ -40,7 +40,8 @@ def param_specs(cfg: TransformerConfig, mesh: Mesh, *, model_axis: str = "model"
             "wkv": P(None, None, kv),
             "wo": P(None, m, None),
             "mlp_norm": P(None, None),
-            "w_gate_up": P(None, None, m),
+            "w_gate": P(None, None, m),
+            "w_up": P(None, None, m),
             "w_down": P(None, m, None),
         },
     }

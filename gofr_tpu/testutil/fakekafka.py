@@ -1,0 +1,261 @@
+"""In-process fake Kafka broker speaking the real wire protocol.
+
+The test stand-in for a broker, exactly as MiniRedis (testutil) speaks real
+RESP: unit tests drive the from-scratch Kafka client end-to-end over TCP
+without an external service (the reference's CI instead provisions a real
+Kafka container, go.yml:61-77 — this image has none, so the broker is
+in-process). Implements the same API subset the client uses: Produce v2,
+Fetch v2, ListOffsets v1, Metadata v1, OffsetCommit v2, OffsetFetch v1,
+FindCoordinator v0, CreateTopics v0, DeleteTopics v0.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+
+from ..datasource.pubsub import kafkaproto as kp
+
+__all__ = ["FakeKafkaBroker"]
+
+
+class FakeKafkaBroker:
+    """Single-node broker (node_id 0). Topics live in memory as
+    {topic: {partition: [Record]}}; group offsets as {(group, topic, pid)}."""
+
+    def __init__(self, host: str = "127.0.0.1"):
+        self._sock = socket.socket()
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, 0))
+        self._sock.listen(16)
+        self.host = host
+        self.port = self._sock.getsockname()[1]
+        self.node_id = 0
+        self._topics: dict[str, dict[int, list[kp.Record]]] = {}
+        self._group_offsets: dict[tuple[str, str, int], int] = {}
+        self._lock = threading.Lock()
+        self._closed = False
+        # knobs for failure-injection tests
+        self.fail_next_produce: int | None = None
+        self._thread = threading.Thread(target=self._accept_loop, daemon=True)
+        self._thread.start()
+
+    @property
+    def address(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    # -- message log helpers (tests assert against these) ------------------
+    def records(self, topic: str, pid: int = 0) -> list[kp.Record]:
+        with self._lock:
+            return list(self._topics.get(topic, {}).get(pid, []))
+
+    def committed(self, group: str, topic: str, pid: int = 0) -> int | None:
+        with self._lock:
+            return self._group_offsets.get((group, topic, pid))
+
+    def seed(self, topic: str, values: list[bytes], pid: int = 0,
+             partitions: int = 1) -> None:
+        with self._lock:
+            parts = self._topics.setdefault(
+                topic, {p: [] for p in range(partitions)}
+            )
+            log = parts.setdefault(pid, [])
+            base = len(log)
+            for i, v in enumerate(values):
+                log.append(kp.Record(key=None, value=v, offset=base + i))
+
+    def close(self) -> None:
+        self._closed = True
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    # -- server loop -------------------------------------------------------
+    def _accept_loop(self) -> None:
+        while not self._closed:
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            threading.Thread(
+                target=self._serve_conn, args=(conn,), daemon=True
+            ).start()
+
+    def _recv_exact(self, conn: socket.socket, n: int) -> bytes | None:
+        buf = b""
+        while len(buf) < n:
+            try:
+                chunk = conn.recv(n - len(buf))
+            except OSError:
+                return None
+            if not chunk:
+                return None
+            buf += chunk
+        return buf
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        with conn:
+            while not self._closed:
+                head = self._recv_exact(conn, 4)
+                if head is None:
+                    return
+                size = struct.unpack(">i", head)[0]
+                payload = self._recv_exact(conn, size)
+                if payload is None:
+                    return
+                r = kp.Reader(payload)
+                api_key, _api_ver, corr = r.i16(), r.i16(), r.i32()
+                r.string()  # client_id
+                try:
+                    body = self._dispatch(api_key, r)
+                except Exception:  # noqa: BLE001 — a broken frame kills the conn
+                    return
+                try:
+                    conn.sendall(kp.encode_response(corr, body))
+                except OSError:
+                    return
+
+    def _dispatch(self, api_key: int, r: kp.Reader) -> bytes:
+        if api_key == kp.METADATA:
+            return self._metadata(kp.dec_metadata_req(r))
+        if api_key == kp.PRODUCE:
+            return self._produce(*kp.dec_produce_req(r))
+        if api_key == kp.FETCH:
+            return self._fetch(kp.dec_fetch_req(r))
+        if api_key == kp.LIST_OFFSETS:
+            return self._list_offsets(kp.dec_list_offsets_req(r))
+        if api_key == kp.OFFSET_COMMIT:
+            return self._offset_commit(*kp.dec_offset_commit_req(r))
+        if api_key == kp.OFFSET_FETCH:
+            return self._offset_fetch(*kp.dec_offset_fetch_req(r))
+        if api_key == kp.FIND_COORDINATOR:
+            kp.dec_find_coordinator_req(r)
+            return kp.enc_find_coordinator_resp(kp.NONE, self.node_id, self.host, self.port)
+        if api_key == kp.CREATE_TOPICS:
+            return self._create_topics(kp.dec_create_topics_req(r))
+        if api_key == kp.DELETE_TOPICS:
+            return self._delete_topics(kp.dec_delete_topics_req(r))
+        raise ValueError(f"unsupported api_key {api_key}")
+
+    def _metadata(self, want: list[str] | None) -> bytes:
+        with self._lock:
+            names = list(self._topics) if want is None else want
+            topics = []
+            for name in names:
+                parts = self._topics.get(name)
+                if parts is None:
+                    topics.append((kp.UNKNOWN_TOPIC_OR_PARTITION, name, []))
+                else:
+                    topics.append(
+                        (kp.NONE, name, [(kp.NONE, pid, self.node_id) for pid in sorted(parts)])
+                    )
+        return kp.enc_metadata_resp(
+            [(self.node_id, self.host, self.port)], self.node_id, topics
+        )
+
+    def _produce(self, acks: int, _timeout: int,
+                 topics: dict[str, dict[int, bytes]]) -> bytes:
+        resp: dict[str, dict[int, tuple[int, int]]] = {}
+        with self._lock:
+            for name, parts in topics.items():
+                resp[name] = {}
+                for pid, record_set in parts.items():
+                    if self.fail_next_produce is not None:
+                        code, self.fail_next_produce = self.fail_next_produce, None
+                        resp[name][pid] = (code, -1)
+                        continue
+                    tparts = self._topics.get(name)
+                    if tparts is None or pid not in tparts:
+                        resp[name][pid] = (kp.UNKNOWN_TOPIC_OR_PARTITION, -1)
+                        continue
+                    log = tparts[pid]
+                    base = len(log)
+                    for i, rec in enumerate(kp.decode_message_set(record_set)):
+                        rec.offset = base + i
+                        log.append(rec)
+                    resp[name][pid] = (kp.NONE, base)
+        return kp.enc_produce_resp(resp)
+
+    def _fetch(self, topics: dict[str, dict[int, tuple[int, int]]]) -> bytes:
+        resp: dict[str, dict[int, tuple[int, int, bytes]]] = {}
+        with self._lock:
+            for name, parts in topics.items():
+                resp[name] = {}
+                tparts = self._topics.get(name)
+                for pid, (offset, max_bytes) in parts.items():
+                    if tparts is None or pid not in tparts:
+                        resp[name][pid] = (kp.UNKNOWN_TOPIC_OR_PARTITION, -1, b"")
+                        continue
+                    log = tparts[pid]
+                    hw = len(log)
+                    if offset > hw:
+                        resp[name][pid] = (kp.OFFSET_OUT_OF_RANGE, hw, b"")
+                        continue
+                    out, size = [], 0
+                    for rec in log[offset:]:
+                        out.append(rec)
+                        size += len(rec.value) + 34
+                        if size >= max_bytes:
+                            break
+                    resp[name][pid] = (kp.NONE, hw, kp.encode_message_set(out))
+        return kp.enc_fetch_resp(resp)
+
+    def _list_offsets(self, topics: dict[str, dict[int, int]]) -> bytes:
+        resp: dict[str, dict[int, tuple[int, int]]] = {}
+        with self._lock:
+            for name, parts in topics.items():
+                resp[name] = {}
+                tparts = self._topics.get(name)
+                for pid, ts in parts.items():
+                    if tparts is None or pid not in tparts:
+                        resp[name][pid] = (kp.UNKNOWN_TOPIC_OR_PARTITION, -1)
+                    elif ts == kp.EARLIEST:
+                        resp[name][pid] = (kp.NONE, 0)
+                    else:  # LATEST
+                        resp[name][pid] = (kp.NONE, len(tparts[pid]))
+        return kp.enc_list_offsets_resp(resp)
+
+    def _offset_commit(self, group: str,
+                       topics: dict[str, dict[int, int]]) -> bytes:
+        resp: dict[str, dict[int, int]] = {}
+        with self._lock:
+            for name, parts in topics.items():
+                resp[name] = {}
+                for pid, off in parts.items():
+                    self._group_offsets[(group, name, pid)] = off
+                    resp[name][pid] = kp.NONE
+        return kp.enc_offset_commit_resp(resp)
+
+    def _offset_fetch(self, group: str, topics: dict[str, list[int]]) -> bytes:
+        resp: dict[str, dict[int, tuple[int, int]]] = {}
+        with self._lock:
+            for name, pids in topics.items():
+                resp[name] = {
+                    pid: (self._group_offsets.get((group, name, pid), -1), kp.NONE)
+                    for pid in pids
+                }
+        return kp.enc_offset_fetch_resp(resp)
+
+    def _create_topics(self, topics: dict[str, int]) -> bytes:
+        resp: dict[str, int] = {}
+        with self._lock:
+            for name, nparts in topics.items():
+                if name in self._topics:
+                    resp[name] = kp.TOPIC_ALREADY_EXISTS
+                else:
+                    self._topics[name] = {p: [] for p in range(max(1, nparts))}
+                    resp[name] = kp.NONE
+        return kp.enc_create_topics_resp(resp)
+
+    def _delete_topics(self, topics: list[str]) -> bytes:
+        resp: dict[str, int] = {}
+        with self._lock:
+            for name in topics:
+                if name in self._topics:
+                    del self._topics[name]
+                    resp[name] = kp.NONE
+                else:
+                    resp[name] = kp.UNKNOWN_TOPIC_OR_PARTITION
+        return kp.enc_delete_topics_resp(resp)

@@ -1,0 +1,60 @@
+"""Which reduction layout is fast for vocab-axis argmax/top-k on TPU?"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+B, V, K = 64, 256_000, 32
+x = jax.random.normal(jax.random.PRNGKey(0), (B, V), jnp.float32)
+xT = x.T.copy()
+print("device:", jax.devices()[0].device_kind, flush=True)
+
+
+def timed(name, fn, *args):
+    f = jax.jit(fn)
+    out = f(*args)
+    _ = float(np.asarray(jax.tree.leaves(out)[0]).ravel()[0])
+    t0 = time.perf_counter()
+    out = f(*args)
+    _ = float(np.asarray(jax.tree.leaves(out)[0]).ravel()[0])
+    dt = time.perf_counter() - t0
+    print(f"{name:46s} {dt/K*1e3:8.3f} ms/iter", flush=True)
+
+
+def chain(op):
+    def fn(x):
+        def body(x, _):
+            r = op(x)
+            return x + r.astype(jnp.float32).reshape((-1,) + (1,) * (x.ndim - 1))[:1, :1] * 1e-9, None
+
+        x, _ = jax.lax.scan(body, x, None, length=K)
+        return x
+
+    return fn
+
+
+timed("argmax axis=-1  [B,V]", chain(lambda x: jnp.argmax(x, -1)), x)
+timed("max    axis=-1  [B,V]", chain(lambda x: jnp.max(x, -1)), x)
+timed("argmax axis=0   [V,B]", chain(lambda x: jnp.argmax(x, 0)), xT)
+timed("max    axis=0   [V,B]", chain(lambda x: jnp.max(x, 0)), xT)
+timed("approx_max_k=64 [B,V]", chain(lambda x: jax.lax.approx_max_k(x, 64)[0].sum(-1)), x)
+timed(
+    "approx_max_k=64 [V,B] rdim0",
+    chain(lambda x: jax.lax.approx_max_k(x, 64, reduction_dimension=0)[0].sum(0)),
+    xT,
+)
+timed(
+    "2-pass argmax axis=-1 (max+iota-select)",
+    chain(
+        lambda x: jnp.min(
+            jnp.where(x >= jnp.max(x, -1, keepdims=True), jnp.arange(V, dtype=jnp.int32)[None, :], V),
+            axis=-1,
+        )
+    ),
+    x,
+)

@@ -1,0 +1,35 @@
+"""Direct (non-scan) timings of vocab reductions on TPU."""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+B, V = 64, 256_000
+x = jax.random.normal(jax.random.PRNGKey(0), (B, V), jnp.float32)
+xb = x.astype(jnp.bfloat16)
+print("device:", jax.devices()[0].device_kind, flush=True)
+
+
+def timed(name, fn, *args, n=5):
+    f = jax.jit(fn)
+    _ = np.asarray(jax.tree.leaves(f(*args))[0]).ravel()[0]
+    t0 = time.perf_counter()
+    outs = [f(*args) for _ in range(n)]
+    _ = np.asarray(jax.tree.leaves(outs[-1])[0]).ravel()[0]
+    dt = (time.perf_counter() - t0) / n
+    print(f"{name:46s} {dt*1e3:8.2f} ms/call", flush=True)
+
+
+timed("sum axis=-1 f32", lambda x: jnp.sum(x, -1), x)
+timed("max axis=-1 f32", lambda x: jnp.max(x, -1), x)
+timed("argmax axis=-1 f32", lambda x: jnp.argmax(x, -1), x)
+timed("argmax axis=-1 bf16", lambda x: jnp.argmax(x, -1), xb)
+timed("argmax small [64,2048]", lambda x: jnp.argmax(x, -1), x[:, :2048])
+timed("copy (baseline)", lambda x: x * 1.000001, x)
+timed("approx_max_k 64", lambda x: jax.lax.approx_max_k(x, 64), x)
+timed("top_k 64", lambda x: jax.lax.top_k(x, 64), x)

@@ -1,0 +1,150 @@
+"""Decompose the Gemma-2B decode step cost on the real chip.
+
+Each probe runs its op K times inside ONE jitted lax.scan (single dispatch)
+so the tunnel's per-dispatch overhead (~20ms) can't pollute per-step time.
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from gofr_tpu.models import TransformerConfig, init_params
+from gofr_tpu.models.transformer import decode_step, init_cache
+from gofr_tpu.ops import decode_attention
+
+cfg = TransformerConfig.gemma_2b()
+B, MAX, K = 64, 208, 32
+print("device:", jax.devices()[0].device_kind, flush=True)
+
+params = jax.jit(lambda k: init_params(k, cfg))(jax.random.PRNGKey(0))
+_ = float(np.asarray(params["final_norm"])[0])
+
+
+def timed(name, fn, *args):
+    f = jax.jit(fn)
+    out = f(*args)
+    _ = float(np.asarray(jax.tree.leaves(out)[0]).ravel()[0])  # compile+sync
+    t0 = time.perf_counter()
+    out = f(*args)
+    _ = float(np.asarray(jax.tree.leaves(out)[0]).ravel()[0])
+    dt = time.perf_counter() - t0
+    print(f"{name:44s} {dt/K*1e3:8.2f} ms/step   ({dt*1e3:7.1f} ms / {K})", flush=True)
+    return dt / K
+
+
+PROBES = set(sys.argv[1:]) or {"mm", "un", "attn", "sample"}
+t_full = t_mm = t_un = t_at = t_s = 0.0
+
+# 1) full decode chunk (greedy argmax sampling)
+if "full" in PROBES:
+    cache = init_cache(cfg, B, MAX)
+    cache = cache._replace(length=jnp.full((B,), 128, jnp.int32))
+
+    def full_chunk(tok, cache):
+        def body(c, _):
+            tok, cache = c
+            logits, cache = decode_step(params, cfg, tok, cache)
+            return (jnp.argmax(logits, -1).astype(jnp.int32), cache), None
+
+        (tok, cache), _ = jax.lax.scan(body, (tok, cache), None, length=K)
+        return tok, cache
+
+    t_full = timed("full decode chunk", full_chunk, jnp.zeros((B,), jnp.int32), cache)
+
+# 2) weight-stream probe: all per-layer matmuls, no attention/unembed
+layers = params["layers"]
+
+
+def mm_chain(x, layers):
+    def body(x, _):
+        def layer(x, lp):
+            q = x @ lp["wq"]
+            kv = x @ lp["wkv"]
+            o = q @ lp["wo"]
+            g = x @ lp["w_gate_up"]
+            d = (g[:, : cfg.d_ff] * g[:, cfg.d_ff :]) @ lp["w_down"]
+            return (x + o + d + kv.sum() * 0).astype(x.dtype), None
+
+        x, _ = jax.lax.scan(layer, x, layers)
+        return x, None
+
+    x, _ = jax.lax.scan(body, x, None, length=K)
+    return x
+
+
+if "mm" in PROBES:
+    t_mm = timed("per-layer matmuls only", mm_chain, jnp.ones((B, cfg.d_model), cfg.dtype), layers)
+
+# 3) unembed probe
+embed = params["embed"]
+
+
+def unembed_chain(x, embed):
+    def body(x, _):
+        logits = (x @ embed.T.astype(cfg.dtype)).astype(jnp.float32)
+        return (logits[:, : cfg.d_model] * 1e-6).astype(cfg.dtype), None
+
+    x, _ = jax.lax.scan(body, x, None, length=K)
+    return x
+
+
+if "un" in PROBES:
+    t_un = timed("unembed [B,d]@[d,V]", unembed_chain, jnp.ones((B, cfg.d_model), cfg.dtype), embed)
+
+# 4) attention + cache update probe (all layers, scan-stacked like the model)
+kc = jnp.zeros((cfg.n_layers, B, MAX, cfg.n_kv_heads, cfg.head_dim), cfg.dtype)
+vc = jnp.zeros_like(kc)
+lengths = jnp.full((B,), 128, jnp.int32)
+
+
+def attn_chain(state):
+    kc, vc, lengths = state
+    q = jnp.ones((B, 1, cfg.n_heads, cfg.head_dim), cfg.dtype)
+    newk = jnp.ones((B, 1, cfg.n_kv_heads, cfg.head_dim), cfg.dtype)
+
+    def body(state, _):
+        kc, vc, lengths = state
+
+        def layer(carry, layer_kv):
+            kcl, vcl = layer_kv
+            upd = jax.vmap(lambda c, n, i: jax.lax.dynamic_update_slice(c, n, (i, 0, 0)))
+            kcl = upd(kcl, newk, lengths)
+            vcl = upd(vcl, newk, lengths)
+            out = decode_attention(q, kcl, vcl, lengths + 1)
+            return carry + out.sum() * 0, (kcl, vcl)
+
+        _, (kc, vc) = jax.lax.scan(layer, jnp.zeros((), cfg.dtype), (kc, vc))
+        return (kc, vc, lengths + 1), None
+
+    state, _ = jax.lax.scan(body, (kc, vc, lengths), None, length=K)
+    return state
+
+
+if "attn" in PROBES:
+    t_at = timed("attention+cache update (18 layers)", attn_chain, (kc, vc, lengths))
+
+# 5) sampling probe
+logits0 = jax.random.normal(jax.random.PRNGKey(1), (B, cfg.vocab_size), jnp.float32)
+
+
+def sample_chain(logits):
+    def body(logits, _):
+        g = jnp.argmax(logits, -1)
+        tv, ti = jax.lax.approx_max_k(logits, 64)
+        return logits + (g[0] + ti[0, 0]).astype(jnp.float32) * 1e-9, None
+
+    logits, _ = jax.lax.scan(body, logits, None, length=K)
+    return logits
+
+
+if "sample" in PROBES:
+    t_s = timed("argmax + approx_max_k(64)", sample_chain, logits0)
+
+print(f"\nsum of probes: {(t_mm + t_un + t_at + t_s)*1e3:.2f} ms vs full {t_full*1e3:.2f} ms", flush=True)
+params_bytes = sum(x.size * x.dtype.itemsize for x in jax.tree.leaves(params))
+print(f"weights-stream floor: {params_bytes/8.2e11*1e3:.2f} ms/step", flush=True)

@@ -1,0 +1,110 @@
+"""Bisect the decode layer body: which piece doubles the in-situ cost?
+
+Rebuilds the decode chunk with an inline layer body where pieces can be
+toggled: rope, norms, attention, mlp, cache scatter. All probes return
+scalars only (tunnel transfer is ~40MB/s)."""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from gofr_tpu.models import TransformerConfig, init_params
+from gofr_tpu.models.transformer import init_cache
+from gofr_tpu.ops import decode_attention, rms_norm, apply_rope
+
+cfg = TransformerConfig.gemma_2b()
+B, MAX, K = 64, 208, 32
+print("device:", jax.devices()[0].device_kind, flush=True)
+
+params = jax.jit(lambda k: init_params(k, cfg))(jax.random.PRNGKey(0))
+_ = float(np.asarray(params["final_norm"])[0])
+
+
+def make_chunk(rope=True, norms=True, attn=True, mlp=True, qkvo=True):
+    hq, hkv, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+
+    def layer_body(x, lp, k_cache, v_cache, length):
+        b = x.shape[0]
+        h = rms_norm(x, lp["attn_norm"], cfg.norm_eps) if norms else x
+        if qkvo:
+            q = (h @ lp["wq"]).reshape(b, 1, hq, hd)
+            kv = (h @ lp["wkv"]).reshape(b, 1, hkv, 2, hd)
+            k, v = kv[:, :, :, 0], kv[:, :, :, 1]
+        else:
+            q = jnp.ones((b, 1, hq, hd), cfg.dtype)
+            k = v = jnp.ones((b, 1, hkv, hd), cfg.dtype)
+        if rope:
+            pos = length[:, None]
+            q = apply_rope(q, pos, cfg.rope_theta)
+            k = apply_rope(k, pos, cfg.rope_theta)
+        if attn:
+            upd = jax.vmap(lambda c, n, i: jax.lax.dynamic_update_slice(c, n, (i, 0, 0)))
+            k_cache = upd(k_cache, k.astype(k_cache.dtype), length)
+            v_cache = upd(v_cache, v.astype(v_cache.dtype), length)
+            a = decode_attention(q, k_cache, v_cache, length + 1)
+        else:
+            a = jnp.broadcast_to(q, (b, 1, hq, hd))
+        if qkvo:
+            x = x + (a.reshape(b, 1, hq * hd)[:, 0] @ lp["wo"]).astype(x.dtype)
+        else:
+            x = x + a[:, 0, 0, : cfg.d_model].astype(x.dtype) * 0
+        if mlp:
+            h = rms_norm(x, lp["mlp_norm"], cfg.norm_eps) if norms else x
+            x = x + (jax.nn.gelu(h @ lp["w_gate"]) * (h @ lp["w_up"])) @ lp["w_down"]
+        return x, k_cache, v_cache
+
+    def chunk(params, tok, kc, vc, lengths):
+        def body(c, _):
+            tok, kc, vc, lengths = c
+            x = params["embed"][tok[:, None]].astype(cfg.dtype)[:, 0]
+
+            def layer(x, lkv):
+                lp, kcl, vcl = lkv
+                x, nk, nv = layer_body(x, lp, kcl, vcl, lengths)
+                return x, (nk, nv)
+
+            x, (kc, vc) = jax.lax.scan(layer, x, (params["layers"], kc, vc))
+            tok = jnp.argmax(x[:, :128], -1).astype(jnp.int32)
+            return (tok, kc, vc, lengths + 1), None
+
+        (tok, kc, vc, lengths), _ = jax.lax.scan(
+            body, (tok, kc, vc, lengths), None, length=K
+        )
+        return tok.sum()
+
+    return chunk
+
+
+def timed(name, fn, *args):
+    f = jax.jit(fn)
+    _ = float(np.asarray(f(*args)))
+    t0 = time.perf_counter()
+    _ = float(np.asarray(f(*args)))
+    dt = time.perf_counter() - t0
+    print(f"{name:46s} {dt/K*1e3:8.2f} ms/step", flush=True)
+    return dt / K
+
+
+kc0 = jnp.zeros((cfg.n_layers, B, MAX, cfg.n_kv_heads, cfg.head_dim), cfg.dtype)
+lengths0 = jnp.full((B,), 128, jnp.int32)
+tok0 = jnp.zeros((B,), jnp.int32)
+
+variants = {
+    "all on (≈ real body)": dict(),
+    "no rope": dict(rope=False),
+    "no norms": dict(norms=False),
+    "no attn (scatter+attend off)": dict(attn=False),
+    "no mlp": dict(mlp=False),
+    "no rope+norms": dict(rope=False, norms=False),
+    "matmuls only": dict(rope=False, norms=False, attn=False),
+}
+which = set(sys.argv[1:])
+for name, kw in variants.items():
+    if which and not any(w in name for w in which):
+        continue
+    timed(name, make_chunk(**kw), params, tok0, kc0, kc0, lengths0)

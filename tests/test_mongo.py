@@ -1,0 +1,114 @@
+"""Mongo datasource tests: CRUD surface, query/update operators,
+instrumentation, and the app.add_mongo injection seam (parity spec:
+reference datasource/mongo/mongo.go:77-205 + externalDB.go:5-12)."""
+
+import pytest
+
+from gofr_tpu.datasource.mongo import InMemoryMongo, InstrumentedMongo, MongoProvider
+from gofr_tpu.logging import new_logger
+from gofr_tpu.metrics import new_metrics_manager
+
+
+@pytest.fixture()
+def db():
+    m = InMemoryMongo("testdb")
+    m.connect()
+    return m
+
+
+class TestCRUD:
+    def test_insert_and_find(self, db):
+        db.insert_one("users", {"name": "ada", "age": 36})
+        db.insert_one("users", {"name": "alan", "age": 41})
+        assert db.count_documents("users") == 2
+        found = db.find("users", {"name": "ada"})
+        assert len(found) == 1 and found[0]["age"] == 36
+        assert found[0]["_id"]  # auto-assigned
+
+    def test_find_one_missing_returns_none(self, db):
+        assert db.find_one("users", {"name": "nobody"}) is None
+
+    def test_insert_many(self, db):
+        ids = db.insert_many("n", [{"v": i} for i in range(5)])
+        assert len(ids) == 5 and len(set(ids)) == 5
+        assert db.count_documents("n") == 5
+
+    def test_query_operators(self, db):
+        db.insert_many("t", [{"v": i} for i in range(10)])
+        assert db.count_documents("t", {"v": {"$gt": 7}}) == 2
+        assert db.count_documents("t", {"v": {"$gte": 7}}) == 3
+        assert db.count_documents("t", {"v": {"$lt": 2}}) == 2
+        assert db.count_documents("t", {"v": {"$ne": 0}}) == 9
+        assert db.count_documents("t", {"v": {"$in": [1, 3, 99]}}) == 2
+        assert db.count_documents("t", {"v": {"$nin": list(range(8))}}) == 2
+        assert db.count_documents("t", {"w": {"$exists": False}}) == 10
+        with pytest.raises(ValueError, match="unsupported"):
+            db.find("t", {"v": {"$regex": "x"}})
+
+    def test_update_one_set_and_inc(self, db):
+        db.insert_one("c", {"k": "a", "n": 1})
+        assert db.update_one("c", {"k": "a"}, {"$set": {"x": True}, "$inc": {"n": 2}}) == 1
+        doc = db.find_one("c", {"k": "a"})
+        assert doc["x"] is True and doc["n"] == 3
+
+    def test_update_by_id_and_replacement(self, db):
+        _id = db.insert_one("c", {"k": "a"})
+        assert db.update_by_id("c", _id, {"k": "b", "new": 1}) == 1
+        doc = db.find_one("c", {"_id": _id})
+        assert doc["k"] == "b" and doc["new"] == 1 and doc["_id"] == _id
+
+    def test_update_many(self, db):
+        db.insert_many("m", [{"g": 1}, {"g": 1}, {"g": 2}])
+        assert db.update_many("m", {"g": 1}, {"$set": {"seen": True}}) == 2
+
+    def test_delete_one_many(self, db):
+        db.insert_many("d", [{"v": i % 2} for i in range(6)])
+        assert db.delete_one("d", {"v": 0}) == 1
+        assert db.delete_many("d", {"v": 0}) == 2
+        assert db.count_documents("d") == 3
+
+    def test_drop_collection(self, db):
+        db.insert_one("x", {"a": 1})
+        db.drop_collection("x")
+        assert db.count_documents("x") == 0
+
+    def test_health(self, db):
+        db.insert_one("h", {})
+        h = db.health_check()
+        assert h["status"] == "UP" and h["details"]["collections"] == {"h": 1}
+
+    def test_protocol_conformance(self, db):
+        assert isinstance(db, MongoProvider)
+
+
+class TestInstrumentation:
+    def test_metrics_and_logs_recorded(self, db):
+        metrics = new_metrics_manager()
+        metrics.new_histogram("app_mongo_stats", "t", (0.001, 1))
+        wrapped = InstrumentedMongo(db, new_logger(level_name="ERROR"), metrics)
+        wrapped.insert_one("i", {"a": 1})
+        assert wrapped.find("i")[0]["a"] == 1
+        text = metrics.render_prometheus()
+        assert 'app_mongo_stats' in text and 'operation="insert_one"' in text
+
+    def test_error_propagates(self, db):
+        wrapped = InstrumentedMongo(db, None, None)
+        wrapped.insert_one("i", {"v": 1})
+        with pytest.raises(ValueError):
+            wrapped.find("i", {"v": {"$bogus": 1}})
+
+
+class TestAppSeam:
+    def test_add_mongo_wires_ctx_and_health(self):
+        from gofr_tpu.app import App
+        from gofr_tpu.config import new_mock_config
+
+        app = App(config=new_mock_config({"APP_NAME": "t", "LOG_LEVEL": "ERROR"}))
+        provider = InMemoryMongo("appdb")
+        app.add_mongo(provider)
+        assert provider._connected  # framework called connect()
+        c = app.container
+        c.mongo.insert_one("things", {"a": 1})
+        assert c.mongo.count_documents("things") == 1
+        h = c.health()
+        assert h["mongo"]["status"] == "UP"

@@ -86,9 +86,17 @@ class TestBackendSwitch:
         cfg = new_mock_config({"PUBSUB_FILE_DIR": str(tmp_path)})
         assert isinstance(new_pubsub("FILE", cfg), FilePubSub)
 
-    def test_kafka_unavailable_is_clear(self):
-        with pytest.raises(RuntimeError, match="KAFKA"):
-            new_pubsub("KAFKA", new_mock_config({}))
+    def test_kafka_switch_builds_real_client(self):
+        # KAFKA is a real built-in backend now (kafka.py); construction
+        # succeeds without a broker — connections are lazy per call.
+        from gofr_tpu.datasource.pubsub.kafka import KafkaPubSub
+
+        ps = new_pubsub("KAFKA", new_mock_config({"PUBSUB_BROKER": "127.0.0.1:1"}))
+        try:
+            assert isinstance(ps, KafkaPubSub)
+            assert ps.health()["status"] == "DOWN"  # nothing listening
+        finally:
+            ps.close()
 
     def test_unknown_backend(self):
         with pytest.raises(RuntimeError, match="unknown"):
