@@ -271,20 +271,25 @@ def bench_greet(args) -> dict:
     url = f"http://127.0.0.1:{port}/greet"
 
     lat: list[float] = []
+    errors: list[BaseException] = []
     lock = threading.Lock()
 
     def client(n: int):
-        for _ in range(n):
-            t0 = time.perf_counter()
-            with urllib.request.urlopen(url, timeout=5) as r:
-                assert r.status == 200
-                r.read()
-            dt = time.perf_counter() - t0
+        try:
+            for _ in range(n):
+                t0 = time.perf_counter()
+                with urllib.request.urlopen(url, timeout=5) as r:
+                    assert r.status == 200
+                    r.read()
+                dt = time.perf_counter() - t0
+                with lock:
+                    lat.append(dt)
+        except BaseException as e:  # noqa: BLE001 — surface after join
             with lock:
-                lat.append(dt)
+                errors.append(e)
 
-    nthreads = args.clients
-    per = args.requests // nthreads
+    nthreads = min(args.clients, args.requests)
+    per = max(1, args.requests // nthreads)
     threads = [threading.Thread(target=client, args=(per,)) for _ in range(nthreads)]
     t0 = time.perf_counter()
     for th in threads:
@@ -293,6 +298,8 @@ def bench_greet(args) -> dict:
         th.join()
     wall = time.perf_counter() - t0
     app.shutdown()
+    if errors:
+        raise RuntimeError(f"{len(errors)} greet clients failed: {errors[0]!r}")
     qps = per * nthreads / wall
     return {
         "metric": "greet_qps_cpu",

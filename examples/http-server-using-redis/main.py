@@ -1,0 +1,52 @@
+"""examples/http-server-using-redis: Redis-backed key/value routes.
+
+Parity: reference examples/http-server-using-redis/main.go:11-77 —
+POST /redis stores each key/value from the JSON body (with expiry),
+GET /redis/{key} reads one back, GET /redis-pipeline runs several
+commands in one round-trip batch.
+"""
+
+import sys
+
+sys.path.insert(0, "../..")
+
+import gofr_tpu
+
+REDIS_EXPIRY_S = 5 * 60
+
+
+async def redis_set(ctx):
+    data = ctx.bind()
+    if not isinstance(data, dict):
+        raise gofr_tpu.ErrorInvalidParam("body")
+    for key, value in data.items():
+        await ctx.redis.set(key, value, ex=REDIS_EXPIRY_S)
+    return "Successful"
+
+
+async def redis_get(ctx):
+    key = ctx.path_param("key")
+    value = await ctx.redis.get(key)
+    if value is None:
+        raise gofr_tpu.ErrorEntityNotFound("key", key)
+    return {key: value.decode()}
+
+
+async def redis_pipeline(ctx):
+    # several commands in sequence on one connection (hook.go pipeline log)
+    await ctx.redis.set("pipeline-1", "one", ex=REDIS_EXPIRY_S)
+    await ctx.redis.set("pipeline-2", "two", ex=REDIS_EXPIRY_S)
+    values = [await ctx.redis.get(k) for k in ("pipeline-1", "pipeline-2")]
+    return {"values": [v.decode() if v else None for v in values]}
+
+
+def build_app() -> "gofr_tpu.App":
+    app = gofr_tpu.new()
+    app.post("/redis", redis_set)
+    app.get("/redis/{key}", redis_get)
+    app.get("/redis-pipeline", redis_pipeline)
+    return app
+
+
+if __name__ == "__main__":
+    build_app().run()

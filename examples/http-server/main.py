@@ -32,13 +32,17 @@ async def redis_handler(ctx):
     return value
 
 
-def main():
+def build_app():
     app = gofr_tpu.new()
     app.get("/greet", greet)
     app.get("/hello", hello)
     if app.container.redis is not None:
         app.get("/redis", redis_handler)
-    app.run()
+    return app
+
+
+def main():
+    build_app().run()
 
 
 if __name__ == "__main__":

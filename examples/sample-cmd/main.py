@@ -1,0 +1,30 @@
+"""examples/sample-cmd: a CLI app with subcommands.
+
+Parity: reference examples/sample-cmd/main.go:9-21 — `hello` and `params`
+subcommands; flags bind to ctx params (python main.py params -name=Vikash).
+"""
+
+import sys
+
+sys.path.insert(0, "../..")
+
+import gofr_tpu
+
+
+def hello(ctx):
+    return "Hello World!"
+
+
+def params(ctx):
+    return f"Hello {ctx.param('name')}!"
+
+
+def build_app() -> "gofr_tpu.CMDApp":
+    app = gofr_tpu.new_cmd()
+    app.sub_command("hello", hello, description="print a friendly greeting")
+    app.sub_command("params", params, description="greet -name=<who>")
+    return app
+
+
+if __name__ == "__main__":
+    sys.exit(build_app().run())

@@ -1,0 +1,46 @@
+"""examples/using-add-rest-handlers: generated CRUD with a verb override.
+
+Parity: reference examples/using-add-rest-handlers/main.go:8-35 — a `user`
+entity gets POST/GET/GET-by-id/PUT/DELETE generated from its fields, with
+GetAll overridden by the entity's own method.
+"""
+
+import sys
+
+sys.path.insert(0, "../..")
+
+from dataclasses import dataclass
+
+import gofr_tpu
+
+CREATE_TABLE = """CREATE TABLE IF NOT EXISTS user
+(
+    id          int not null primary key,
+    name        varchar(50),
+    age         int,
+    is_employed bool
+)"""
+
+
+@dataclass
+class User:
+    id: int = 0
+    name: str = ""
+    age: int = 0
+    is_employed: bool = False
+
+    # verb override (crud_handlers.go:17-35 interface pattern)
+    @staticmethod
+    def get_all(ctx):
+        return "user GetAll called"
+
+
+def build_app() -> "gofr_tpu.App":
+    app = gofr_tpu.new()
+    app.migrate({1: lambda ds: ds.sql.exec(CREATE_TABLE)})
+    app.add_rest_handlers(User)
+    return app
+
+
+if __name__ == "__main__":
+    build_app().run()

@@ -1,0 +1,42 @@
+"""examples/using-cron-jobs: an in-process cron counter.
+
+Parity: reference examples/using-cron-jobs/main.go:17-37 (AddCronJob every
+minute incrementing a counter). Unlike the reference — which sleeps and
+exits — this app also serves HTTP so the counter is observable at /count
+and the framework routes stay testable.
+"""
+
+import sys
+
+sys.path.insert(0, "../..")
+
+import threading
+
+import gofr_tpu
+
+_count = 0
+_mu = threading.Lock()
+
+
+def count(ctx):
+    global _count
+    with _mu:
+        _count += 1
+        n = _count
+    ctx.logger.info(f"Count: {n}")
+
+
+def get_count(ctx):
+    with _mu:
+        return {"count": _count}
+
+
+def build_app() -> "gofr_tpu.App":
+    app = gofr_tpu.new()
+    app.add_cron_job("* * * * *", "counter", count)
+    app.get("/count", get_count)
+    return app
+
+
+if __name__ == "__main__":
+    build_app().run()

@@ -1,0 +1,57 @@
+"""examples/using-custom-metrics: user-defined metrics for a store.
+
+Parity: reference examples/using-custom-metrics/main.go:19-60 — counter,
+up-down counter, gauge and histogram registered at boot, recorded from
+handlers, exported on the metrics port alongside framework metrics.
+"""
+
+import sys
+
+sys.path.insert(0, "../..")
+
+import time
+
+import gofr_tpu
+
+TRANSACTION_SUCCESS = "transaction_success"
+TRANSACTION_TIME = "transaction_time"
+TOTAL_CREDIT_DAY_SALES = "total_credit_day_sale"
+PRODUCT_STOCK = "product_stock"
+
+
+def transaction(ctx):
+    start = time.perf_counter()
+    # ... transaction logic ...
+    ctx.metrics.increment_counter(TRANSACTION_SUCCESS)
+    ctx.metrics.record_histogram(
+        TRANSACTION_TIME, (time.perf_counter() - start) * 1e3
+    )
+    ctx.metrics.delta_updown_counter(TOTAL_CREDIT_DAY_SALES, 1000, sale_type="credit")
+    ctx.metrics.set_gauge(PRODUCT_STOCK, 10)
+    return "Transaction Successful"
+
+
+def sales_return(ctx):
+    ctx.metrics.delta_updown_counter(
+        TOTAL_CREDIT_DAY_SALES, -1000, sale_type="credit_return"
+    )
+    ctx.metrics.set_gauge(PRODUCT_STOCK, 50)
+    return "Return Successful"
+
+
+def build_app() -> "gofr_tpu.App":
+    app = gofr_tpu.new()
+    m = app.container.metrics
+    m.new_counter(TRANSACTION_SUCCESS, "count of successful transactions")
+    m.new_updown_counter(TOTAL_CREDIT_DAY_SALES, "total credit sales in a day")
+    m.new_gauge(PRODUCT_STOCK, "number of products in stock")
+    m.new_histogram(
+        TRANSACTION_TIME, "time taken by a transaction ms", (5, 10, 15, 20, 25, 35)
+    )
+    app.post("/transaction", transaction)
+    app.post("/return", sales_return)
+    return app
+
+
+if __name__ == "__main__":
+    build_app().run()

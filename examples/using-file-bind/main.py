@@ -1,0 +1,46 @@
+"""examples/using-file-bind: multipart upload bound to a dataclass.
+
+Parity: reference examples/using-file-bind/main.go:14-66 — a zip field
+(form key "upload") unpacked in memory and a generic file field (form key
+"a") read as bytes, both bound via ctx.bind().
+"""
+
+import sys
+
+sys.path.insert(0, "../..")
+
+from dataclasses import dataclass, field
+
+import gofr_tpu
+from gofr_tpu.fileutil import Zip
+from gofr_tpu.http.request import UploadedFile
+
+
+@dataclass
+class Data:
+    # field name is the form key unless `file` metadata overrides it
+    # (reference tag file:"upload" / file:"a")
+    upload: Zip = None
+    a: UploadedFile = None
+
+
+def upload_handler(ctx):
+    d = ctx.bind(Data)
+    if d.upload is None or d.a is None:
+        raise gofr_tpu.ErrorMissingParam("upload", "a")
+    content = d.a.content.decode("utf-8", "replace")
+    return {
+        "zip_entries": sorted(d.upload.files),
+        "file_name": d.a.filename,
+        "file_content": content,
+    }
+
+
+def build_app() -> "gofr_tpu.App":
+    app = gofr_tpu.new()
+    app.post("/upload", upload_handler)
+    return app
+
+
+if __name__ == "__main__":
+    build_app().run()

@@ -1,0 +1,45 @@
+"""examples/using-http-service: inter-service HTTP client.
+
+Parity: reference examples/using-http-service/main.go:13-56 — an outbound
+service registered with a circuit breaker and a custom health endpoint; a
+handler proxies a call through it. The upstream address comes from
+SERVICE_ADDRESS (the reference hardcodes a public API; this image has no
+egress, so tests point it at a local stub).
+"""
+
+import sys
+
+sys.path.insert(0, "../..")
+
+import json
+
+import gofr_tpu
+from gofr_tpu.service import CircuitBreaker, HealthConfig
+
+
+def fact_handler(ctx):
+    svc = ctx.get_http_service("fact-service")
+    resp = svc.get("fact", params={"max_length": ctx.param("max") or "100"})
+    if resp.status_code != 200:
+        raise gofr_tpu.HTTPError(
+            resp.status_code, f"upstream returned {resp.status_code}"
+        )
+    return json.loads(resp.body)
+
+
+def build_app() -> "gofr_tpu.App":
+    app = gofr_tpu.new()
+    address = app.container.config.get_or_default(
+        "SERVICE_ADDRESS", "http://localhost:9000"
+    )
+    app.add_http_service(
+        "fact-service", address,
+        CircuitBreaker(threshold=4, interval=1.0),
+        HealthConfig("breeds"),
+    )
+    app.get("/fact", fact_handler)
+    return app
+
+
+if __name__ == "__main__":
+    build_app().run()

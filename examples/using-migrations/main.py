@@ -1,0 +1,79 @@
+"""examples/using-migrations: schema migrations + employee REST handlers.
+
+Parity: reference examples/using-migrations/main.go:18-78 (Migrate before
+routes; GET /employee?name= and POST /employee over SQL) with the
+timestamped migration map from migrations/all.go.
+"""
+
+import sys
+
+sys.path.insert(0, "../..")
+
+from dataclasses import dataclass
+
+import gofr_tpu
+
+CREATE_TABLE = """CREATE TABLE IF NOT EXISTS employee
+(
+    id             int         not null primary key,
+    name           varchar(50) not null,
+    gender         varchar(6)  not null,
+    contact_number varchar(10) not null
+)"""
+
+
+def create_table_employee(ds):
+    ds.sql.exec(CREATE_TABLE)
+    ds.sql.exec(
+        "INSERT INTO employee (id, name, gender, contact_number) VALUES (?, ?, ?, ?)",
+        1, "Umang", "M", "0987654321",
+    )
+    ds.sql.exec("ALTER TABLE employee ADD dob varchar(11) NULL")
+
+
+def all_migrations() -> dict:
+    # timestamped versions, applied in order (migrations/all.go)
+    return {1708322067: create_table_employee}
+
+
+@dataclass
+class Employee:
+    id: int = 0
+    name: str = ""
+    gender: str = ""
+    contact_number: str = ""
+    dob: str = ""
+
+
+def get_employee(ctx):
+    name = ctx.param("name")
+    if not name:
+        raise gofr_tpu.ErrorMissingParam("name")
+    row = ctx.sql.query_row(
+        "SELECT id, name, gender, contact_number, dob FROM employee WHERE name = ?",
+        name,
+    )
+    if row is None:
+        raise gofr_tpu.ErrorEntityNotFound("employee", name)
+    return Employee(**row)
+
+
+def post_employee(ctx):
+    emp = ctx.bind(Employee)
+    ctx.sql.exec(
+        "INSERT INTO employee (id, name, gender, contact_number, dob) VALUES (?, ?, ?, ?, ?)",
+        emp.id, emp.name, emp.gender, emp.contact_number, emp.dob,
+    )
+    return "successfully posted entity"
+
+
+def build_app() -> "gofr_tpu.App":
+    app = gofr_tpu.new()
+    app.migrate(all_migrations())
+    app.get("/employee", get_employee)
+    app.post("/employee", post_employee)
+    return app
+
+
+if __name__ == "__main__":
+    build_app().run()

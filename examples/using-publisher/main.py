@@ -1,0 +1,38 @@
+"""examples/using-publisher: publish-only app.
+
+Parity: reference examples/using-publisher/main.go:9-63 — POST
+/publish-order and /publish-product push the request body onto their
+topics via ctx.get_publisher(). Backend from PUBSUB_BACKEND (MEMORY dev
+default; FILE durable; KAFKA against a broker).
+"""
+
+import sys
+
+sys.path.insert(0, "../..")
+
+import json
+
+import gofr_tpu
+
+
+async def publish_order(ctx):
+    data = ctx.bind()
+    await ctx.get_publisher().publish("order-logs", json.dumps(data))
+    return "Published"
+
+
+async def publish_product(ctx):
+    data = ctx.bind()
+    await ctx.get_publisher().publish("products", json.dumps(data))
+    return "Published"
+
+
+def build_app() -> "gofr_tpu.App":
+    app = gofr_tpu.new()
+    app.post("/publish-order", publish_order)
+    app.post("/publish-product", publish_product)
+    return app
+
+
+if __name__ == "__main__":
+    build_app().run()

@@ -32,12 +32,16 @@ def seen(ctx):
     return RECEIVED
 
 
-def main():
+def build_app():
     app = gofr_tpu.new()
     app.subscribe("order-logs", on_order)
     app.post("/publish-order", publish_order)
     app.get("/seen", seen)
-    app.run()
+    return app
+
+
+def main():
+    build_app().run()
 
 
 if __name__ == "__main__":

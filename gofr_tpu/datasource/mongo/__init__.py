@@ -19,6 +19,7 @@ implement in a network-connected deployment.
 
 from __future__ import annotations
 
+import copy
 import threading
 import time
 import uuid
@@ -120,20 +121,24 @@ class InMemoryMongo:
     def _coll(self, name: str) -> list[dict]:
         return self._collections.setdefault(name, [])
 
+    # deep copies on both ingress and egress: a real driver round-trips
+    # through BSON, so caller-held documents never alias stored ones
     def find(self, collection: str, filter: dict | None = None) -> list[dict]:
         with self._lock:
-            return [dict(d) for d in self._coll(collection) if _matches(d, filter)]
+            return [
+                copy.deepcopy(d) for d in self._coll(collection) if _matches(d, filter)
+            ]
 
     def find_one(self, collection: str, filter: dict | None = None) -> dict | None:
         with self._lock:
             for d in self._coll(collection):
                 if _matches(d, filter):
-                    return dict(d)
+                    return copy.deepcopy(d)
         return None
 
     def insert_one(self, collection: str, document: dict) -> Any:
         with self._lock:
-            doc = dict(document)
+            doc = copy.deepcopy(document)
             doc.setdefault("_id", uuid.uuid4().hex)
             self._coll(collection).append(doc)
             return doc["_id"]

@@ -139,6 +139,7 @@ class KafkaPubSub(_BasePubSub):
         self._offsets: dict[str, dict[int, int]] = {}
         self._pending: dict[str, collections.deque] = {}
         self._sub_lock = threading.Lock()
+        self._coord: _Broker | None = None
         self._flusher = threading.Thread(
             target=self._flush_loop, name="kafka-flusher", daemon=True
         )
@@ -247,32 +248,68 @@ class KafkaPubSub(_BasePubSub):
             self._buf_bytes = 0
         if not batch:
             return
-        # group by (leader broker) -> {topic: {pid: records}}
-        by_tp: dict[str, dict[int, list[kp.Record]]] = {}
-        for topic, raw in batch:
-            parts = self._partitions(topic)
-            pid = parts[self._rr % len(parts)]
-            self._rr += 1
-            by_tp.setdefault(topic, {}).setdefault(pid, []).append(
-                kp.Record(key=None, value=raw, timestamp=int(time.time() * 1000))
-            )
-        by_leader: dict[_Broker, dict[str, dict[int, bytes]]] = {}
+        # group by (leader broker) -> {topic: {pid: [(topic, raw)]}}
+        by_tp: dict[str, dict[int, list[tuple[str, bytes]]]] = {}
+        try:
+            for topic, raw in batch:
+                parts = self._partitions(topic)
+                pid = parts[self._rr % len(parts)]
+                self._rr += 1
+                by_tp.setdefault(topic, {}).setdefault(pid, []).append((topic, raw))
+        except Exception:
+            self._requeue(batch)  # metadata failure: nothing sent yet
+            raise
+        by_leader: dict[_Broker, dict[str, dict[int, list[tuple[str, bytes]]]]] = {}
         for topic, parts in by_tp.items():
-            for pid, records in parts.items():
-                broker = self._leader(topic, pid)
-                by_leader.setdefault(broker, {}).setdefault(topic, {})[pid] = (
-                    kp.encode_message_set(records)
-                )
+            for pid, originals in parts.items():
+                try:
+                    broker = self._leader(topic, pid)
+                except Exception:
+                    self._requeue(originals)
+                    raise
+                by_leader.setdefault(broker, {}).setdefault(topic, {})[pid] = originals
+        first_err: Exception | None = None
         for broker, topics in by_leader.items():
-            r = broker.call(kp.PRODUCE, 2, kp.enc_produce_req(1, 5000, topics))
-            resp = kp.dec_produce_resp(r)
-            for topic, parts in resp.items():
-                for pid, (err, _base) in parts.items():
-                    if err == kp.NOT_LEADER_FOR_PARTITION:
-                        self._refresh_metadata([topic])
-                        raise KafkaError(err, f"{topic}/{pid}")
-                    if err != kp.NONE:
-                        raise KafkaError(err, f"produce {topic}/{pid}")
+            wire = {
+                t: {
+                    pid: kp.encode_message_set(
+                        [
+                            kp.Record(key=None, value=raw,
+                                      timestamp=int(time.time() * 1000))
+                            for _t, raw in originals
+                        ]
+                    )
+                    for pid, originals in parts.items()
+                }
+                for t, parts in topics.items()
+            }
+            try:
+                r = broker.call(kp.PRODUCE, 2, kp.enc_produce_req(1, 5000, wire))
+                resp = kp.dec_produce_resp(r)
+                for topic, parts in resp.items():
+                    for pid, (err, _base) in parts.items():
+                        if err != kp.NONE:
+                            if err == kp.NOT_LEADER_FOR_PARTITION:
+                                self._refresh_metadata([topic])
+                            # requeue just this partition's messages for retry
+                            self._requeue(topics[topic][pid])
+                            first_err = first_err or KafkaError(
+                                err, f"produce {topic}/{pid}"
+                            )
+            except (OSError, ConnectionError) as e:
+                # transport failure: requeue everything aimed at this broker;
+                # other leaders' sends proceed (at-least-once, never drop)
+                for topic, parts in topics.items():
+                    for originals in parts.values():
+                        self._requeue(originals)
+                first_err = first_err or e
+        if first_err is not None:
+            raise first_err
+
+    def _requeue(self, originals: list[tuple[str, bytes]]) -> None:
+        with self._buf_lock:
+            self._buf = list(originals) + self._buf
+            self._buf_bytes += sum(len(raw) for _t, raw in originals)
 
     # -- consumer ----------------------------------------------------------
     def _init_offsets(self, topic: str) -> None:
@@ -299,13 +336,18 @@ class KafkaPubSub(_BasePubSub):
             self._pending.setdefault(topic, collections.deque())
 
     def _coordinator(self) -> _Broker:
+        # cached — FindCoordinator per commit would double the hot-path RPCs;
+        # invalidated on commit failure (_next_pending's committer)
+        if self._coord is not None:
+            return self._coord
         r = self._bootstrap().call(
             kp.FIND_COORDINATOR, 0, kp.enc_find_coordinator_req(self.cfg.group)
         )
         err, _node, host, port = kp.dec_find_coordinator_resp(r)
         if err != kp.NONE:
             raise KafkaError(err, "find_coordinator")
-        return self._broker_at(host, port)
+        self._coord = self._broker_at(host, port)
+        return self._coord
 
     def _fetch_once(self, topic: str, max_wait_ms: int = 200) -> None:
         with self._sub_lock:
@@ -351,14 +393,18 @@ class KafkaPubSub(_BasePubSub):
         group = self.cfg.group
 
         def committer() -> None:
-            b = self._coordinator()
-            r = b.call(
-                kp.OFFSET_COMMIT, 2,
-                kp.enc_offset_commit_req(group, {topic: {pid: rec.offset + 1}}),
-            )
-            errs = kp.dec_offset_commit_resp(r).get(topic, {})
-            if errs.get(pid, 0) != kp.NONE:
-                raise KafkaError(errs[pid], f"offset_commit {topic}/{pid}")
+            try:
+                b = self._coordinator()
+                r = b.call(
+                    kp.OFFSET_COMMIT, 2,
+                    kp.enc_offset_commit_req(group, {topic: {pid: rec.offset + 1}}),
+                )
+                errs = kp.dec_offset_commit_resp(r).get(topic, {})
+                if errs.get(pid, 0) != kp.NONE:
+                    raise KafkaError(errs[pid], f"offset_commit {topic}/{pid}")
+            except Exception:
+                self._coord = None  # coordinator may have moved; re-resolve
+                raise
 
         if self.metrics is not None:
             self.metrics.increment_counter(

@@ -66,8 +66,7 @@ def mm_chain(x, layers):
             q = x @ lp["wq"]
             kv = x @ lp["wkv"]
             o = q @ lp["wo"]
-            g = x @ lp["w_gate_up"]
-            d = (g[:, : cfg.d_ff] * g[:, cfg.d_ff :]) @ lp["w_down"]
+            d = ((x @ lp["w_gate"]) * (x @ lp["w_up"])) @ lp["w_down"]
             return (x + o + d + kv.sum() * 0).astype(x.dtype), None
 
         x, _ = jax.lax.scan(layer, x, layers)

@@ -187,6 +187,22 @@ class TestKafkaPubSub:
         finally:
             c.close()
 
+    def test_produce_failure_requeues_not_drops(self, broker):
+        """At-least-once: a failed produce puts the batch back in the
+        buffer; the next flush delivers it."""
+        c = make_client(broker, KAFKA_BATCH_SIZE="1000", KAFKA_BATCH_TIMEOUT="60000")
+        try:
+            c.create_topic("t")
+            c.publish_sync("t", b"keep-me")
+            broker.fail_next_produce = kp.NOT_LEADER_FOR_PARTITION
+            with pytest.raises(Exception):
+                c.flush()
+            assert broker.records("t") == []  # send failed...
+            c.flush()  # ...but the message was requeued, not dropped
+            assert [r.value for r in broker.records("t")] == [b"keep-me"]
+        finally:
+            c.close()
+
     def test_async_facade(self, broker):
         c = make_client(broker)
         try:

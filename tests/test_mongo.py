@@ -72,6 +72,17 @@ class TestCRUD:
         db.drop_collection("x")
         assert db.count_documents("x") == 0
 
+    def test_documents_never_alias_store(self, db):
+        """Deep-copy semantics like a real BSON round trip: mutating a
+        returned or inserted document must not change the store."""
+        src = {"tags": ["a"]}
+        db.insert_one("alias", src)
+        src["tags"].append("leaked-in")
+        doc = db.find_one("alias")
+        assert doc["tags"] == ["a"]
+        doc["tags"].append("leaked-out")
+        assert db.find_one("alias")["tags"] == ["a"]
+
     def test_health(self, db):
         db.insert_one("h", {})
         h = db.health_check()
