@@ -124,6 +124,10 @@ class DB:
     """Instrumented SQL handle: every op gets a debug query-log and an
     app_sql_stats histogram sample (db.go:19-58)."""
 
+    # monitor cadence (reference pushes stats + retries every 10 s,
+    # sql.go:91,150); overridable for tests
+    MONITOR_INTERVAL_S = 10.0
+
     def __init__(self, cfg: SQLConfig, logger=None, metrics=None):
         self.cfg = cfg
         self.logger = logger
@@ -133,10 +137,73 @@ class DB:
         self._conns: list = []
         self._lock = threading.Lock()
         self._closed = False
+        self.connected = False
         self._connect_factory = self._make_factory()
-        # eager ping, as the reference does at construction (sql.go:35-69)
-        conn = self._conn()
-        conn.execute("SELECT 1")
+        self._inuse = 0
+        # eager ping as the reference does at construction — but like the
+        # reference, a down database does NOT fail app startup; the monitor
+        # loop keeps retrying in the background (sql.go:91-115)
+        try:
+            self._ping(self._conn())
+            self.connected = True
+        except Exception as e:  # noqa: BLE001
+            if self.logger is not None:
+                self.logger.error(f"could not connect to SQL ({cfg.dsn()}): {e}")
+        self._monitor = threading.Thread(
+            target=self._monitor_loop, name="sql-monitor", daemon=True
+        )
+        self._monitor_wake = threading.Event()
+        self._monitor.start()
+
+    def _monitor_loop(self) -> None:
+        """Background ping/reconnect + connection-stats gauge pusher
+        (parity: sql.go:91-115 retry loop and sql.go:150-163 pushDBMetrics)."""
+        while not self._closed:
+            self._monitor_wake.wait(self.MONITOR_INTERVAL_S)
+            self._monitor_wake.clear()
+            if self._closed:
+                return
+            try:
+                self._ping(self._conn())
+                if not self.connected and self.logger is not None:
+                    self.logger.info(f"connected to SQL ({self.cfg.dsn()})")
+                self.connected = True
+            except Exception as e:  # noqa: BLE001
+                if self.connected and self.logger is not None:
+                    self.logger.error(f"SQL connection lost ({self.cfg.dsn()}): {e}")
+                self.connected = False
+                self._drop_local_conn()
+            if self.metrics is not None:
+                with self._lock:
+                    n = len(self._conns)
+                self.metrics.set_gauge(
+                    "app_sql_open_connections", float(n if self.connected else 0)
+                )
+                # in-use = statements executing right now (db.Stats().InUse
+                # semantics), not pool size
+                self.metrics.set_gauge(
+                    "app_sql_inuse_connections", float(self._inuse)
+                )
+
+    def _ping(self, conn) -> None:
+        """Dialect-aware liveness probe (PEP-249 connections have no
+        .execute; only sqlite3's do)."""
+        if self.cfg.dialect == "sqlite":
+            conn.execute("SELECT 1")
+        else:
+            self._cursor_exec(conn, "SELECT 1", ())
+
+    def _drop_local_conn(self) -> None:
+        conn = getattr(self._local, "conn", None)
+        if conn is not None:
+            self._local.conn = None
+            with self._lock:
+                if conn in self._conns:
+                    self._conns.remove(conn)
+            try:
+                conn.close()
+            except Exception:  # noqa: BLE001
+                pass
 
     # -- connection management -------------------------------------------
     def _make_factory(self) -> Callable:
@@ -218,25 +285,52 @@ class DB:
 
     def _query_on(self, conn, q: str, args: tuple) -> list[dict]:
         t0 = time.perf_counter()
+        with self._lock:
+            self._inuse += 1
         try:
             cur = conn.execute(q, args) if self.cfg.dialect == "sqlite" else self._cursor_exec(conn, q, args)
             cols = [d[0] for d in cur.description] if cur.description else []
             rows = [dict(zip(cols, r)) for r in cur.fetchall()]
             return rows
         except Exception as e:  # noqa: BLE001
+            self._invalidate_if_dead(conn)
             raise ErrorDB(str(e), e) from e
         finally:
+            with self._lock:
+                self._inuse -= 1
             self._observe("query", q, t0)
 
     def _exec_on(self, conn, q: str, args: tuple) -> int:
         t0 = time.perf_counter()
+        with self._lock:
+            self._inuse += 1
         try:
             cur = conn.execute(q, args) if self.cfg.dialect == "sqlite" else self._cursor_exec(conn, q, args)
             return cur.rowcount
         except Exception as e:  # noqa: BLE001
+            self._invalidate_if_dead(conn)
             raise ErrorDB(str(e), e) from e
         finally:
+            with self._lock:
+                self._inuse -= 1
             self._observe("exec", q, t0)
+
+    def _invalidate_if_dead(self, conn) -> None:
+        """After an op failure, probe the connection; drop it if the probe
+        fails too, so the NEXT call transparently reconnects (the statement
+        error itself still propagates to the caller). Roll back first:
+        on postgres an ordinary statement error aborts the transaction and
+        would fail the probe on a perfectly healthy connection."""
+        try:
+            if self.cfg.dialect != "sqlite":
+                try:
+                    conn.rollback()
+                except Exception:  # noqa: BLE001
+                    pass
+            self._ping(conn)
+        except Exception:  # noqa: BLE001
+            self.connected = False
+            self._drop_local_conn()
 
     @staticmethod
     def _cursor_exec(conn, q: str, args: tuple):
@@ -299,6 +393,11 @@ class DB:
 
     def close(self) -> None:
         self._closed = True
+        self._monitor_wake.set()
+        # join before clearing the pool: a monitor tick racing past its
+        # _closed check could otherwise open (and leak) a fresh connection
+        if self._monitor.is_alive() and threading.current_thread() is not self._monitor:
+            self._monitor.join(timeout=5)
         with self._lock:
             for c in self._conns:
                 try:

@@ -160,6 +160,72 @@ class ZipkinExporter(Exporter):
             pass
 
 
+class OTLPHTTPExporter(Exporter):
+    """POSTs OTLP/HTTP JSON (the protocol's documented JSON encoding) to a
+    collector — Jaeger natively ingests OTLP, so this is the build's
+    TRACE_EXPORTER=jaeger path (reference gofr.go:305-311 uses OTLP-gRPC;
+    OTLP/HTTP carries the same payload without a generated-proto dependency)."""
+
+    def __init__(self, endpoint: str, service_name: str):
+        self.endpoint = endpoint  # e.g. http://host:4318/v1/traces
+        self.service_name = service_name
+
+    def export(self, spans: list[Span]) -> None:
+        payload = {
+            "resourceSpans": [
+                {
+                    "resource": {
+                        "attributes": [
+                            {
+                                "key": "service.name",
+                                "value": {"stringValue": self.service_name},
+                            }
+                        ]
+                    },
+                    "scopeSpans": [
+                        {
+                            "scope": {"name": "gofr-tpu"},
+                            "spans": [
+                                {
+                                    "traceId": s.trace_id,
+                                    "spanId": s.span_id,
+                                    **(
+                                        {"parentSpanId": s.parent_id}
+                                        if s.parent_id
+                                        else {}
+                                    ),
+                                    "name": s.name,
+                                    "kind": 2,  # SPAN_KIND_SERVER
+                                    "startTimeUnixNano": str(s.start_ns),
+                                    "endTimeUnixNano": str(s.end_ns or s.start_ns),
+                                    "attributes": [
+                                        {
+                                            "key": str(k),
+                                            "value": {"stringValue": str(v)},
+                                        }
+                                        for k, v in s.attributes.items()
+                                    ],
+                                    "status": {
+                                        "code": 2 if s.status == "ERROR" else 1
+                                    },
+                                }
+                                for s in spans
+                            ],
+                        }
+                    ],
+                }
+            ]
+        }
+        req = urllib.request.Request(
+            self.endpoint,
+            data=json.dumps(payload).encode("utf-8"),
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=5):  # noqa: S310
+            pass
+
+
 class BatchProcessor:
     """Queues ended spans; a daemon thread flushes batches to the exporter.
     Parity: reference batch span processor (gofr.go:318)."""
@@ -245,9 +311,10 @@ def current_span() -> Span | None:
 
 
 def new_tracer(config, logger=None) -> Tracer:
-    """Build tracer from config. TRACE_EXPORTER: zipkin|console|memory|none
-    (reference supports jaeger|zipkin|gofr, gofr.go:305-316; OTLP/jaeger needs
-    a collector lib — zipkin JSON covers the wire-export case here)."""
+    """Build tracer from config. TRACE_EXPORTER switch matches the
+    reference's jaeger|zipkin|gofr (gofr.go:305-316) plus console|memory
+    dev exporters: jaeger/otlp -> OTLP/HTTP JSON, zipkin -> Zipkin-v2 JSON,
+    gofr -> the reference's hosted zipkin-shaped endpoint (exporter.go:36)."""
     name = (config.get("APP_NAME") or "gofr-tpu-app") if config else "gofr-tpu-app"
     exporter_kind = (config.get("TRACE_EXPORTER") or "").lower() if config else ""
     exporter: Exporter | None = None
@@ -255,6 +322,18 @@ def new_tracer(config, logger=None) -> Tracer:
         host = config.get_or_default("TRACER_HOST", "localhost")
         port = config.get_or_default("TRACER_PORT", "9411")
         url = config.get_or_default("TRACER_URL", f"http://{host}:{port}/api/v2/spans")
+        exporter = ZipkinExporter(url, name)
+    elif exporter_kind in ("jaeger", "otlp"):
+        host = config.get_or_default("TRACER_HOST", "localhost")
+        port = config.get_or_default("TRACER_PORT", "4318")
+        url = config.get_or_default(
+            "TRACER_URL", f"http://{host}:{port}/v1/traces"
+        )
+        exporter = OTLPHTTPExporter(url, name)
+    elif exporter_kind == "gofr":
+        url = config.get_or_default(
+            "TRACER_URL", "https://tracer-api.gofr.dev/api/spans"
+        )
         exporter = ZipkinExporter(url, name)
     elif exporter_kind == "console":
         exporter = ConsoleExporter(logger)

@@ -79,3 +79,70 @@ def test_no_exporter_tracer():
     t = gt.new_tracer(cfg)
     s = t.start_span("cheap")
     s.end()  # must not raise
+
+
+class TestExporterSwitch:
+    """TRACE_EXPORTER parity with the reference switch (gofr.go:305-316)."""
+
+    def _collector(self):
+        import http.server
+        import threading
+
+        received = []
+
+        class H(http.server.BaseHTTPRequestHandler):
+            def do_POST(self):
+                import json as _json
+
+                n = int(self.headers.get("Content-Length", 0))
+                received.append((self.path, _json.loads(self.rfile.read(n))))
+                self.send_response(200)
+                self.end_headers()
+
+            def log_message(self, *a):
+                pass
+
+        srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), H)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        return srv, received
+
+    def test_jaeger_otlp_http_export(self):
+        srv, received = self._collector()
+        try:
+            cfg = new_mock_config({
+                "APP_NAME": "otlp-app", "TRACE_EXPORTER": "jaeger",
+                "TRACER_URL": f"http://127.0.0.1:{srv.server_address[1]}/v1/traces",
+            })
+            t = gt.new_tracer(cfg)
+            s = t.start_span("unit-op")
+            s.set_attribute("k", "v")
+            s.end()
+            t._processor._flush()
+            assert received, "collector saw no OTLP payload"
+            path, payload = received[0]
+            assert path == "/v1/traces"
+            rs = payload["resourceSpans"][0]
+            attrs = rs["resource"]["attributes"]
+            assert {"key": "service.name", "value": {"stringValue": "otlp-app"}} in attrs
+            span = rs["scopeSpans"][0]["spans"][0]
+            assert span["name"] == "unit-op" and span["traceId"] == s.trace_id
+            assert {"key": "k", "value": {"stringValue": "v"}} in span["attributes"]
+        finally:
+            srv.shutdown()
+
+    def test_gofr_exporter_is_zipkin_shaped(self):
+        srv, received = self._collector()
+        try:
+            cfg = new_mock_config({
+                "TRACE_EXPORTER": "gofr",
+                "TRACER_URL": f"http://127.0.0.1:{srv.server_address[1]}/api/spans",
+            })
+            t = gt.new_tracer(cfg)
+            t.start_span("gofr-op").end()
+            t._processor._flush()
+            assert received
+            path, payload = received[0]
+            assert path == "/api/spans"
+            assert payload[0]["name"] == "gofr-op" and "traceId" in payload[0]
+        finally:
+            srv.shutdown()
