@@ -2,12 +2,17 @@
 BASELINE.json config 3 ("grpc-server unary + server-streaming Gemma-2B
 decode") through the continuous-batching engine.
 
-GEMMA_PRESET=tiny (default, CI/dev) | 2b | 7b chooses the config; weights
-are randomly initialized (no weight downloads in this environment) — the
-serving path is identical with real checkpoints loaded via orbax.
+Weights: set GEMMA_CKPT to an HF safetensors checkpoint (file or sharded
+dir) or an orbax directory of the native pytree — loaded via
+gofr_tpu.models.checkpoint. Set GEMMA_TOKENIZER (or ship tokenizer.json in
+the checkpoint dir) for text in/out. Without GEMMA_CKPT the model is
+randomly initialized (this environment has no weight downloads) and the API
+still works on raw token ids — the serving path is identical.
+
+GEMMA_PRESET=tiny (default, CI/dev) | 2b | 7b chooses the architecture.
 
 Drive it:
-  unary:  json_unary(target, "Gemma", "Generate", {"tokens": [...], "max_new_tokens": 8})
+  unary:  json_unary(target, "Gemma", "Generate", {"prompt": "...", "max_new_tokens": 8})
   stream: json_server_stream(target, "Gemma", "Stream", {...}) -> one token per chunk
 """
 
@@ -18,8 +23,11 @@ sys.path.insert(0, "../..")
 
 import gofr_tpu
 
+TOKENIZER = None  # set at build time when configured
+
 
 def build_engine(app):
+    global TOKENIZER
     import jax
 
     from gofr_tpu.models import TransformerConfig, init_params
@@ -30,7 +38,27 @@ def build_engine(app):
         "2b": TransformerConfig.gemma_2b,
         "7b": TransformerConfig.gemma_7b,
     }[preset]()
-    params = init_params(jax.random.PRNGKey(0), cfg)
+
+    ckpt = os.environ.get("GEMMA_CKPT", "")
+    if ckpt:
+        from gofr_tpu.models.checkpoint import load_gemma_checkpoint
+
+        app.logger.info(f"loading weights from {ckpt}")
+        params = load_gemma_checkpoint(ckpt, cfg)
+    else:
+        app.logger.warn("GEMMA_CKPT not set: serving randomly initialized weights")
+        params = init_params(jax.random.PRNGKey(0), cfg)
+
+    tok_path = os.environ.get("GEMMA_TOKENIZER", "") or (ckpt if os.path.isdir(ckpt) else "")
+    if tok_path:
+        from gofr_tpu.models.tokenizer import load_tokenizer
+
+        try:
+            TOKENIZER = load_tokenizer(tok_path)
+            app.logger.info(f"tokenizer loaded ({TOKENIZER.vocab_size} pieces)")
+        except FileNotFoundError:
+            app.logger.warn(f"no tokenizer.json under {tok_path}; id-only API")
+
     kw = {}
     n_dev = len(jax.devices())
     if n_dev > 1:
@@ -47,41 +75,69 @@ def build_engine(app):
     )
 
 
+def _request_tokens(body) -> tuple[list[int], int]:
+    """Resolve prompt text or raw ids -> (tokens, eos)."""
+    if "prompt" in body and TOKENIZER is not None:
+        toks = TOKENIZER.encode(body["prompt"])
+        eos = TOKENIZER.eos_id if TOKENIZER.eos_id is not None else -1
+        return toks, eos
+    if "prompt" in body:
+        raise gofr_tpu.HTTPError(400, "no tokenizer configured; send 'tokens'")
+    return list(body["tokens"]), int(body.get("eos_token", -1))
+
+
 def generate(ctx):
     body = ctx.bind()
-    toks = ctx.tpu().llm("gemma").generate(
-        body["tokens"], max_new_tokens=int(body.get("max_new_tokens", 16)),
-        temperature=float(body.get("temperature", 0.0)),
+    toks, eos = _request_tokens(body)
+    out = ctx.tpu().llm("gemma").generate(
+        toks, max_new_tokens=int(body.get("max_new_tokens", 16)),
+        temperature=float(body.get("temperature", 0.0)), eos_token=eos,
     )
-    return {"tokens": toks}
+    resp = {"tokens": out}
+    if TOKENIZER is not None:
+        resp["text"] = TOKENIZER.decode(out)
+    return resp
 
 
 async def stream(ctx):
     from gofr_tpu.llm import GenRequest
 
     body = ctx.bind()
+    toks, eos = _request_tokens(body)
     req = ctx.tpu().llm("gemma").submit(
         GenRequest(
-            body["tokens"],
+            toks,
             max_new_tokens=int(body.get("max_new_tokens", 16)),
             temperature=float(body.get("temperature", 0.0)),
+            eos_token=eos,
         )
     )
+    emitted: list[int] = []
     async for tok in req.astream():
-        yield {"token": tok}
+        chunk = {"token": tok}
+        if TOKENIZER is not None:
+            # decode incrementally: text of all tokens so far minus prefix
+            prev = TOKENIZER.decode(emitted)
+            emitted.append(tok)
+            chunk["text"] = TOKENIZER.decode(emitted)[len(prev):]
+        yield chunk
 
 
 def engine_stats(ctx):
     return ctx.tpu().llm("gemma").stats()
 
 
-def main():
+def build_app():
     app = gofr_tpu.new()
     build_engine(app)
     app.grpc_unary("Gemma", "Generate", generate)
     app.grpc_server_stream("Gemma", "Stream", stream)
     app.get("/stats", engine_stats)
-    app.run()
+    return app
+
+
+def main():
+    build_app().run()
 
 
 if __name__ == "__main__":

@@ -1,0 +1,148 @@
+"""Checkpoint loading: HF safetensors → the framework's param pytree, and
+orbax save/restore of the native pytree.
+
+The reference has no model weights (it is a web framework); this implements
+the serving north star's "weights-from-disk" path (BASELINE.json config 3:
+grpc-gemma serves a real checkpoint, not random init).
+
+Layout conversions (models/transformer.py init_params is the contract):
+- HF linear weights are [out_features, in_features]; ours are [in, out] —
+  transposed on load.
+- Per-layer tensors are stacked on a leading [n_layers] axis (the layer
+  stack is one lax.scan).
+- k_proj/v_proj pack into wkv with heads OUTERMOST ([hkv, 2, hd] column
+  blocks) so TP column shards hold whole (k, v) head pairs.
+- gate_proj/up_proj stay separate tensors (w_gate / w_up, see the
+  transformer module for why fused layouts lose).
+- embed is shared input/output (Gemma ties them); final_norm / *_norm are
+  stored as (1 + scale) offsets by Gemma convention — HF stores the raw
+  scale, which is what our rms_norm expects too, so no offset here.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any
+
+import numpy as np
+
+__all__ = [
+    "load_safetensors_dir",
+    "gemma_params_from_hf",
+    "load_gemma_checkpoint",
+    "save_orbax",
+    "load_orbax",
+]
+
+
+def load_safetensors_dir(path: str) -> dict[str, np.ndarray]:
+    """Load every tensor from a .safetensors file or a directory of shards
+    (with or without a model.safetensors.index.json)."""
+    from safetensors.numpy import load_file
+
+    if os.path.isfile(path):
+        return dict(load_file(path))
+    files: list[str] = []
+    index = os.path.join(path, "model.safetensors.index.json")
+    if os.path.exists(index):
+        with open(index) as f:
+            weight_map = json.load(f)["weight_map"]
+        files = sorted({os.path.join(path, v) for v in weight_map.values()})
+    else:
+        files = sorted(
+            os.path.join(path, n)
+            for n in os.listdir(path)
+            if n.endswith(".safetensors")
+        )
+    if not files:
+        raise FileNotFoundError(f"no .safetensors files under {path}")
+    out: dict[str, np.ndarray] = {}
+    for fp in files:
+        out.update(load_file(fp))
+    return out
+
+
+def _get(tensors: dict, *names: str) -> np.ndarray:
+    for n in names:
+        if n in tensors:
+            return tensors[n]
+    raise KeyError(f"none of {names} in checkpoint (have {len(tensors)} tensors)")
+
+
+def gemma_params_from_hf(tensors: dict[str, np.ndarray], cfg) -> dict:
+    """Map an HF-layout Gemma checkpoint (model.layers.N.* naming) onto the
+    framework pytree. Works for any TransformerConfig whose dims match the
+    checkpoint (gemma_2b / gemma_7b / tiny test checkpoints)."""
+    import jax.numpy as jnp
+
+    d, hd, hq, hkv, L = cfg.d_model, cfg.head_dim, cfg.n_heads, cfg.n_kv_heads, cfg.n_layers
+    dt = cfg.dtype
+
+    def t(x):  # HF [out, in] -> ours [in, out]
+        return np.ascontiguousarray(np.asarray(x).T)
+
+    wq, wkv, wo, w_gate, w_up, w_down, attn_n, mlp_n = ([] for _ in range(8))
+    for i in range(L):
+        p = f"model.layers.{i}."
+        wq.append(t(_get(tensors, p + "self_attn.q_proj.weight")))  # [d, hq*hd]
+        k = t(_get(tensors, p + "self_attn.k_proj.weight"))  # [d, hkv*hd]
+        v = t(_get(tensors, p + "self_attn.v_proj.weight"))
+        # heads outermost: [d, hkv, hd] x2 -> [d, hkv, 2, hd] -> [d, 2*hkv*hd]
+        k = k.reshape(d, hkv, hd)
+        v = v.reshape(d, hkv, hd)
+        wkv.append(np.stack([k, v], axis=2).reshape(d, 2 * hkv * hd))
+        wo.append(t(_get(tensors, p + "self_attn.o_proj.weight")))  # [hq*hd, d]
+        w_gate.append(t(_get(tensors, p + "mlp.gate_proj.weight")))  # [d, ff]
+        w_up.append(t(_get(tensors, p + "mlp.up_proj.weight")))
+        w_down.append(t(_get(tensors, p + "mlp.down_proj.weight")))  # [ff, d]
+        attn_n.append(np.asarray(_get(tensors, p + "input_layernorm.weight")))
+        mlp_n.append(np.asarray(_get(tensors, p + "post_attention_layernorm.weight")))
+
+    embed = np.asarray(_get(tensors, "model.embed_tokens.weight"))
+    final_norm = np.asarray(_get(tensors, "model.norm.weight"))
+
+    def stack(xs):
+        return jnp.asarray(np.stack(xs), dt)
+
+    return {
+        "embed": jnp.asarray(embed, dt),
+        "final_norm": jnp.asarray(final_norm, dt),
+        "layers": {
+            "attn_norm": stack(attn_n),
+            "wq": stack(wq),
+            "wkv": stack(wkv),
+            "wo": stack(wo),
+            "mlp_norm": stack(mlp_n),
+            "w_gate": stack(w_gate),
+            "w_up": stack(w_up),
+            "w_down": stack(w_down),
+        },
+    }
+
+
+def load_gemma_checkpoint(path: str, cfg) -> dict:
+    """Checkpoint dir/file → params pytree. Accepts an HF safetensors
+    checkpoint or an orbax directory (detected by its checkpoint metadata)."""
+    if os.path.isdir(path) and (
+        os.path.exists(os.path.join(path, "_CHECKPOINT_METADATA"))
+        or os.path.exists(os.path.join(path, "_METADATA"))
+    ):
+        return load_orbax(path)
+    return gemma_params_from_hf(load_safetensors_dir(path), cfg)
+
+
+def save_orbax(params: Any, path: str) -> None:
+    """Save the native pytree with orbax (for fast reload of converted
+    checkpoints: convert from HF once, reload in native layout forever)."""
+    import orbax.checkpoint as ocp
+
+    with ocp.StandardCheckpointer() as ckptr:
+        ckptr.save(os.path.abspath(path), params)
+
+
+def load_orbax(path: str) -> Any:
+    import orbax.checkpoint as ocp
+
+    with ocp.StandardCheckpointer() as ckptr:
+        return ckptr.restore(os.path.abspath(path))

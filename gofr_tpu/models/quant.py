@@ -1,0 +1,76 @@
+"""Int8 weight quantization for serving.
+
+Decode is HBM-bandwidth-bound: every step streams all weights once, so
+int8 halves the floor (bf16 5.0 GB -> 2.5 GB for Gemma-2B). Symmetric
+per-output-channel quantization: q int8 [in, out], scale bf16 [out];
+activations stay bf16 and XLA fuses the int8->bf16 convert into the dot's
+operand stream (no materialized dequantized copy).
+
+QTensor is a pytree node, so quantized params flow through jit/scan/
+device_put/shardings exactly like plain arrays — the layer stack scans over
+stacked (q, s) leaves with zero code changes outside the matmul helper.
+
+The embedding quantizes per-d-column so ONE scale vector serves both uses:
+  gather:  emb.q[tokens] * s        (row lookup, scale on d)
+  unembed: (x * s) @ emb.q.T        (scale folds into the activations)
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+__all__ = ["QTensor", "quantize", "qmm", "quantize_params", "is_quantized"]
+
+
+class QTensor(NamedTuple):
+    q: jnp.ndarray  # int8
+    s: jnp.ndarray  # bf16 scale, broadcastable over the LAST axis
+
+    @property
+    def shape(self):
+        return self.q.shape
+
+    @property
+    def dtype(self):  # reported dtype = compute dtype after dequant
+        return self.s.dtype
+
+
+def quantize(w: jnp.ndarray, dtype=jnp.bfloat16) -> QTensor:
+    """Symmetric per-last-axis-channel int8."""
+    wf = w.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(wf), axis=tuple(range(w.ndim - 1)), keepdims=True)
+    scale = jnp.where(amax > 0, amax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(wf / scale), -127, 127).astype(jnp.int8)
+    return QTensor(q=q, s=scale.astype(dtype))
+
+
+def qmm(x: jnp.ndarray, w) -> jnp.ndarray:
+    """x @ w for plain arrays or QTensors (dequant fused into the dot).
+    w.s has keepdims shape [1, ..., out]; broadcasting applies it to the
+    dot's trailing output axis."""
+    if isinstance(w, QTensor):
+        return (x @ w.q.astype(x.dtype)) * w.s.astype(x.dtype)
+    return x @ w
+
+
+def is_quantized(params: dict) -> bool:
+    return isinstance(params.get("embed"), QTensor)
+
+
+_QUANT_KEYS = ("wq", "wkv", "wo", "w_gate", "w_up", "w_down")
+
+
+def quantize_params(params: dict, dtype=jnp.bfloat16) -> dict:
+    """Quantize the big matmul weights (+ embedding); norms stay bf16.
+    Layer-stacked weights [L, in, out] get per-(L, out) scales."""
+    layers = {
+        k: (quantize(v, dtype) if k in _QUANT_KEYS else v)
+        for k, v in params["layers"].items()
+    }
+    return {
+        "embed": quantize(params["embed"], dtype),
+        "final_norm": params["final_norm"],
+        "layers": layers,
+    }

@@ -30,6 +30,7 @@ import jax
 import jax.numpy as jnp
 
 from ..ops import decode_attention, multi_head_attention, rms_norm, apply_rope
+from .quant import QTensor, qmm
 
 
 @dataclasses.dataclass(frozen=True)
@@ -133,11 +134,11 @@ def _layer_body(
     hq, hkv, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
 
     h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
-    q = (h @ lp["wq"]).reshape(b, s, hq, hd)
+    q = qmm(h, lp["wq"]).reshape(b, s, hq, hd)
     # wkv packs heads OUTERMOST ([hkv, 2, hd] per output column block) so a
     # TP shard of the flat output dim holds whole (k, v) head pairs — keeps
     # Megatron column-parallel layout collective-free inside the layer.
-    kv = (h @ lp["wkv"]).reshape(b, s, hkv, 2, hd)
+    kv = qmm(h, lp["wkv"]).reshape(b, s, hkv, 2, hd)
     k, v = kv[:, :, :, 0], kv[:, :, :, 1]
     q = apply_rope(q, positions, cfg.rope_theta)
     k = apply_rope(k, positions, cfg.rope_theta)
@@ -163,10 +164,10 @@ def _layer_body(
         # Prefill fills the cache from position 0 (right-padded batches).
         new_k, new_v = k, v
 
-    x = x + (attn.reshape(b, s, hq * hd) @ lp["wo"]).astype(x.dtype)
+    x = x + qmm(attn.reshape(b, s, hq * hd), lp["wo"]).astype(x.dtype)
 
     h = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
-    x = x + (jax.nn.gelu(h @ lp["w_gate"]) * (h @ lp["w_up"])) @ lp["w_down"]
+    x = x + qmm(jax.nn.gelu(qmm(h, lp["w_gate"])) * qmm(h, lp["w_up"]), lp["w_down"])
     return x, new_k, new_v
 
 

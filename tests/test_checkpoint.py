@@ -1,0 +1,204 @@
+"""Checkpoint + tokenizer tests: a synthetic HF-layout Gemma checkpoint is
+written with safetensors, loaded through the mapping, and must produce the
+EXACT same forward outputs as directly-constructed params; orbax round-trips
+the native pytree; the tokenizer round-trips text."""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gofr_tpu.models import TransformerConfig, init_params, prefill
+from gofr_tpu.models.checkpoint import (
+    gemma_params_from_hf,
+    load_gemma_checkpoint,
+    load_orbax,
+    load_safetensors_dir,
+    save_orbax,
+)
+
+CFG = TransformerConfig.tiny()
+
+
+def params_to_hf(params, cfg) -> dict[str, np.ndarray]:
+    """Inverse of gemma_params_from_hf: build the HF-layout tensor dict from
+    a native pytree (the test's synthetic checkpoint writer)."""
+    d, hd, hkv, L = cfg.d_model, cfg.head_dim, cfg.n_kv_heads, cfg.n_layers
+    out = {
+        "model.embed_tokens.weight": np.asarray(params["embed"], np.float32),
+        "model.norm.weight": np.asarray(params["final_norm"], np.float32),
+    }
+    lp = params["layers"]
+    for i in range(L):
+        p = f"model.layers.{i}."
+        out[p + "self_attn.q_proj.weight"] = np.asarray(lp["wq"][i], np.float32).T
+        kv = np.asarray(lp["wkv"][i], np.float32).reshape(d, hkv, 2, hd)
+        out[p + "self_attn.k_proj.weight"] = kv[:, :, 0].reshape(d, hkv * hd).T
+        out[p + "self_attn.v_proj.weight"] = kv[:, :, 1].reshape(d, hkv * hd).T
+        out[p + "self_attn.o_proj.weight"] = np.asarray(lp["wo"][i], np.float32).T
+        out[p + "mlp.gate_proj.weight"] = np.asarray(lp["w_gate"][i], np.float32).T
+        out[p + "mlp.up_proj.weight"] = np.asarray(lp["w_up"][i], np.float32).T
+        out[p + "mlp.down_proj.weight"] = np.asarray(lp["w_down"][i], np.float32).T
+        out[p + "input_layernorm.weight"] = np.asarray(lp["attn_norm"][i], np.float32)
+        out[p + "post_attention_layernorm.weight"] = np.asarray(lp["mlp_norm"][i], np.float32)
+    return {k: np.ascontiguousarray(v) for k, v in out.items()}
+
+
+@pytest.fixture(scope="module")
+def native_params():
+    return init_params(jax.random.PRNGKey(3), CFG)
+
+
+def _forward(params):
+    toks = jnp.asarray([[3, 1, 4, 1, 5]], jnp.int32)
+    lens = jnp.asarray([5], jnp.int32)
+    logits, _ = prefill(params, CFG, toks, lens, 16)
+    return np.asarray(logits)
+
+
+class TestSafetensors:
+    def test_hf_round_trip_exact_forward(self, native_params, tmp_path):
+        from safetensors.numpy import save_file
+
+        hf = params_to_hf(native_params, CFG)
+        save_file(hf, str(tmp_path / "model.safetensors"))
+        loaded = gemma_params_from_hf(
+            load_safetensors_dir(str(tmp_path / "model.safetensors")), CFG
+        )
+        np.testing.assert_allclose(
+            _forward(loaded), _forward(native_params), rtol=1e-5, atol=1e-5
+        )
+
+    def test_sharded_dir_with_index(self, native_params, tmp_path):
+        from safetensors.numpy import save_file
+
+        hf = params_to_hf(native_params, CFG)
+        names = sorted(hf)
+        half = len(names) // 2
+        save_file({k: hf[k] for k in names[:half]}, str(tmp_path / "model-00001.safetensors"))
+        save_file({k: hf[k] for k in names[half:]}, str(tmp_path / "model-00002.safetensors"))
+        index = {
+            "weight_map": {
+                k: ("model-00001.safetensors" if k in names[:half] else "model-00002.safetensors")
+                for k in names
+            }
+        }
+        with open(tmp_path / "model.safetensors.index.json", "w") as f:
+            json.dump(index, f)
+        loaded = gemma_params_from_hf(load_safetensors_dir(str(tmp_path)), CFG)
+        np.testing.assert_allclose(
+            _forward(loaded), _forward(native_params), rtol=1e-5, atol=1e-5
+        )
+
+    def test_missing_tensor_is_clear(self, tmp_path):
+        from safetensors.numpy import save_file
+
+        save_file({"model.norm.weight": np.zeros(4, np.float32)}, str(tmp_path / "m.safetensors"))
+        with pytest.raises(KeyError, match="self_attn"):
+            gemma_params_from_hf(load_safetensors_dir(str(tmp_path / "m.safetensors")), CFG)
+
+
+class TestOrbax:
+    def test_native_round_trip(self, native_params, tmp_path):
+        path = str(tmp_path / "ckpt")
+        save_orbax(native_params, path)
+        loaded = load_orbax(path)
+        np.testing.assert_allclose(
+            _forward(loaded), _forward(native_params), rtol=1e-6, atol=1e-6
+        )
+
+    def test_load_gemma_checkpoint_detects_orbax(self, native_params, tmp_path):
+        path = str(tmp_path / "ckpt")
+        save_orbax(native_params, path)
+        loaded = load_gemma_checkpoint(path, CFG)
+        assert loaded["layers"]["wq"].shape == native_params["layers"]["wq"].shape
+
+
+class TestTokenizer:
+    def _make_tokenizer(self, tmp_path) -> str:
+        from tokenizers import Tokenizer as HFTokenizer
+        from tokenizers.models import WordLevel
+        from tokenizers.pre_tokenizers import Whitespace
+
+        vocab = {
+            "<bos>": 0, "<eos>": 1, "<unk>": 2,
+            "hello": 3, "world": 4, "gofr": 5, "tpu": 6, "serves": 7,
+        }
+        tok = HFTokenizer(WordLevel(vocab, unk_token="<unk>"))
+        tok.pre_tokenizer = Whitespace()
+        p = str(tmp_path / "tokenizer.json")
+        tok.save(p)
+        return p
+
+    def test_encode_decode_round_trip(self, tmp_path):
+        from gofr_tpu.models.tokenizer import load_tokenizer
+
+        t = load_tokenizer(self._make_tokenizer(tmp_path))
+        ids = t.encode("hello world")
+        assert ids[0] == t.bos_id == 0  # bos prepended
+        assert t.decode(ids) == "hello world"
+        assert t.eos_id == 1
+        assert t.vocab_size == 8
+
+    def test_load_from_directory(self, tmp_path):
+        from gofr_tpu.models.tokenizer import load_tokenizer
+
+        self._make_tokenizer(tmp_path)
+        t = load_tokenizer(str(tmp_path))
+        assert t.encode("gofr tpu", add_bos=False) == [5, 6]
+
+    def test_missing_file_is_clear(self, tmp_path):
+        from gofr_tpu.models.tokenizer import load_tokenizer
+
+        with pytest.raises(FileNotFoundError):
+            load_tokenizer(str(tmp_path / "nope.json"))
+
+
+class TestGrpcGemmaExample:
+    def test_text_round_trip_with_checkpoint(self, native_params, tmp_path, monkeypatch):
+        """The full config-3 path: checkpoint on disk + tokenizer -> text in,
+        text out over the engine."""
+        from safetensors.numpy import save_file
+
+        ckpt_dir = tmp_path / "ckpt"
+        ckpt_dir.mkdir()
+        save_file(params_to_hf(native_params, CFG), str(ckpt_dir / "model.safetensors"))
+        TestTokenizer()._make_tokenizer(ckpt_dir)
+
+        import importlib.util
+
+        ex = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "examples", "grpc-gemma", "main.py",
+        )
+        monkeypatch.chdir(os.path.dirname(ex))
+        monkeypatch.setenv("GEMMA_CKPT", str(ckpt_dir))
+        monkeypatch.setenv("GEMMA_PRESET", "tiny")
+        monkeypatch.setenv("LOG_LEVEL", "ERROR")
+        monkeypatch.setenv("HTTP_PORT", "0")
+        spec = importlib.util.spec_from_file_location("example_grpc_gemma_ckpt", ex)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+
+        import gofr_tpu
+        from gofr_tpu.config import new_mock_config
+
+        app = gofr_tpu.App(config=new_mock_config({"APP_NAME": "t", "LOG_LEVEL": "ERROR"}))
+        mod.build_engine(app)
+        assert mod.TOKENIZER is not None
+        try:
+            from gofr_tpu.context import Context
+
+            class Req:
+                context: dict = {}
+
+                def bind(self, target=None):
+                    return {"prompt": "hello world", "max_new_tokens": 3}
+
+            out = mod.generate(Context(Req(), app.container))
+            assert len(out["tokens"]) <= 3 and isinstance(out["text"], str)
+        finally:
+            app.container.close()
