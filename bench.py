@@ -59,13 +59,18 @@ def bench_serving(args) -> dict:
     init_s = time.time() - t0
 
     S = args.prefill_len
+    quantize = args.quantize and on_tpu
     eng = LLMEngine(
-        cfg, params, slots=args.batch, max_seq_len=S + args.new_tokens + 8,
+        cfg, params, slots=args.batch,
+        # prompts are S-8 long; leave new_tokens + 2 chunks of cap margin
+        max_seq_len=S + args.new_tokens + 2 * args.decode_chunk,
         prefill_buckets=(S,), decode_chunk=args.decode_chunk,
-        admit_cap=args.admit_cap,
+        admit_cap=args.admit_cap, quantize=quantize,
     )
     n_params = sum(x.size for x in jax.tree.leaves(params))
-    params_bytes = sum(x.size * x.dtype.itemsize for x in jax.tree.leaves(params))
+    params_bytes = sum(
+        x.size * x.dtype.itemsize for x in jax.tree.leaves(eng.params)
+    )
 
     # -- raw fused decode: engine's own executable, all slots active -------
     B = args.batch
@@ -329,6 +334,10 @@ def main() -> None:
     ap.add_argument("--admit-cap", type=int, default=32)
     ap.add_argument("--new-tokens", type=int, default=16)
     ap.add_argument("--clients", type=int, default=128)
+    ap.add_argument(
+        "--no-quantize", dest="quantize", action="store_false", default=True,
+        help="serve bf16 weights instead of int8 (int8 is the TPU default)",
+    )
     # shared knobs
     ap.add_argument("--requests", type=int, default=None)
     ap.add_argument("--concurrency", type=int, default=512)

@@ -10,32 +10,36 @@ Design (all shapes static; a bounded set of compiled executables):
 
 - **Slots.** A fixed decode batch of S slots with one persistent KV cache
   [n_layers, S, max_seq_len, hkv, hd] on device. Inactive slots are masked
-  (their cursor stays pinned so they never overflow; their tokens are
-  discarded on host).
+  (their tokens are discarded on host; their cursors never advance).
 - **Fused decode chunks.** Decode advances ALL slots K steps per dispatch
-  (`decode_chunk`, a lax.scan over decode_step with on-device sampling).
-  One host→device dispatch per K tokens amortizes dispatch latency — the
-  dominant cost at decode's arithmetic intensity — and the engine keeps up
-  to `lookahead` chunks in flight, chaining the next chunk's input tokens
-  from the previous chunk's on-device output so the device never waits for
-  host readback (the host processes chunk N while the device runs N+1).
-- **Admission.** Waiting requests are prefilled in length-bucketed batches
-  (powers-of-two capped at `admit_cap`), sampled on device (token #1 honors
-  the request temperature), then their KV rows are copied into free slots
-  via ONE jitted insert-many (scan of dynamic_update_slice) — the running
-  decode batch never recompiles as traffic changes. Admission first drains
-  in-flight chunks so the next dispatch sees a host-merged token vector.
+  (models.transformer.decode_chunk: a lax.scan over a chunk-ring-buffer
+  layer body with on-device sampling — the main cache is read-only inside
+  a chunk and merged once at chunk end, so no per-step scatter). One
+  host→device dispatch per K tokens amortizes dispatch latency, and the
+  engine keeps up to `lookahead` chunks in flight, chaining each chunk's
+  input tokens from the previous chunk's on-device output so the device
+  never waits for host readback.
+- **Admission without stalling decode.** Prefill waves dispatch
+  asynchronously BETWEEN decode chunks; the first sampled token is merged
+  into the on-device tail vector by a jitted scatter (no host round trip),
+  and prefilled KV rows are copied into free slots via ONE jitted
+  insert-many. Decode chunks already in flight keep streaming — their
+  tokens for a reused slot are dropped on host via per-slot generation
+  tags, never by draining the pipeline (the r2 engine's flush-before-admit
+  barrier cost 72% of raw decode throughput).
 - **On-device sampling.** Greedy or temperature sampling happens inside the
-  chunk; the host syncs one [K, S] int32 array per chunk instead of logits.
+  chunk; the host syncs one [K, S] int32 array per chunk (started with
+  copy_to_host_async at dispatch) instead of logits.
 - **Streaming.** Each request owns a thread-safe queue; the engine thread
-  pushes tokens as chunks complete; consumers iterate stream() (sync) or
-  astream() (async) and detach by cancelling — a detached request just
-  frees its slot, never stalling the batch (same contract as the TPU
-  datasource batcher).
+  pushes per-chunk token LISTS as fetches complete; consumers iterate
+  stream() (sync) or astream() (async) and detach by cancelling — a
+  detached request just frees its slot, never stalling the batch.
 
 Tensor parallelism: pass mesh + param_specs; the slot cache is resharded by
 GSPMD from the params' shardings (KV replicated under MQA, sharded when the
 TP degree divides n_kv_heads) — identical code single-chip and multi-chip.
+Quantization: quantize=True serves int8 weights (models.quant), halving the
+HBM stream that bounds decode.
 """
 
 from __future__ import annotations
@@ -67,6 +71,9 @@ class GenRequest:
         self.out: queue.Queue = queue.Queue()
         self.cancelled = False
         self.emitted = 0
+        self.capped = False  # engine reduced max_new_tokens to fit the cache
+        self.finish_reason: str | None = None  # "eos" | "length" | "cancelled"
+        self.submitted_at: float | None = None
 
     # -- consumption ------------------------------------------------------
     def stream(self, timeout: float = 60.0) -> Iterator[int]:
@@ -75,7 +82,10 @@ class GenRequest:
             item = self.out.get(timeout=timeout)
             if item is None:
                 return
-            yield item
+            if isinstance(item, list):
+                yield from item
+            else:
+                yield item
 
     async def astream(self, timeout: float = 60.0):
         import asyncio
@@ -85,7 +95,11 @@ class GenRequest:
             item = await loop.run_in_executor(None, lambda: self.out.get(timeout=timeout))
             if item is None:
                 return
-            yield item
+            if isinstance(item, list):
+                for t in item:
+                    yield t
+            else:
+                yield item
 
     def cancel(self) -> None:
         self.cancelled = True
@@ -104,18 +118,30 @@ class LLMEngine:
         max_seq_len: int = 512,
         prefill_buckets: tuple[int, ...] = (16, 64, 128),
         decode_chunk: int = 8,
-        lookahead: int = 2,
+        lookahead: int = 3,
         admit_cap: int = 8,
         mesh=None,
         param_specs: Any = None,
         logger=None,
         metrics=None,
         warmup: bool = True,
+        quantize: bool = False,
     ):
         import jax
         import jax.numpy as jnp
 
-        from .models.transformer import decode_step, init_cache, prefill
+        from .models.transformer import decode_chunk as chunk_fn
+        from .models.transformer import init_cache, prefill
+
+        if quantize:
+            from .models.quant import quantize_param_specs, quantize_params
+
+            # int8 weights halve the HBM stream decode is bound by
+            # (VERDICT r2: 5.0 GB bf16 -> 2.5 GB); no-op if already quantized.
+            params = jax.jit(lambda p: quantize_params(p, cfg.dtype))(params)
+            if param_specs is not None:
+                param_specs = quantize_param_specs(param_specs)
+        self.quantized = quantize
 
         self.cfg = cfg
         self.slots = slots
@@ -160,24 +186,10 @@ class LLMEngine:
         K = decode_chunk
 
         def _chunk_op(params, tokens, cache, active, temps, rng):
-            """K decode steps fused in one executable. Slots advance only
-            while `live` (active AND below cache capacity); frozen slots
-            keep their cursor and re-emit their input token (discarded by
-            the host)."""
-            rng, sub = jax.random.split(rng)
-            keys = jax.random.split(sub, K)
-
-            def body(carry, key):
-                tok, cache = carry
-                live = active & (cache.length < max_seq_len)
-                logits, new_cache = decode_step(params, cfg, tok, cache)
-                nt = _sample(logits, temps, key)
-                nt = jnp.where(live, nt, tok)
-                new_len = jnp.where(live, new_cache.length, cache.length)
-                return (nt, new_cache._replace(length=new_len)), nt
-
-            (last, cache), toks = jax.lax.scan(body, (tokens, cache), keys)
-            return toks, last, cache, rng
+            return chunk_fn(
+                params, cfg, tokens, cache, active, temps, rng,
+                n_steps=K, sample_fn=_sample,
+            )
 
         M = self.admit_cap
 
@@ -207,22 +219,29 @@ class LLMEngine:
             cache, _ = jax.lax.scan(body, slot_cache, (slot_idx, rows))
             return cache
 
+        def _merge_tail(tail, slot_idx, rows, first):
+            """Scatter freshly-prefilled first tokens into the on-device
+            chain tail — admission never forces a host round trip. Padding
+            entries repeat slot_idx[0]/rows[0] (idempotent)."""
+            return tail.at[slot_idx].set(first[rows])
+
         self._prefill_op = jax.jit(_prefill_op)
         self._chunk_op = jax.jit(_chunk_op, donate_argnums=(2,))
         self._insert_many = jax.jit(_insert_many, donate_argnums=(0,))
+        self._merge_tail = jax.jit(_merge_tail, donate_argnums=(0,))
         self._rng = jax.random.PRNGKey(0)
 
         self.cache = init_cache(cfg, slots, max_seq_len)
         self._slot_req: list[GenRequest | None] = [None] * slots
-        self._last_tok = np.zeros((slots,), np.int32)
+        self._gen = np.zeros((slots,), np.int64)  # per-slot assignment epoch
         self._temps = np.zeros((slots,), np.float32)
+        self._tail = jnp.zeros((slots,), jnp.int32)  # device: next chunk input
         self._admit_q: queue.Queue[GenRequest | None] = queue.Queue()
         self._stop = False
-        # in-flight decode chunks: deque of device [K, S] token arrays,
-        # oldest first; _tail is the newest chunk's on-device last-token
-        # vector (input for a chained speculative dispatch)
+        # in-flight device work, oldest first:
+        #   ("chunk", toks_dev [K,S], gens snapshot)
+        #   ("prefill", first_dev [nb], slots list, gens list)
         self._inflight: deque = deque()
-        self._tail = None
         self._jnp = jnp
         self._jax = jax
 
@@ -235,10 +254,26 @@ class LLMEngine:
     def submit(self, req: GenRequest) -> GenRequest:
         if self._stop:
             raise RuntimeError("engine stopped")
-        if len(req.prompt_tokens) >= self.max_seq_len:
+        plen = len(req.prompt_tokens)
+        if plen >= self.max_seq_len:
             raise ValueError(
-                f"prompt of {len(req.prompt_tokens)} tokens exceeds max_seq_len {self.max_seq_len}"
+                f"prompt of {plen} tokens exceeds max_seq_len {self.max_seq_len}"
             )
+        # Cap max_new_tokens so the slot's cursor can never clamp-overwrite
+        # its own live rows: while a request is incomplete its length stays
+        # <= prompt + max_new + chunk (chunk-granularity rounding), and the
+        # end-of-chunk merge needs a further chunk of slack. A request that
+        # cannot emit a single token is rejected outright.
+        room = self.max_seq_len - plen - 2 * self.decode_chunk
+        if room < 1:
+            raise ValueError(
+                f"prompt of {plen} tokens leaves no decode room at "
+                f"max_seq_len {self.max_seq_len} (chunk {self.decode_chunk})"
+            )
+        if req.max_new_tokens > room:
+            req.max_new_tokens = room
+            req.capped = True
+        req.submitted_at = time.perf_counter()
         self._admit_q.put(req)
         return req
 
@@ -252,7 +287,7 @@ class LLMEngine:
             "waiting": self._admit_q.qsize(),
             "max_seq_len": self.max_seq_len,
             "decode_chunk": self.decode_chunk,
-            "inflight_chunks": len(self._inflight),
+            "inflight_chunks": sum(1 for e in self._inflight if e[0] == "chunk"),
         }
 
     def close(self) -> None:
@@ -265,13 +300,15 @@ class LLMEngine:
         jnp = self._jnp
         t0 = time.perf_counter()
         zero_rng = self._rng
+        idx = jnp.zeros((self.admit_cap,), jnp.int32)
         for b in self.prefill_buckets:
-            toks = jnp.zeros((1, b), jnp.int32)
-            lens = jnp.ones((1,), jnp.int32)
-            temps = jnp.zeros((1,), jnp.float32)
-            first, c, _ = self._prefill_op(self.params, toks, lens, temps, zero_rng)
-            idx = jnp.zeros((self.admit_cap,), jnp.int32)
-            self.cache = self._insert_many(self.cache, c, idx, idx)
+            for nb in dict.fromkeys((1, self.admit_cap)):
+                toks = jnp.zeros((nb, b), jnp.int32)
+                lens = jnp.ones((nb,), jnp.int32)
+                temps = jnp.zeros((nb,), jnp.float32)
+                first, c, _ = self._prefill_op(self.params, toks, lens, temps, zero_rng)
+                self.cache = self._insert_many(self.cache, c, idx, idx % nb)
+                self._tail = self._merge_tail(self._tail, idx, idx % nb, first)
         toks, last, self.cache, _ = self._chunk_op(
             self.params,
             jnp.zeros((self.slots,), jnp.int32),
@@ -282,6 +319,7 @@ class LLMEngine:
         )
         _ = np.asarray(last)  # sync (block_until_ready is unreliable on axon)
         self.cache = self.cache._replace(length=jnp.zeros((self.slots,), jnp.int32))
+        self._tail = jnp.zeros((self.slots,), jnp.int32)
         if self.logger is not None:
             self.logger.info(
                 f"LLM engine warmed in {time.perf_counter() - t0:.1f}s "
@@ -303,8 +341,10 @@ class LLMEngine:
 
     def _admit(self) -> bool:
         """Pull waiting requests into free slots, prefilling per bucket.
-        Drains in-flight chunks first so the next dispatch starts from a
-        host-merged last-token vector."""
+        Purely dispatch-side: decode chunks in flight are untouched (their
+        tokens for reused slots are dropped by generation tag), and the
+        first sampled tokens merge into the device tail without a host
+        round trip."""
         jnp = self._jnp
         free = self._free_slots()
         pulled: list[GenRequest] = []
@@ -319,13 +359,12 @@ class LLMEngine:
                 self._stop = True
                 break
             if req.cancelled:
+                req.finish_reason = "cancelled"
                 req.out.put(None)
                 continue
             pulled.append(req)
         if not pulled:
             return False
-        self._flush()  # retire-complete + host-known last tokens
-        free = self._free_slots()
         # group by bucket to share prefill executions; chunks of admit_cap
         by_bucket: dict[int, list[GenRequest]] = {}
         for r in pulled:
@@ -351,12 +390,12 @@ class LLMEngine:
                 self.params, jnp.asarray(toks), jnp.asarray(lens),
                 jnp.asarray(temps), self._rng,
             )
-            first = np.asarray(first_dev)
             if self.metrics is not None:
                 self.metrics.record_histogram(
                     "app_tpu_stats", time.perf_counter() - t0,
-                    model="llm", op=f"prefill_{bucket}",
+                    model="llm", op=f"prefill_dispatch_{bucket}",
                 )
+            free = self._free_slots()
             slot_idx = np.zeros((self.admit_cap,), np.int32)
             rows = np.zeros((self.admit_cap,), np.int32)
             taken: list[int] = []
@@ -364,7 +403,7 @@ class LLMEngine:
                 slot = free.pop(0)
                 taken.append(slot)
                 self._slot_req[slot] = r
-                self._last_tok[slot] = first[j]
+                self._gen[slot] += 1
                 self._temps[slot] = r.temperature
                 slot_idx[j], rows[j] = slot, j
             # pad entries duplicate entry 0 (idempotent)
@@ -373,20 +412,51 @@ class LLMEngine:
             self.cache = self._insert_many(
                 self.cache, new_cache, jnp.asarray(slot_idx), jnp.asarray(rows)
             )
-            for j, slot in enumerate(taken):
-                self._emit(slot, int(first[j]))
+            self._tail = self._merge_tail(
+                self._tail, jnp.asarray(slot_idx), jnp.asarray(rows), first_dev
+            )
+            self._start_fetch(first_dev)
+            self._inflight.append(
+                ("prefill", first_dev, list(taken), [self._gen[s] for s in taken])
+            )
         return True
 
-    def _emit(self, slot: int, token: int) -> None:
+    @staticmethod
+    def _start_fetch(arr) -> None:
+        copy = getattr(arr, "copy_to_host_async", None)
+        if copy is not None:
+            try:
+                copy()
+            except Exception:  # pragma: no cover — backend-dependent
+                pass
+
+    def _emit_tokens(self, slot: int, toks: list[int]) -> None:
+        """Append a request's next tokens, honoring max_new/eos/cancel."""
         r = self._slot_req[slot]
         if r is None:
             return
         if r.cancelled:
+            r.finish_reason = "cancelled"
             self._retire(slot)
             return
-        r.out.put(token)
-        r.emitted += 1
-        if token == r.eos_token or r.emitted >= r.max_new_tokens:
+        take = min(len(toks), r.max_new_tokens - r.emitted)
+        toks = toks[:take]
+        finish = None
+        if r.eos_token >= 0 and r.eos_token in toks:
+            toks = toks[: toks.index(r.eos_token) + 1]
+            finish = "eos"
+        if r.emitted == 0 and r.submitted_at is not None and self.metrics is not None:
+            self.metrics.record_histogram(
+                "app_tpu_queue_wait", time.perf_counter() - r.submitted_at,
+                model="llm", op="ttft",
+            )
+        if toks:
+            r.out.put(toks)
+            r.emitted += len(toks)
+        if finish is None and r.emitted >= r.max_new_tokens:
+            finish = "length"
+        if finish is not None:
+            r.finish_reason = finish
             self._retire(slot)
 
     def _retire(self, slot: int) -> None:
@@ -394,26 +464,32 @@ class LLMEngine:
         if r is not None:
             r.out.put(None)
         self._slot_req[slot] = None
+        self._gen[slot] += 1
         self._temps[slot] = 0.0
 
     def _dispatch(self) -> None:
-        """Launch one decode chunk. The first chunk of a chain starts from
-        the host-merged token vector; subsequent chunks chain from the
-        previous chunk's on-device output, so the device never stalls on
-        host readback."""
+        """Launch one decode chunk chained from the on-device tail."""
         jnp = self._jnp
-        src = self._tail if self._tail is not None else jnp.asarray(self._last_tok)
         active = np.array([r is not None for r in self._slot_req])
         toks, last, self.cache, self._rng = self._chunk_op(
-            self.params, src, self.cache,
+            self.params, self._tail, self.cache,
             jnp.asarray(active), jnp.asarray(self._temps), self._rng,
         )
         self._tail = last
-        self._inflight.append(toks)
+        self._start_fetch(toks)
+        self._inflight.append(("chunk", toks, self._gen.copy()))
 
     def _process_one(self) -> None:
-        """Read back the oldest in-flight chunk and emit its tokens."""
-        toks_dev = self._inflight.popleft()
+        """Read back the oldest in-flight device result and emit tokens."""
+        entry = self._inflight.popleft()
+        if entry[0] == "prefill":
+            _, first_dev, slots_, gens = entry
+            first = np.asarray(first_dev)
+            for j, slot in enumerate(slots_):
+                if self._gen[slot] == gens[j]:
+                    self._emit_tokens(slot, [int(first[j])])
+            return
+        _, toks_dev, gens = entry
         t0 = time.perf_counter()
         toks = np.asarray(toks_dev)  # [K, S] — blocks; device runs next chunk
         if self.metrics is not None:
@@ -421,45 +497,35 @@ class LLMEngine:
                 "app_tpu_stats", time.perf_counter() - t0,
                 model="llm", op="decode_chunk",
             )
-        for k in range(toks.shape[0]):
-            for slot in range(self.slots):
-                r = self._slot_req[slot]
-                if r is None:
-                    continue
-                if r.emitted + len(r.prompt_tokens) >= self.max_seq_len - 1:
-                    self._retire(slot)  # cache capacity guard
-                    continue
-                self._emit(slot, int(toks[k, slot]))
-        self._last_tok = toks[-1].copy()
-        if not self._inflight:
-            self._tail = None
+        cols = toks.T  # [S, K]
+        for slot in range(self.slots):
+            if self._slot_req[slot] is None or self._gen[slot] != gens[slot]:
+                continue
+            self._emit_tokens(slot, cols[slot].tolist())
 
     def _flush(self) -> None:
         while self._inflight:
             self._process_one()
-        self._tail = None
 
     def _loop(self) -> None:
+        jnp = self._jnp
         while not self._stop:
             try:
                 self._admit()
                 if self._stop:
                     break
                 if self._any_active():
-                    if not self._inflight:
+                    depth = sum(1 for e in self._inflight if e[0] == "chunk")
+                    while depth < self.lookahead:
                         self._dispatch()
-                    # speculative chunk: only when no admission is possible
-                    # (otherwise the next loop iteration admits instead)
-                    can_admit = self._admit_q.qsize() > 0 and self._free_slots()
-                    while len(self._inflight) < self.lookahead and not can_admit:
-                        self._dispatch()
+                        depth += 1
                 if self._inflight:
                     self._process_one()
             except Exception as e:  # noqa: BLE001 — engine must not die silently
                 if self.logger is not None:
                     self.logger.error(f"LLM engine step failed: {e!r}")
                 self._inflight.clear()
-                self._tail = None
+                self._tail = jnp.zeros((self.slots,), jnp.int32)
                 for slot in range(self.slots):
                     self._retire(slot)
                 time.sleep(0.1)

@@ -29,8 +29,14 @@ from typing import Any, NamedTuple
 import jax
 import jax.numpy as jnp
 
-from ..ops import decode_attention, multi_head_attention, rms_norm, apply_rope
-from .quant import QTensor, qmm
+from ..ops import (
+    apply_rope,
+    chunk_decode_attention,
+    decode_attention,
+    multi_head_attention,
+    rms_norm,
+)
+from .quant import QTensor, qmm, qmm_a8
 
 
 @dataclasses.dataclass(frozen=True)
@@ -133,12 +139,17 @@ def _layer_body(
     b, s, d = x.shape
     hq, hkv, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
 
+    # Prefill (many token rows, MXU-bound) uses the W8A8 integer dot when
+    # weights are quantized; decode (one row, HBM-bound) dequantizes into
+    # the dot. Plain-array weights are unaffected by either.
+    mm = qmm if decode else qmm_a8
+
     h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
-    q = qmm(h, lp["wq"]).reshape(b, s, hq, hd)
+    q = mm(h, lp["wq"]).reshape(b, s, hq, hd)
     # wkv packs heads OUTERMOST ([hkv, 2, hd] per output column block) so a
     # TP shard of the flat output dim holds whole (k, v) head pairs — keeps
     # Megatron column-parallel layout collective-free inside the layer.
-    kv = qmm(h, lp["wkv"]).reshape(b, s, hkv, 2, hd)
+    kv = mm(h, lp["wkv"]).reshape(b, s, hkv, 2, hd)
     k, v = kv[:, :, :, 0], kv[:, :, :, 1]
     q = apply_rope(q, positions, cfg.rope_theta)
     k = apply_rope(k, positions, cfg.rope_theta)
@@ -164,10 +175,10 @@ def _layer_body(
         # Prefill fills the cache from position 0 (right-padded batches).
         new_k, new_v = k, v
 
-    x = x + qmm(attn.reshape(b, s, hq * hd), lp["wo"]).astype(x.dtype)
+    x = x + mm(attn.reshape(b, s, hq * hd), lp["wo"]).astype(x.dtype)
 
     h = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
-    x = x + qmm(jax.nn.gelu(qmm(h, lp["w_gate"])) * qmm(h, lp["w_up"]), lp["w_down"])
+    x = x + mm(jax.nn.gelu(mm(h, lp["w_gate"])) * mm(h, lp["w_up"]), lp["w_down"])
     return x, new_k, new_v
 
 
@@ -188,8 +199,7 @@ def transformer_forward(
     given — serving prefill only needs last-token logits, and skipping the
     full [b, s, vocab] unembed saves seq_len x the memory/FLOPs of the
     single biggest matmul (vocab 256k: 8.4 GB at b=64, s=128)."""
-    x = params["embed"][tokens].astype(cfg.dtype)
-    x = x * jnp.sqrt(jnp.asarray(cfg.d_model, jnp.float32)).astype(cfg.dtype)
+    x = _embed_tokens(params, cfg, tokens)
 
     if decode:
         assert cache is not None
@@ -240,10 +250,7 @@ def transformer_forward(
         x = jnp.take_along_axis(
             x, unembed_positions[:, None, None].astype(jnp.int32), axis=1
         )  # [b, 1, d]
-    logits = (x @ params["embed"].T.astype(cfg.dtype)).astype(jnp.float32)
-    if cfg.final_logit_cap > 0.0:
-        logits = cfg.final_logit_cap * jnp.tanh(logits / cfg.final_logit_cap)
-    return logits, new_cache
+    return _unembed(params, cfg, x), new_cache
 
 
 def prefill(
@@ -282,6 +289,136 @@ def decode_step(
         params, cfg, tokens[:, None], positions, cache=cache, decode=True
     )
     return logits[:, 0], new_cache
+
+
+def _embed_tokens(params: dict, cfg: TransformerConfig, tokens: jnp.ndarray) -> jnp.ndarray:
+    """(possibly int8) embedding gather + Gemma sqrt(d) scaling."""
+    emb = params["embed"]
+    if isinstance(emb, QTensor):
+        # int8 embedding: gather rows of q, apply the shared per-d-column
+        # scale (quant.py docstring) — reads vocab x d bytes at int8 width.
+        x = emb.q[tokens].astype(cfg.dtype) * emb.s.astype(cfg.dtype)
+    else:
+        x = emb[tokens].astype(cfg.dtype)
+    return x * jnp.sqrt(jnp.asarray(cfg.d_model, jnp.float32)).astype(cfg.dtype)
+
+
+def _unembed(params: dict, cfg: TransformerConfig, x: jnp.ndarray) -> jnp.ndarray:
+    """Tied (possibly int8) unembed for [b, s, d] -> [b, s, vocab] f32."""
+    emb = params["embed"]
+    if isinstance(emb, QTensor):
+        # Fold the d-column scale into the activations, then one bf16 x
+        # int8 dot (x*s) @ q.T — the big [vocab, d] stream stays int8.
+        logits = ((x * emb.s.astype(cfg.dtype)) @ emb.q.T.astype(cfg.dtype)).astype(
+            jnp.float32
+        )
+    else:
+        logits = (x @ emb.T.astype(cfg.dtype)).astype(jnp.float32)
+    if cfg.final_logit_cap > 0.0:
+        logits = cfg.final_logit_cap * jnp.tanh(logits / cfg.final_logit_cap)
+    return logits
+
+
+def _unembed_last(params: dict, cfg: TransformerConfig, x: jnp.ndarray) -> jnp.ndarray:
+    """final norm + tied unembed for a [b, 1, d] tail -> [b, vocab]."""
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    return _unembed(params, cfg, x)[:, 0]
+
+
+def decode_chunk(
+    params: dict,
+    cfg: TransformerConfig,
+    tokens: jnp.ndarray,  # [b] last sampled token per sequence
+    cache: KVCache,
+    active: jnp.ndarray,  # [b] bool — only active slots advance their cursor
+    temps: jnp.ndarray,  # [b] f32 sampling temperatures
+    rng: jax.Array,
+    *,
+    n_steps: int,
+    sample_fn,  # (logits [b, vocab] f32, temps [b], key) -> tokens [b] int32
+) -> tuple[jnp.ndarray, jnp.ndarray, KVCache, jax.Array]:
+    """n_steps fused decode steps — the serving engine's hot loop.
+
+    Unlike a scan over decode_step, the main KV cache is READ-ONLY inside
+    the chunk: each step writes its new K/V at the UNIFORM position `step`
+    of a small [L, b, n_steps, hkv, hd] ring buffer (one aligned
+    dynamic_update_slice), and attention spans cache+buffer with a joint
+    softmax (ops.chunk_decode_attention). The buffer is merged into
+    per-slot cursor positions ONCE at chunk end. Rationale (measured on
+    v5e): per-step vmap'd scatters at per-sequence cursors plus restacking
+    the full cache through scan outputs cost ~3.5 ms/step across 18 layers
+    — 6x the attention math itself; this layout amortizes the scatter to
+    once per chunk and removes the restack entirely.
+
+    ALL slots run every step (no per-step freeze): inactive slots sample
+    garbage the host discards, and only active slots' lengths advance at
+    the merge. Callers must guarantee active slots have n_steps of cache
+    headroom (LLMEngine caps max_new_tokens at submit).
+
+    Returns (tokens [n_steps, b], last [b], new cache, rng).
+    """
+    L, b = cfg.n_layers, tokens.shape[0]
+    hq, hkv, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    max_len = cache.k.shape[2]
+    K = n_steps
+    kb0 = jnp.zeros((L, b, K, hkv, hd), cache.k.dtype)
+    vb0 = jnp.zeros((L, b, K, hkv, hd), cache.v.dtype)
+    rng, sub = jax.random.split(rng)
+    keys = jax.random.split(sub, K)
+    def step(carry, inp):
+        tok, kb, vb = carry
+        k_i, key = inp
+        positions = (cache.length + k_i)[:, None]  # [b, 1]
+        x = _embed_tokens(params, cfg, tok[:, None])
+
+        def layer(x, xs):
+            lp, kc_l, vc_l, kb_l, vb_l = xs
+            h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
+            q = qmm(h, lp["wq"]).reshape(b, 1, hq, hd)
+            kv = qmm(h, lp["wkv"]).reshape(b, 1, hkv, 2, hd)
+            k_new, v_new = kv[:, :, :, 0], kv[:, :, :, 1]
+            q = apply_rope(q, positions, cfg.rope_theta)
+            k_new = apply_rope(k_new, positions, cfg.rope_theta)
+            kb_l = jax.lax.dynamic_update_slice(
+                kb_l, k_new.astype(kb_l.dtype), (0, k_i, 0, 0)
+            )
+            vb_l = jax.lax.dynamic_update_slice(
+                vb_l, v_new.astype(vb_l.dtype), (0, k_i, 0, 0)
+            )
+            attn = chunk_decode_attention(
+                q, kc_l, vc_l, kb_l, vb_l, cache.length, k_i,
+                logit_cap=cfg.attn_logit_cap,
+            )
+            x = x + qmm(attn.reshape(b, 1, hq * hd), lp["wo"]).astype(x.dtype)
+            h = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
+            x = x + qmm(
+                jax.nn.gelu(qmm(h, lp["w_gate"])) * qmm(h, lp["w_up"]), lp["w_down"]
+            )
+            return x, (kb_l, vb_l)
+
+        x, (kb, vb) = jax.lax.scan(
+            layer, x, (params["layers"], cache.k, cache.v, kb, vb)
+        )
+        logits = _unembed_last(params, cfg, x)
+        nt = sample_fn(logits, temps, key).astype(jnp.int32)
+        return (nt, kb, vb), nt
+
+    (last, kb, vb), toks = jax.lax.scan(
+        step, (tokens, kb0, vb0), (jnp.arange(K, dtype=jnp.int32), keys)
+    )
+
+    # merge: one scatter per chunk. Inactive slots write garbage rows at a
+    # clamped in-bounds start — harmless, their rows sit beyond the valid
+    # length (or the slot is free and rewritten wholesale at admission).
+    start = jnp.minimum(cache.length, max_len - K)
+    merge = jax.vmap(
+        lambda c, u, i: jax.lax.dynamic_update_slice(c, u, (0, i, 0, 0)),
+        in_axes=(1, 1, 0), out_axes=1,
+    )
+    new_k = merge(cache.k, kb, start)
+    new_v = merge(cache.v, vb, start)
+    new_len = jnp.where(active, jnp.minimum(cache.length + K, max_len), cache.length)
+    return toks, last, KVCache(k=new_k, v=new_v, length=new_len), rng
 
 
 def generate(

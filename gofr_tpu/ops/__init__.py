@@ -9,6 +9,7 @@ this package exists for the TPU north star (BASELINE.json).
 """
 
 from .attention import (
+    chunk_decode_attention,
     decode_attention,
     flash_attention,
     mha_reference,
@@ -22,6 +23,7 @@ __all__ = [
     "mha_reference",
     "flash_attention",
     "decode_attention",
+    "chunk_decode_attention",
     "rms_norm",
     "apply_rope",
     "rope_frequencies",

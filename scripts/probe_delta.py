@@ -1,0 +1,162 @@
+"""Delta-method decode profiling: per-step cost = (T(K2)-T(K1))/(K2-K1),
+which cancels the ~95 ms fixed dispatch+fetch round-trip of the axon
+tunnel that poisoned absolute K=32 measurements (probe_variants.py)."""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from gofr_tpu.models import TransformerConfig, init_params
+from gofr_tpu.models.quant import quantize_params
+from gofr_tpu.models.transformer import decode_step, init_cache
+from gofr_tpu.ops import decode_attention
+
+cfg = TransformerConfig.gemma_2b()
+B, MAX = 64, 208
+K1, K2 = 32, 96
+print("device:", jax.devices()[0].device_kind, flush=True)
+
+params = jax.jit(lambda k: init_params(k, cfg))(jax.random.PRNGKey(0))
+qparams = jax.jit(lambda p: quantize_params(p, cfg.dtype))(params)
+_ = float(np.asarray(qparams["final_norm"])[0])
+
+
+def timed(name, mk, *args):
+    ts = {}
+    for K in (K1, K2):
+        f = jax.jit(mk(K))
+        _ = float(np.asarray(f(*args)))
+        best = 1e9
+        for _r in range(2):
+            t0 = time.perf_counter()
+            _ = float(np.asarray(f(*args)))
+            best = min(best, time.perf_counter() - t0)
+        ts[K] = best
+    per = (ts[K2] - ts[K1]) / (K2 - K1)
+    print(f"{name:52s} {per*1e3:8.3f} ms/step", flush=True)
+    return per
+
+
+PROBES = set(sys.argv[1:]) or {"full", "mm", "un", "attn", "sample", "norm"}
+
+x0 = jnp.ones((B, cfg.d_model), cfg.dtype)
+emb = qparams["embed"]
+
+if "full" in PROBES:
+    def mk_full(K):
+        def f(params, tok, cache):
+            def body(c, _):
+                tok, cache = c
+                logits, cache = decode_step(params, cfg, tok, cache)
+                return (jnp.argmax(logits, -1).astype(jnp.int32), cache), None
+            (tok, cache), _ = jax.lax.scan(body, (tok, cache), None, length=K)
+            return tok.sum()
+        return f
+    cache0 = init_cache(cfg, B, MAX)._replace(length=jnp.full((B,), 128, jnp.int32))
+    timed("full int8 decode (greedy)", mk_full, qparams, jnp.zeros((B,), jnp.int32), cache0)
+    timed("full bf16 decode (greedy)", mk_full, params, jnp.zeros((B,), jnp.int32), cache0)
+
+if "mm" in PROBES:
+    from gofr_tpu.models.quant import qmm
+    def mk_mm(layers_):
+        def mk(K):
+            def f(x, layers):
+                def body(x, _):
+                    def layer(x, lp):
+                        q = qmm(x, lp["wq"])
+                        kv = qmm(x, lp["wkv"])
+                        o = qmm(q, lp["wo"])
+                        d = qmm(jax.nn.gelu(qmm(x, lp["w_gate"])) * qmm(x, lp["w_up"]), lp["w_down"])
+                        return (x + o + d + kv.sum() * 0).astype(x.dtype), None
+                    x, _ = jax.lax.scan(layer, x, layers)
+                    return x, None
+                x, _ = jax.lax.scan(body, x, None, length=K)
+                return x.sum().astype(jnp.float32)
+            return f
+        return mk
+    timed("mm int8 per-layer matmuls", mk_mm(qparams["layers"]), x0, qparams["layers"])
+    timed("mm bf16 per-layer matmuls", mk_mm(params["layers"]), x0, params["layers"])
+
+if "un" in PROBES:
+    def mk_un_q(K):
+        def f(x, emb):
+            def body(x, _):
+                lg = ((x * emb.s.astype(cfg.dtype)) @ emb.q.T.astype(cfg.dtype)).astype(jnp.float32)
+                return (lg[:, : cfg.d_model] * 1e-6).astype(cfg.dtype), None
+            x, _ = jax.lax.scan(body, x, None, length=K)
+            return x.sum().astype(jnp.float32)
+        return f
+    timed("unembed int8", mk_un_q, x0, emb)
+
+    def mk_un_b(K):
+        def f(x, e):
+            def body(x, _):
+                lg = (x @ e.T.astype(cfg.dtype)).astype(jnp.float32)
+                return (lg[:, : cfg.d_model] * 1e-6).astype(cfg.dtype), None
+            x, _ = jax.lax.scan(body, x, None, length=K)
+            return x.sum().astype(jnp.float32)
+        return f
+    timed("unembed bf16", mk_un_b, x0, params["embed"])
+
+if "attn" in PROBES:
+    kc0 = jnp.zeros((cfg.n_layers, B, MAX, cfg.n_kv_heads, cfg.head_dim), cfg.dtype)
+    q1 = jnp.ones((B, 1, cfg.n_heads, cfg.head_dim), cfg.dtype)
+    newk = jnp.ones((B, 1, cfg.n_kv_heads, cfg.head_dim), cfg.dtype)
+
+    def mk_attn(K):
+        def f(kc, vc, lengths):
+            def body(state, _):
+                kc, vc, lengths = state
+                def layer(carry, layer_kv):
+                    kcl, vcl = layer_kv
+                    upd = jax.vmap(lambda c, n, i: jax.lax.dynamic_update_slice(c, n, (i, 0, 0)))
+                    kcl = upd(kcl, newk, lengths)
+                    vcl = upd(vcl, newk, lengths)
+                    out = decode_attention(q1, kcl, vcl, lengths + 1)
+                    return carry + out.sum().astype(jnp.float32) * 0, (kcl, vcl)
+                _, (kc, vc) = jax.lax.scan(layer, jnp.zeros((), jnp.float32), (kc, vc))
+                return (kc, vc, lengths), None
+            state, _ = jax.lax.scan(body, (kc, vc, lengths), None, length=K)
+            return state[2].sum().astype(jnp.float32)
+        return f
+    timed("attn+update scan-stacked (18L)", mk_attn, kc0, kc0, jnp.full((B,), 128, jnp.int32))
+
+if "sample" in PROBES:
+    logits0 = jax.random.normal(jax.random.PRNGKey(1), (B, cfg.vocab_size), jnp.float32)
+
+    def mk_s(K):
+        def f(lg, tok, temps, rng):
+            def body(c, _):
+                tok, rng = c
+                l = lg + tok[:1, None].astype(jnp.float32) * 1e-9
+                rng, sub = jax.random.split(rng)
+                g = jnp.argmax(l, -1)
+                tv, ti = jax.lax.approx_max_k(l, 64)
+                loc = jax.random.categorical(sub, tv / jnp.maximum(temps, 1e-4)[:, None], axis=-1)
+                samp = jnp.take_along_axis(ti, loc[:, None], axis=1)[:, 0]
+                return (jnp.where(temps > 0, samp, g).astype(jnp.int32), rng), None
+            (tok, _), _ = jax.lax.scan(body, (tok, rng), None, length=K)
+            return tok.sum()
+        return f
+    timed("sample full (_sample equivalent)", mk_s, logits0,
+          jnp.zeros((B,), jnp.int32), jnp.zeros((B,), jnp.float32), jax.random.PRNGKey(0))
+
+if "norm" in PROBES:
+    def mk_norm(K):
+        from gofr_tpu.ops import rms_norm, apply_rope
+        def f(x, norms):
+            def body(x, _):
+                def layer(x, n):
+                    h = rms_norm(x[:, None, :], n, cfg.norm_eps)[:, 0, :]
+                    return (x + h * 1e-6).astype(x.dtype), None
+                x, _ = jax.lax.scan(layer, x, norms)
+                return x, None
+            x, _ = jax.lax.scan(body, x, None, length=K)
+            return x.sum().astype(jnp.float32)
+        return f
+    timed("rms_norm x18", mk_norm, x0, params["layers"]["attn_norm"])

@@ -1,0 +1,211 @@
+"""Round-3 probe: variants for each decode cost center found by
+profile_decode3.py. Scalar-only outputs (axon tunnel)."""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from gofr_tpu.models import TransformerConfig, init_params
+from gofr_tpu.models.quant import quantize_params
+from gofr_tpu.ops import decode_attention
+
+cfg = TransformerConfig.gemma_2b()
+B, MAX, K = 64, 208, 32
+print("device:", jax.devices()[0].device_kind, flush=True)
+
+params = jax.jit(lambda k: init_params(k, cfg))(jax.random.PRNGKey(0))
+qparams = jax.jit(lambda p: quantize_params(p, cfg.dtype))(params)
+_ = float(np.asarray(qparams["final_norm"])[0])
+
+
+def timed(name, fn, *args):
+    f = jax.jit(fn)
+    _ = float(np.asarray(f(*args)))
+    t0 = time.perf_counter()
+    _ = float(np.asarray(f(*args)))
+    dt = time.perf_counter() - t0
+    print(f"{name:52s} {dt/K*1e3:8.3f} ms/step", flush=True)
+    return dt / K
+
+
+PROBES = set(sys.argv[1:]) or {"un", "sample", "attn", "mm"}
+
+emb = qparams["embed"]
+x0 = jnp.ones((B, cfg.d_model), cfg.dtype)
+
+if "un" in PROBES:
+    # A: dequant-into-dot (current)
+    def un_a(x, emb):
+        def body(x, _):
+            lg = ((x * emb.s.astype(cfg.dtype)) @ emb.q.T.astype(cfg.dtype)).astype(jnp.float32)
+            return (lg[:, : cfg.d_model] * 1e-6).astype(cfg.dtype), None
+        x, _ = jax.lax.scan(body, x, None, length=K)
+        return x.sum().astype(jnp.float32)
+
+    timed("unembed A: bf16 @ convert(int8)", un_a, x0, emb)
+
+    # B: W8A8 — quantize activations per-row, s8xs8 -> s32 MXU native
+    def un_b(x, emb):
+        def body(x, _):
+            xs = x * emb.s.astype(cfg.dtype)
+            amax = jnp.max(jnp.abs(xs), axis=-1, keepdims=True).astype(jnp.float32)
+            xscale = jnp.maximum(amax / 127.0, 1e-8)
+            xq = jnp.clip(jnp.round(xs.astype(jnp.float32) / xscale), -127, 127).astype(jnp.int8)
+            acc = jax.lax.dot_general(
+                xq, emb.q,
+                (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.int32,
+            )
+            lg = acc.astype(jnp.float32) * xscale
+            return (lg[:, : cfg.d_model] * 1e-6).astype(cfg.dtype), None
+        x, _ = jax.lax.scan(body, x, None, length=K)
+        return x.sum().astype(jnp.float32)
+
+    timed("unembed B: s8 x s8 -> s32 MXU", un_b, x0, emb)
+
+    # C: bf16 weights (r2 baseline shape)
+    def un_c(x, emb):
+        def body(x, _):
+            lg = (x @ emb.T.astype(cfg.dtype)).astype(jnp.float32)
+            return (lg[:, : cfg.d_model] * 1e-6).astype(cfg.dtype), None
+        x, _ = jax.lax.scan(body, x, None, length=K)
+        return x.sum().astype(jnp.float32)
+
+    timed("unembed C: bf16 @ bf16", un_c, x0, params["embed"])
+
+if "sample" in PROBES:
+    logits0 = jax.random.normal(jax.random.PRNGKey(1), (B, cfg.vocab_size), jnp.float32)
+
+    def s_argmax(lg, tok):
+        def body(tok, _):
+            l = lg + tok[:1, None].astype(jnp.float32) * 1e-9
+            return jnp.argmax(l, -1).astype(jnp.int32), None
+        tok, _ = jax.lax.scan(body, tok, None, length=K)
+        return tok.sum()
+
+    timed("sample: argmax f32 only", s_argmax, logits0, jnp.zeros((B,), jnp.int32))
+
+    def s_topk(lg, tok):
+        def body(tok, _):
+            l = lg + tok[:1, None].astype(jnp.float32) * 1e-9
+            tv, ti = jax.lax.approx_max_k(l, 64)
+            return ti[:, 0].astype(jnp.int32), None
+        tok, _ = jax.lax.scan(body, tok, None, length=K)
+        return tok.sum()
+
+    timed("sample: approx_max_k(64) only", s_topk, logits0, jnp.zeros((B,), jnp.int32))
+
+    def s_topk_bf16(lg, tok):
+        lgb = lg.astype(jnp.bfloat16)
+        def body(tok, _):
+            l = lgb + tok[:1, None].astype(jnp.bfloat16) * 1e-9
+            tv, ti = jax.lax.approx_max_k(l, 64)
+            return ti[:, 0].astype(jnp.int32), None
+        tok, _ = jax.lax.scan(body, tok, None, length=K)
+        return tok.sum()
+
+    timed("sample: approx_max_k(64) bf16", s_topk_bf16, logits0, jnp.zeros((B,), jnp.int32))
+
+    def s_both_from_topk(lg, tok):
+        # greedy via the same top-k result (argmax == topi[argmax(topv)])
+        def body(tok, _):
+            l = lg + tok[:1, None].astype(jnp.float32) * 1e-9
+            tv, ti = jax.lax.approx_max_k(l, 64)
+            g = jnp.take_along_axis(ti, jnp.argmax(tv, -1)[:, None], axis=1)[:, 0]
+            return g.astype(jnp.int32), None
+        tok, _ = jax.lax.scan(body, tok, None, length=K)
+        return tok.sum()
+
+    timed("sample: greedy from topk (fused)", s_both_from_topk, logits0, jnp.zeros((B,), jnp.int32))
+
+if "attn" in PROBES:
+    kc0 = jnp.zeros((cfg.n_layers, B, MAX, cfg.n_kv_heads, cfg.head_dim), cfg.dtype)
+    q1 = jnp.ones((B, 1, cfg.n_heads, cfg.head_dim), cfg.dtype)
+    newk = jnp.ones((B, 1, cfg.n_kv_heads, cfg.head_dim), cfg.dtype)
+
+    def a_update_only(kc, vc, lengths):
+        def body(state, _):
+            kc, vc, lengths = state
+            def layer(carry, layer_kv):
+                kcl, vcl = layer_kv
+                upd = jax.vmap(lambda c, n, i: jax.lax.dynamic_update_slice(c, n, (i, 0, 0)))
+                kcl = upd(kcl, newk, lengths)
+                vcl = upd(vcl, newk, lengths)
+                return carry, (kcl, vcl)
+            _, (kc, vc) = jax.lax.scan(layer, jnp.zeros((), jnp.float32), (kc, vc))
+            return (kc, vc, lengths + 1), None
+        state, _ = jax.lax.scan(body, (kc, vc, lengths), None, length=K)
+        return state[2].sum().astype(jnp.float32)
+
+    timed("attn: cache scatter-update only (18L)", a_update_only, kc0, kc0,
+          jnp.full((B,), 128, jnp.int32))
+
+    def a_attend_only(kc, vc, lengths):
+        def body(state, _):
+            kc, vc, lengths = state
+            def layer(carry, layer_kv):
+                kcl, vcl = layer_kv
+                out = decode_attention(q1, kcl, vcl, lengths + 1)
+                return carry + out.sum().astype(jnp.float32) * 0, None
+            s, _ = jax.lax.scan(layer, jnp.zeros((), jnp.float32), (kc, vc))
+            return (kc, vc, lengths + 1), None
+        state, _ = jax.lax.scan(body, (kc, vc, lengths), None, length=K)
+        return state[2].sum().astype(jnp.float32)
+
+    timed("attn: attention only, no update (18L)", a_attend_only, kc0, kc0,
+          jnp.full((B,), 128, jnp.int32))
+
+    def a_no_stack(kc, vc, lengths):
+        # fori over layers, cache updated in place on the [L,...] array
+        def body(state, _):
+            kc, vc, lengths = state
+            def layer(l, st):
+                kc, vc, acc = st
+                kcl = jax.lax.dynamic_index_in_dim(kc, l, 0, keepdims=False)
+                vcl = jax.lax.dynamic_index_in_dim(vc, l, 0, keepdims=False)
+                upd = jax.vmap(lambda c, n, i: jax.lax.dynamic_update_slice(c, n, (i, 0, 0)))
+                kcl = upd(kcl, newk, lengths)
+                vcl = upd(vcl, newk, lengths)
+                out = decode_attention(q1, kcl, vcl, lengths + 1)
+                kc = jax.lax.dynamic_update_index_in_dim(kc, kcl, l, 0)
+                vc = jax.lax.dynamic_update_index_in_dim(vc, vcl, l, 0)
+                return kc, vc, acc + out.sum().astype(jnp.float32) * 0
+            kc, vc, _ = jax.lax.fori_loop(0, cfg.n_layers, layer, (kc, vc, jnp.zeros((), jnp.float32)))
+            return (kc, vc, lengths + 1), None
+        state, _ = jax.lax.scan(body, (kc, vc, lengths), None, length=K)
+        return state[2].sum().astype(jnp.float32)
+
+    timed("attn: fori in-place, no ys-stacking (18L)", a_no_stack, kc0, kc0,
+          jnp.full((B,), 128, jnp.int32))
+
+if "mm" in PROBES:
+    layers = qparams["layers"]
+
+    def mm_w8a8(x, layers):
+        def body(x, _):
+            def layer(x, lp):
+                def q8(h):
+                    amax = jnp.max(jnp.abs(h), axis=-1, keepdims=True).astype(jnp.float32)
+                    sc = jnp.maximum(amax / 127.0, 1e-8)
+                    return jnp.clip(jnp.round(h.astype(jnp.float32) / sc), -127, 127).astype(jnp.int8), sc
+                def dot8(h, w):
+                    hq, sc = q8(h)
+                    acc = jax.lax.dot_general(hq, w.q, (((1,), (0,)), ((), ())),
+                                              preferred_element_type=jnp.int32)
+                    return (acc.astype(jnp.float32) * sc * w.s.astype(jnp.float32)).astype(cfg.dtype)
+                q = dot8(x, lp["wq"])
+                kv = dot8(x, lp["wkv"])
+                o = dot8(q, lp["wo"])
+                d = dot8(jax.nn.gelu(dot8(x, lp["w_gate"])) * dot8(x, lp["w_up"]), lp["w_down"])
+                return (x + o + d + kv.sum() * 0).astype(x.dtype), None
+            x, _ = jax.lax.scan(layer, x, layers)
+            return x, None
+        x, _ = jax.lax.scan(body, x, None, length=K)
+        return x.sum().astype(jnp.float32)
+
+    timed("mm: W8A8 s8xs8->s32 per-layer matmuls", mm_w8a8, x0, layers)

@@ -1,0 +1,154 @@
+"""Quantized decode-step decomposition (round-3 companion to
+profile_decode2.py). Every probe runs K iterations inside one jitted scan
+and returns ONLY a scalar (the axon tunnel moves device->host at ~40MB/s).
+
+Usage: python scripts/profile_decode3.py [probe ...]
+Probes: full mm un attn sample  (default: all)  — all on int8 params.
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from gofr_tpu.models import TransformerConfig, init_params
+from gofr_tpu.models.quant import qmm, quantize_params
+from gofr_tpu.models.transformer import decode_step, init_cache
+from gofr_tpu.ops import decode_attention
+
+cfg = TransformerConfig.gemma_2b()
+B, MAX, K = 64, 208, 32
+print("device:", jax.devices()[0].device_kind, flush=True)
+
+params = jax.jit(lambda k: init_params(k, cfg))(jax.random.PRNGKey(0))
+qparams = jax.jit(lambda p: quantize_params(p, cfg.dtype))(params)
+_ = float(np.asarray(qparams["final_norm"])[0])
+
+
+def timed(name, fn, *args):
+    f = jax.jit(fn)
+    _ = float(np.asarray(f(*args)))  # compile + sync (scalar out)
+    t0 = time.perf_counter()
+    _ = float(np.asarray(f(*args)))
+    dt = time.perf_counter() - t0
+    print(f"{name:46s} {dt/K*1e3:8.2f} ms/step  ({dt*1e3:7.1f} ms / {K})", flush=True)
+    return dt / K
+
+
+PROBES = set(sys.argv[1:]) or {"full", "mm", "un", "attn", "sample"}
+results = {}
+
+if "full" in PROBES:
+    cache0 = init_cache(cfg, B, MAX)
+    cache0 = cache0._replace(length=jnp.full((B,), 128, jnp.int32))
+
+    def full_chunk(params, tok, cache):
+        def body(c, _):
+            tok, cache = c
+            logits, cache = decode_step(params, cfg, tok, cache)
+            return (jnp.argmax(logits, -1).astype(jnp.int32), cache), None
+
+        (tok, cache), _ = jax.lax.scan(body, (tok, cache), None, length=K)
+        return tok.sum()
+
+    results["full"] = timed(
+        "full int8 decode chunk (greedy)", full_chunk, qparams,
+        jnp.zeros((B,), jnp.int32), cache0,
+    )
+
+layers = qparams["layers"]
+
+if "mm" in PROBES:
+
+    def mm_chain(x, layers):
+        def body(x, _):
+            def layer(x, lp):
+                q = qmm(x, lp["wq"])
+                kv = qmm(x, lp["wkv"])
+                o = qmm(q, lp["wo"])
+                d = qmm(jax.nn.gelu(qmm(x, lp["w_gate"])) * qmm(x, lp["w_up"]), lp["w_down"])
+                return (x + o + d + kv.sum() * 0).astype(x.dtype), None
+
+            x, _ = jax.lax.scan(layer, x, layers)
+            return x, None
+
+        x, _ = jax.lax.scan(body, x, None, length=K)
+        return x.sum().astype(jnp.float32)
+
+    results["mm"] = timed(
+        "per-layer int8 matmuls only", mm_chain,
+        jnp.ones((B, cfg.d_model), cfg.dtype), layers,
+    )
+
+if "un" in PROBES:
+
+    def unembed_chain(x, emb):
+        def body(x, _):
+            logits = ((x * emb.s.astype(cfg.dtype)) @ emb.q.T.astype(cfg.dtype)).astype(
+                jnp.float32
+            )
+            return (logits[:, : cfg.d_model] * 1e-6).astype(cfg.dtype), None
+
+        x, _ = jax.lax.scan(body, x, None, length=K)
+        return x.sum().astype(jnp.float32)
+
+    results["un"] = timed(
+        "int8 unembed [B,d]@[d,V]", unembed_chain,
+        jnp.ones((B, cfg.d_model), cfg.dtype), qparams["embed"],
+    )
+
+if "attn" in PROBES:
+    kc0 = jnp.zeros((cfg.n_layers, B, MAX, cfg.n_kv_heads, cfg.head_dim), cfg.dtype)
+
+    def attn_chain(kc, vc, lengths):
+        q = jnp.ones((B, 1, cfg.n_heads, cfg.head_dim), cfg.dtype)
+        newk = jnp.ones((B, 1, cfg.n_kv_heads, cfg.head_dim), cfg.dtype)
+
+        def body(state, _):
+            kc, vc, lengths = state
+
+            def layer(carry, layer_kv):
+                kcl, vcl = layer_kv
+                upd = jax.vmap(
+                    lambda c, n, i: jax.lax.dynamic_update_slice(c, n, (i, 0, 0))
+                )
+                kcl = upd(kcl, newk, lengths)
+                vcl = upd(vcl, newk, lengths)
+                out = decode_attention(q, kcl, vcl, lengths + 1)
+                return carry + out.sum().astype(jnp.float32) * 0, (kcl, vcl)
+
+            _, (kc, vc) = jax.lax.scan(layer, jnp.zeros((), jnp.float32), (kc, vc))
+            return (kc, vc, lengths + 1), None
+
+        state, _ = jax.lax.scan(body, (kc, vc, lengths), None, length=K)
+        return state[2].sum().astype(jnp.float32)
+
+    results["attn"] = timed(
+        "attention+cache update (18 layers)", attn_chain, kc0, kc0,
+        jnp.full((B,), 128, jnp.int32),
+    )
+
+if "sample" in PROBES:
+    logits0 = jax.random.normal(jax.random.PRNGKey(1), (B, cfg.vocab_size), jnp.float32)
+
+    def sample_chain(logits0, tok):
+        def body(tok, _):
+            logits = logits0 + tok[:1, None].astype(jnp.float32) * 1e-9
+            g = jnp.argmax(logits, -1).astype(jnp.int32)
+            tv, ti = jax.lax.approx_max_k(logits, 64)
+            return g + ti[:, 0] * 0, None
+
+        tok, _ = jax.lax.scan(body, tok, None, length=K)
+        return tok.sum()
+
+    results["sample"] = timed(
+        "argmax + approx_max_k(64)", sample_chain, logits0, jnp.zeros((B,), jnp.int32)
+    )
+
+params_bytes = sum(x.size * x.dtype.itemsize for x in jax.tree.leaves(qparams))
+print(f"\nint8 weights-stream floor: {params_bytes/8.2e11*1e3:.2f} ms/step", flush=True)
+print({k: round(v * 1e3, 2) for k, v in results.items()}, flush=True)
