@@ -177,7 +177,14 @@ class LLMEngine:
             sampled = jnp.take_along_axis(topi, local[:, None], axis=1)[:, 0]
             return jnp.where(temps > 0.0, sampled, greedy).astype(jnp.int32)
 
-        def _prefill_op(params, tokens, lengths, temps, rng):
+        def _prefill_op(params, pack, rng):
+            """pack [nb, bucket+2] int32: tokens | lengths | temps-as-bits.
+            One packed host->device transfer per wave — through the axon
+            tunnel every h2d array costs ~3.5 ms of host-blocking latency
+            regardless of size, so the engine never ships loose vectors."""
+            tokens = pack[:, :-2]
+            lengths = pack[:, -2]
+            temps = jax.lax.bitcast_convert_type(pack[:, -1], jnp.float32)
             last_logits, cache = prefill(params, cfg, tokens, lengths, max_seq_len)
             rng, sub = jax.random.split(rng)
             first = _sample(last_logits, temps, sub)
@@ -193,8 +200,8 @@ class LLMEngine:
 
         M = self.admit_cap
 
-        def _insert_many(slot_cache, new_cache, slot_idx, rows):
-            """Copy new_cache row rows[i] into slot slot_idx[i] for i < M.
+        def _insert_many(slot_cache, new_cache, meta):
+            """Copy new_cache row meta[1][i] into slot meta[0][i] for i < M.
             Padding entries duplicate entry 0 (idempotent rewrite)."""
 
             def body(c, xs):
@@ -216,39 +223,67 @@ class LLMEngine:
                 )
                 return c._replace(k=k, v=v, length=length), None
 
-            cache, _ = jax.lax.scan(body, slot_cache, (slot_idx, rows))
+            cache, _ = jax.lax.scan(body, slot_cache, (meta[0], meta[1]))
             return cache
 
-        def _merge_tail(tail, slot_idx, rows, first):
+        def _admit_update(tail, active, temps, first, meta):
             """Scatter freshly-prefilled first tokens into the on-device
-            chain tail — admission never forces a host round trip. Padding
-            entries repeat slot_idx[0]/rows[0] (idempotent)."""
-            return tail.at[slot_idx].set(first[rows])
+            chain tail and mark the slots active with their temperatures —
+            admission never forces a host round trip. meta [3, M] int32:
+            slot_idx | rows | temps-as-bits; padding entries repeat index 0
+            (idempotent)."""
+            slot_idx, rows = meta[0], meta[1]
+            req_temps = jax.lax.bitcast_convert_type(meta[2], jnp.float32)
+            tail = tail.at[slot_idx].set(first[rows])
+            active = active.at[slot_idx].set(True)
+            temps = temps.at[slot_idx].set(req_temps)
+            return tail, active, temps
 
         self._prefill_op = jax.jit(_prefill_op)
         self._chunk_op = jax.jit(_chunk_op, donate_argnums=(2,))
         self._insert_many = jax.jit(_insert_many, donate_argnums=(0,))
-        self._merge_tail = jax.jit(_merge_tail, donate_argnums=(0,))
+        self._admit_update = jax.jit(_admit_update, donate_argnums=(0, 1, 2))
         self._rng = jax.random.PRNGKey(0)
 
         self.cache = init_cache(cfg, slots, max_seq_len)
         self._slot_req: list[GenRequest | None] = [None] * slots
-        self._gen = np.zeros((slots,), np.int64)  # per-slot assignment epoch
-        self._temps = np.zeros((slots,), np.float32)
-        self._tail = jnp.zeros((slots,), jnp.int32)  # device: next chunk input
+        # device-resident batch state: chain tail, active mask, temps.
+        # active is never cleared on retire (a stale True only advances a
+        # garbage cursor in an unowned slot, clamped in-bounds) — clearing
+        # would cost a host->device transfer per completion.
+        self._tail = jnp.zeros((slots,), jnp.int32)
+        self._active = jnp.zeros((slots,), bool)
+        self._temps = jnp.zeros((slots,), jnp.float32)
         self._admit_q: queue.Queue[GenRequest | None] = queue.Queue()
         self._stop = False
-        # in-flight device work, oldest first:
-        #   ("chunk", toks_dev [K,S], gens snapshot)
-        #   ("prefill", first_dev [nb], slots list, gens list)
+        # in-flight device work, oldest first. Entries snapshot the REQUEST
+        # objects they serve, so a slot can be reassigned while older
+        # chunks still carry its previous request's tokens:
+        #   ("chunk", toks_dev [K,S], [req-or-None per slot])
+        #   ("prefill", first_dev [nb], [(slot, req), ...])
         self._inflight: deque = deque()
+        # Two engine threads: the SCHEDULER owns every device dispatch
+        # (admission prefills, inserts, decode chunks); the COLLECTOR owns
+        # the blocking device->host fetches (~95 ms RTT each through the
+        # axon tunnel) and token emission. One thread doing both stalls
+        # dispatch behind every fetch and leaves the device idle.
+        self._lock = threading.RLock()
+        self._work_cv = threading.Condition(self._lock)  # inflight appended
+        self._kick = threading.Event()  # scheduler wake: submit/slots freed
+        self._processing: tuple | None = None  # entry popped, not yet emitted
         self._jnp = jnp
         self._jax = jax
 
         if warmup:
             self._warm()
-        self._thread = threading.Thread(target=self._loop, name="llm-engine", daemon=True)
+        self._thread = threading.Thread(
+            target=self._schedule_loop, name="llm-engine-sched", daemon=True
+        )
+        self._collector = threading.Thread(
+            target=self._collect_loop, name="llm-engine-collect", daemon=True
+        )
         self._thread.start()
+        self._collector.start()
 
     # -- public API -------------------------------------------------------
     def submit(self, req: GenRequest) -> GenRequest:
@@ -275,51 +310,65 @@ class LLMEngine:
             req.capped = True
         req.submitted_at = time.perf_counter()
         self._admit_q.put(req)
+        self._kick.set()
         return req
 
     def generate(self, prompt_tokens: list[int], **kw) -> list[int]:
         return self.submit(GenRequest(prompt_tokens, **kw)).tokens()
 
     def stats(self) -> dict:
-        return {
-            "slots": self.slots,
-            "active": sum(r is not None for r in self._slot_req),
-            "waiting": self._admit_q.qsize(),
-            "max_seq_len": self.max_seq_len,
-            "decode_chunk": self.decode_chunk,
-            "inflight_chunks": sum(1 for e in self._inflight if e[0] == "chunk"),
-        }
+        with self._lock:
+            return {
+                "slots": self.slots,
+                "active": sum(r is not None for r in self._slot_req),
+                "waiting": self._admit_q.qsize(),
+                "max_seq_len": self.max_seq_len,
+                "decode_chunk": self.decode_chunk,
+                "inflight_chunks": sum(1 for e in self._inflight if e[0] == "chunk"),
+            }
 
     def close(self) -> None:
         self._stop = True
         self._admit_q.put(None)
+        self._kick.set()
+        with self._work_cv:
+            self._work_cv.notify_all()
         self._thread.join(timeout=10)
+        with self._work_cv:
+            self._work_cv.notify_all()
+        self._collector.join(timeout=15)
+        self._abort_all()
+        while True:
+            try:
+                req = self._admit_q.get_nowait()
+            except queue.Empty:
+                break
+            if req is not None:
+                req.out.put(None)
 
     # -- engine internals -------------------------------------------------
     def _warm(self) -> None:
         jnp = self._jnp
         t0 = time.perf_counter()
         zero_rng = self._rng
-        idx = jnp.zeros((self.admit_cap,), jnp.int32)
+        meta = jnp.zeros((3, self.admit_cap), jnp.int32)
         for b in self.prefill_buckets:
             for nb in dict.fromkeys((1, self.admit_cap)):
-                toks = jnp.zeros((nb, b), jnp.int32)
-                lens = jnp.ones((nb,), jnp.int32)
-                temps = jnp.zeros((nb,), jnp.float32)
-                first, c, _ = self._prefill_op(self.params, toks, lens, temps, zero_rng)
-                self.cache = self._insert_many(self.cache, c, idx, idx % nb)
-                self._tail = self._merge_tail(self._tail, idx, idx % nb, first)
+                pack = jnp.zeros((nb, b + 2), jnp.int32)
+                pack = pack.at[:, -2].set(1)  # lengths
+                first, c, _ = self._prefill_op(self.params, pack, zero_rng)
+                self.cache = self._insert_many(self.cache, c, meta)
+                self._tail, self._active, self._temps = self._admit_update(
+                    self._tail, self._active, self._temps, first, meta
+                )
         toks, last, self.cache, _ = self._chunk_op(
-            self.params,
-            jnp.zeros((self.slots,), jnp.int32),
-            self.cache,
-            jnp.zeros((self.slots,), bool),
-            jnp.zeros((self.slots,), jnp.float32),
-            zero_rng,
+            self.params, self._tail, self.cache, self._active, self._temps, zero_rng,
         )
         _ = np.asarray(last)  # sync (block_until_ready is unreliable on axon)
         self.cache = self.cache._replace(length=jnp.zeros((self.slots,), jnp.int32))
         self._tail = jnp.zeros((self.slots,), jnp.int32)
+        self._active = jnp.zeros((self.slots,), bool)
+        self._temps = jnp.zeros((self.slots,), jnp.float32)
         if self.logger is not None:
             self.logger.info(
                 f"LLM engine warmed in {time.perf_counter() - t0:.1f}s "
@@ -333,26 +382,77 @@ class LLMEngine:
                 return b
         return self.max_seq_len
 
+    def _inflight_steps(self) -> dict[int, int]:
+        """Per-slot decode steps already dispatched for the CURRENT owner.
+        Includes the entry the collector popped but has not emitted yet
+        (its tokens are still coming). Call with the lock held."""
+        steps: dict[int, int] = {}
+        entries = list(self._inflight)
+        if self._processing is not None:
+            entries.append(self._processing)
+        for e in entries:
+            if e[0] != "chunk":
+                continue
+            snapshot = e[2]
+            for slot, r in enumerate(snapshot):
+                if r is not None and r is self._slot_req[slot]:
+                    steps[slot] = steps.get(slot, 0) + self.decode_chunk
+        return steps
+
     def _free_slots(self) -> list[int]:
-        return [i for i, r in enumerate(self._slot_req) if r is None]
+        """Free or VIRTUALLY free slots. A slot whose in-flight chunks
+        already cover its request's remaining tokens can be reassigned
+        immediately: the old request keeps receiving from the chunk
+        snapshots, the new request's prefill+insert are device-ordered
+        after those chunks, and the next dispatched chunk serves the new
+        occupant — admission overlaps the tail of the previous request
+        instead of waiting out a fetch round trip."""
+        steps = self._inflight_steps()
+        out = []
+        for i, r in enumerate(self._slot_req):
+            if r is None:
+                out.append(i)
+            elif r.emitted + steps.get(i, 0) >= r.max_new_tokens or r.cancelled:
+                out.append(i)
+        return out
 
     def _any_active(self) -> bool:
         return any(r is not None for r in self._slot_req)
 
+    def _needed_chunks(self) -> int:
+        """Decode chunks still required to finish every current occupant,
+        beyond what is already in flight — the dispatch gate. Bounds
+        speculation by real demand (an upper bound under eos/cancel, which
+        the host cannot project)."""
+        steps = self._inflight_steps()
+        worst = 0
+        for i, r in enumerate(self._slot_req):
+            if r is None or r.cancelled:
+                continue
+            remaining = r.max_new_tokens - r.emitted - steps.get(i, 0)
+            if remaining > worst:
+                worst = remaining
+        return -(-worst // self.decode_chunk)
+
     def _admit(self) -> bool:
-        """Pull waiting requests into free slots, prefilling per bucket.
-        Purely dispatch-side: decode chunks in flight are untouched (their
-        tokens for reused slots are dropped by generation tag), and the
-        first sampled tokens merge into the device tail without a host
-        round trip."""
+        """Pull waiting requests into (virtually) free slots, prefilling
+        per bucket. Purely dispatch-side: decode chunks in flight are
+        untouched, and the first sampled tokens merge into the device tail
+        without a host round trip."""
         jnp = self._jnp
-        free = self._free_slots()
+        with self._lock:
+            free = self._free_slots()
+            idle = (
+                not self._any_active()
+                and not self._inflight
+                and self._processing is None
+            )
         pulled: list[GenRequest] = []
         while len(pulled) < len(free):
             try:
                 # Block briefly only when fully idle; stay hot otherwise.
-                idle = not self._any_active() and not self._inflight and not pulled
-                req = self._admit_q.get(timeout=0.05) if idle else self._admit_q.get_nowait()
+                block = idle and not pulled
+                req = self._admit_q.get(timeout=0.05) if block else self._admit_q.get_nowait()
             except queue.Empty:
                 break
             if req is None:
@@ -377,48 +477,48 @@ class LLMEngine:
             # batch dim: 1 for lone requests, admit_cap otherwise — two
             # executables per bucket, never a per-burst compile
             nb = 1 if len(reqs) == 1 else self.admit_cap
-            toks = np.zeros((nb, bucket), np.int32)
-            lens = np.ones((nb,), np.int32)  # pad rows: 1 token, discarded
-            temps = np.zeros((nb,), np.float32)
+            pack = np.zeros((nb, bucket + 2), np.int32)
+            pack[:, -2] = 1  # pad rows: 1 token, discarded
             for j, r in enumerate(reqs):
                 n = len(r.prompt_tokens)
-                toks[j, :n] = r.prompt_tokens
-                lens[j] = n
-                temps[j] = r.temperature
+                pack[j, :n] = r.prompt_tokens
+                pack[j, -2] = n
+                pack[j, -1] = np.float32(r.temperature).view(np.int32)
             t0 = time.perf_counter()
             first_dev, new_cache, self._rng = self._prefill_op(
-                self.params, jnp.asarray(toks), jnp.asarray(lens),
-                jnp.asarray(temps), self._rng,
+                self.params, jnp.asarray(pack), self._rng,
             )
             if self.metrics is not None:
                 self.metrics.record_histogram(
                     "app_tpu_stats", time.perf_counter() - t0,
                     model="llm", op=f"prefill_dispatch_{bucket}",
                 )
-            free = self._free_slots()
-            slot_idx = np.zeros((self.admit_cap,), np.int32)
-            rows = np.zeros((self.admit_cap,), np.int32)
-            taken: list[int] = []
-            for j, r in enumerate(reqs):
-                slot = free.pop(0)
-                taken.append(slot)
-                self._slot_req[slot] = r
-                self._gen[slot] += 1
-                self._temps[slot] = r.temperature
-                slot_idx[j], rows[j] = slot, j
-            # pad entries duplicate entry 0 (idempotent)
-            for j in range(len(reqs), self.admit_cap):
-                slot_idx[j], rows[j] = slot_idx[0], rows[0]
-            self.cache = self._insert_many(
-                self.cache, new_cache, jnp.asarray(slot_idx), jnp.asarray(rows)
-            )
-            self._tail = self._merge_tail(
-                self._tail, jnp.asarray(slot_idx), jnp.asarray(rows), first_dev
-            )
-            self._start_fetch(first_dev)
-            self._inflight.append(
-                ("prefill", first_dev, list(taken), [self._gen[s] for s in taken])
-            )
+            with self._work_cv:
+                meta = np.zeros((3, self.admit_cap), np.int32)
+                taken: list[tuple[int, GenRequest]] = []
+                for j, r in enumerate(reqs):
+                    slot = free.pop(0)
+                    old = self._slot_req[slot]
+                    if old is not None and old.cancelled and old.finish_reason is None:
+                        # a cancelled occupant may have no in-flight snapshot
+                        # left to deliver its end-of-stream — close it here
+                        old.finish_reason = "cancelled"
+                        old.out.put(None)
+                    taken.append((slot, r))
+                    self._slot_req[slot] = r
+                    meta[0, j], meta[1, j] = slot, j
+                    meta[2, j] = np.float32(r.temperature).view(np.int32)
+                # pad entries duplicate entry 0 (idempotent)
+                for j in range(len(reqs), self.admit_cap):
+                    meta[:, j] = meta[:, 0]
+                md = jnp.asarray(meta)  # ONE packed h2d per wave
+                self.cache = self._insert_many(self.cache, new_cache, md)
+                self._tail, self._active, self._temps = self._admit_update(
+                    self._tail, self._active, self._temps, first_dev, md
+                )
+                self._start_fetch(first_dev)
+                self._inflight.append(("prefill", first_dev, taken))
+                self._work_cv.notify()
         return True
 
     @staticmethod
@@ -430,66 +530,63 @@ class LLMEngine:
             except Exception:  # pragma: no cover — backend-dependent
                 pass
 
-    def _emit_tokens(self, slot: int, toks: list[int]) -> None:
-        """Append a request's next tokens, honoring max_new/eos/cancel."""
-        r = self._slot_req[slot]
-        if r is None:
-            return
+    def _emit_to(self, r: GenRequest, slot: int, toks: list[int]) -> None:
+        """Append a request's next tokens, honoring max_new/eos/cancel.
+        Frees the slot only if `r` still owns it (virtual-free admission
+        may already have handed the slot to a successor)."""
+        if r.finish_reason is not None:
+            return  # already finished; stale chunk overlap
+        finish = None
         if r.cancelled:
-            r.finish_reason = "cancelled"
-            self._retire(slot)
-            return
+            toks, finish = [], "cancelled"
         take = min(len(toks), r.max_new_tokens - r.emitted)
         toks = toks[:take]
-        finish = None
         if r.eos_token >= 0 and r.eos_token in toks:
             toks = toks[: toks.index(r.eos_token) + 1]
             finish = "eos"
-        if r.emitted == 0 and r.submitted_at is not None and self.metrics is not None:
-            self.metrics.record_histogram(
-                "app_tpu_queue_wait", time.perf_counter() - r.submitted_at,
-                model="llm", op="ttft",
-            )
         if toks:
+            if r.emitted == 0 and r.submitted_at is not None and self.metrics is not None:
+                self.metrics.record_histogram(
+                    "app_tpu_queue_wait", time.perf_counter() - r.submitted_at,
+                    model="llm", op="ttft",
+                )
             r.out.put(toks)
             r.emitted += len(toks)
         if finish is None and r.emitted >= r.max_new_tokens:
             finish = "length"
         if finish is not None:
             r.finish_reason = finish
-            self._retire(slot)
-
-    def _retire(self, slot: int) -> None:
-        r = self._slot_req[slot]
-        if r is not None:
             r.out.put(None)
-        self._slot_req[slot] = None
-        self._gen[slot] += 1
-        self._temps[slot] = 0.0
+            if self._slot_req[slot] is r:
+                self._slot_req[slot] = None
 
     def _dispatch(self) -> None:
-        """Launch one decode chunk chained from the on-device tail."""
-        jnp = self._jnp
-        active = np.array([r is not None for r in self._slot_req])
-        toks, last, self.cache, self._rng = self._chunk_op(
-            self.params, self._tail, self.cache,
-            jnp.asarray(active), jnp.asarray(self._temps), self._rng,
-        )
-        self._tail = last
-        self._start_fetch(toks)
-        self._inflight.append(("chunk", toks, self._gen.copy()))
+        """Launch one decode chunk chained from the on-device tail. All
+        inputs are device-resident — zero h2d transfers per chunk."""
+        with self._work_cv:
+            snapshot = list(self._slot_req)
+            toks, last, self.cache, self._rng = self._chunk_op(
+                self.params, self._tail, self.cache, self._active, self._temps, self._rng,
+            )
+            self._tail = last
+            self._start_fetch(toks)
+            self._inflight.append(("chunk", toks, snapshot))
+            self._work_cv.notify()
 
-    def _process_one(self) -> None:
-        """Read back the oldest in-flight device result and emit tokens."""
-        entry = self._inflight.popleft()
+    def _process_entry(self, entry: tuple) -> None:
+        """Fetch one device result (outside the lock — the blocking RTT
+        must not stall the scheduler) and emit tokens (under the lock)."""
         if entry[0] == "prefill":
-            _, first_dev, slots_, gens = entry
+            _, first_dev, taken = entry
             first = np.asarray(first_dev)
-            for j, slot in enumerate(slots_):
-                if self._gen[slot] == gens[j]:
-                    self._emit_tokens(slot, [int(first[j])])
+            with self._lock:
+                for j, (slot, r) in enumerate(taken):
+                    self._emit_to(r, slot, [int(first[j])])
+                self._processing = None  # same acquisition as the emits —
+                # a separate clear would let the scheduler double-count
+                # this entry in _inflight_steps after emitted already grew
             return
-        _, toks_dev, gens = entry
+        _, toks_dev, snapshot = entry
         t0 = time.perf_counter()
         toks = np.asarray(toks_dev)  # [K, S] — blocks; device runs next chunk
         if self.metrics is not None:
@@ -498,45 +595,82 @@ class LLMEngine:
                 model="llm", op="decode_chunk",
             )
         cols = toks.T  # [S, K]
-        for slot in range(self.slots):
-            if self._slot_req[slot] is None or self._gen[slot] != gens[slot]:
-                continue
-            self._emit_tokens(slot, cols[slot].tolist())
+        with self._lock:
+            for slot, r in enumerate(snapshot):
+                if r is not None:
+                    self._emit_to(r, slot, cols[slot].tolist())
+            self._processing = None
 
-    def _flush(self) -> None:
-        while self._inflight:
-            self._process_one()
+    def _abort_all(self) -> None:
+        jnp = self._jnp
+        with self._lock:
+            for slot, r in enumerate(self._slot_req):
+                if r is not None and r.finish_reason is None:
+                    r.finish_reason = "cancelled"
+                    r.out.put(None)
+                self._slot_req[slot] = None
+            self._active = jnp.zeros((self.slots,), bool)
+            self._temps = jnp.zeros((self.slots,), jnp.float32)
 
-    def _loop(self) -> None:
+    def _schedule_loop(self) -> None:
         jnp = self._jnp
         while not self._stop:
             try:
-                self._admit()
+                did = self._admit()
                 if self._stop:
                     break
-                if self._any_active():
+                with self._lock:
                     depth = sum(1 for e in self._inflight if e[0] == "chunk")
-                    while depth < self.lookahead:
-                        self._dispatch()
+                    if self._processing is not None and self._processing[0] == "chunk":
                         depth += 1
-                if self._inflight:
-                    self._process_one()
+                    want = min(self._needed_chunks(), self.lookahead - depth)
+                for _ in range(max(0, want)):
+                    self._dispatch()
+                if not did and want <= 0:
+                    self._kick.wait(timeout=0.005)
+                    self._kick.clear()
             except Exception as e:  # noqa: BLE001 — engine must not die silently
                 if self.logger is not None:
                     self.logger.error(f"LLM engine step failed: {e!r}")
-                self._inflight.clear()
+                with self._lock:
+                    # virtually-freed requests live ONLY in the snapshots
+                    # being discarded — close them before clearing, or
+                    # their consumers never see an end-of-stream
+                    orphans: set = set()
+                    entries = list(self._inflight)
+                    if self._processing is not None:
+                        entries.append(self._processing)
+                    for entry in entries:
+                        if entry[0] == "prefill":
+                            orphans.update(r for _, r in entry[2])
+                        else:
+                            orphans.update(r for r in entry[2] if r is not None)
+                    for r in orphans:
+                        if r.finish_reason is None:
+                            r.finish_reason = "cancelled"
+                            r.out.put(None)
+                    self._inflight.clear()
                 self._tail = jnp.zeros((self.slots,), jnp.int32)
-                for slot in range(self.slots):
-                    self._retire(slot)
+                self._abort_all()
                 time.sleep(0.1)
-        # drain
-        self._flush()
-        for slot in range(self.slots):
-            self._retire(slot)
+
+    def _collect_loop(self) -> None:
         while True:
+            with self._work_cv:
+                while not self._inflight and not self._stop:
+                    self._work_cv.wait(timeout=0.1)
+                if not self._inflight:
+                    if self._stop:
+                        return
+                    continue
+                entry = self._inflight.popleft()
+                self._processing = entry
             try:
-                req = self._admit_q.get_nowait()
-            except queue.Empty:
-                break
-            if req is not None:
-                req.out.put(None)
+                self._process_entry(entry)
+            except Exception as e:  # noqa: BLE001
+                if self.logger is not None:
+                    self.logger.error(f"LLM engine fetch failed: {e!r}")
+            finally:
+                with self._lock:
+                    self._processing = None
+            self._kick.set()
