@@ -17,8 +17,13 @@ Backends:
 - KAFKA — real broker client speaking the Kafka wire protocol from scratch
   (kafka.py): batched producer, consumer-group committed offsets, topic
   admin, health (parity: reference kafka/kafka.go:83-268).
-- GOOGLE/MQTT — need driver libraries absent from this image; construction
-  fails with a clear message (the capability surface stays).
+- MQTT — real broker client speaking MQTT 3.1.1 from scratch (mqtt.py):
+  QoS 0/1, commit-on-success PUBACK, resume-subs reconnect, health
+  (parity: reference mqtt/mqtt.go:82-260).
+- GOOGLE — Google Pub/Sub v1 client speaking the emulator's gRPC surface
+  with a hand-rolled protobuf codec (google.py): topic/subscription
+  get-or-create, publish, server-held Pull loop, ack-on-commit (parity:
+  reference google/google.go:81-211).
 """
 
 from __future__ import annotations
@@ -302,9 +307,12 @@ def new_pubsub(backend: str, config, logger=None, metrics=None):
         from .kafka import KafkaConfig, KafkaPubSub
 
         return KafkaPubSub(KafkaConfig(config), logger=logger, metrics=metrics)
-    if backend in ("GOOGLE", "MQTT"):
-        raise RuntimeError(
-            f"PUBSUB_BACKEND={backend} needs its driver library, not present "
-            "in this environment; MEMORY, FILE and KAFKA backends are built in"
-        )
+    if backend == "MQTT":
+        from .mqtt import MQTTConfig, MQTTPubSub
+
+        return MQTTPubSub(MQTTConfig(config), logger=logger, metrics=metrics)
+    if backend == "GOOGLE":
+        from .google import GooglePubSub
+
+        return GooglePubSub(config, logger=logger, metrics=metrics)
     raise RuntimeError(f"unknown PUBSUB_BACKEND {backend!r}")

@@ -1,0 +1,171 @@
+"""In-process fake MQTT 3.1.1 broker speaking the real wire protocol.
+
+The test stand-in for Mosquitto, exactly as FakeKafkaBroker speaks the
+Kafka codec: unit tests drive the from-scratch MQTT client
+(datasource/pubsub/mqtt.py) end-to-end over TCP without an external
+service. Implements CONNECT/CONNACK, PUBLISH QoS 0/1 with routing to
+matching subscribers (incl. '+'/'#' filters), PUBACK bookkeeping,
+SUBSCRIBE/SUBACK, UNSUBSCRIBE/UNSUBACK, PINGREQ/PINGRESP, DISCONNECT.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+
+from ..datasource.pubsub import mqttproto as mp
+
+__all__ = ["FakeMQTTBroker"]
+
+
+class _Session:
+    def __init__(self, conn: socket.socket, client_id: str):
+        self.conn = conn
+        self.client_id = client_id
+        self.subs: dict[str, int] = {}  # filter -> qos
+        self.wlock = threading.Lock()
+        self.next_pid = 0
+
+    def send(self, frame: bytes) -> None:
+        with self.wlock:
+            self.conn.sendall(frame)
+
+
+class FakeMQTTBroker:
+    def __init__(self, host: str = "127.0.0.1", *, password: str | None = None):
+        self._sock = socket.socket()
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, 0))
+        self._sock.listen(16)
+        self.host = host
+        self.port = self._sock.getsockname()[1]
+        self.password = password  # when set, CONNECT must carry it
+        self._sessions: list[_Session] = []
+        self._lock = threading.Lock()
+        self._closed = False
+        # observability for tests
+        self.published: list[tuple[str, bytes, int]] = []  # (topic, payload, qos)
+        self.acked: list[int] = []  # packet ids PUBACKed by subscribers
+        self.connects: list[mp.ConnectInfo] = []
+        self._thread = threading.Thread(target=self._accept_loop, daemon=True)
+        self._thread.start()
+
+    @property
+    def address(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    def close(self) -> None:
+        self._closed = True
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        with self._lock:
+            for s in self._sessions:
+                try:
+                    s.conn.close()
+                except OSError:
+                    pass
+            self._sessions.clear()
+
+    def inject(self, topic: str, payload: bytes, qos: int = 0) -> None:
+        """Broker-originated message delivery (tests publish without a
+        second client)."""
+        self._route(topic, payload, qos)
+
+    # -- internals ---------------------------------------------------------
+    def _accept_loop(self) -> None:
+        while not self._closed:
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            threading.Thread(
+                target=self._serve_conn, args=(conn,), daemon=True
+            ).start()
+
+    @staticmethod
+    def _recv_exact(conn: socket.socket, n: int) -> bytes:
+        buf = b""
+        while len(buf) < n:
+            chunk = conn.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError("client closed")
+            buf += chunk
+        return buf
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        sess: _Session | None = None
+        try:
+            p = mp.read_packet_from(lambda n: self._recv_exact(conn, n))
+            if p.type != mp.CONNECT:
+                conn.close()
+                return
+            info = mp.parse_connect(p)
+            self.connects.append(info)
+            if self.password is not None and info.password != self.password:
+                conn.sendall(mp.connack_packet(False, 5))  # not authorized
+                conn.close()
+                return
+            sess = _Session(conn, info.client_id)
+            with self._lock:
+                self._sessions.append(sess)
+            conn.sendall(mp.connack_packet(False, 0))
+            while not self._closed:
+                p = mp.read_packet_from(lambda n: self._recv_exact(conn, n))
+                if p.type == mp.PUBLISH:
+                    pub = mp.parse_publish(p)
+                    self.published.append((pub.topic, pub.payload, pub.qos))
+                    if pub.qos > 0:
+                        sess.send(mp.puback_packet(pub.packet_id))
+                    self._route(pub.topic, pub.payload, pub.qos)
+                elif p.type == mp.SUBSCRIBE:
+                    sub = mp.parse_subscribe(p)
+                    for t, qos in sub.topics:
+                        sess.subs[t] = min(qos, 1)
+                    sess.send(
+                        mp.suback_packet(sub.packet_id, [min(q, 1) for _, q in sub.topics])
+                    )
+                elif p.type == mp.UNSUBSCRIBE:
+                    pid, topics = mp.parse_unsubscribe(p)
+                    for t in topics:
+                        sess.subs.pop(t, None)
+                    sess.send(mp.unsuback_packet(pid))
+                elif p.type == mp.PUBACK:
+                    self.acked.append(mp.parse_packet_id(p))
+                elif p.type == mp.PINGREQ:
+                    sess.send(mp.pingresp_packet())
+                elif p.type == mp.DISCONNECT:
+                    break
+        except (ConnectionError, OSError, ValueError):
+            pass
+        finally:
+            if sess is not None:
+                with self._lock:
+                    if sess in self._sessions:
+                        self._sessions.remove(sess)
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _route(self, topic: str, payload: bytes, qos: int) -> None:
+        with self._lock:
+            sessions = list(self._sessions)
+        for s in sessions:
+            grant = max(
+                (g for f, g in s.subs.items() if mp.topic_matches(f, topic)),
+                default=None,
+            )
+            if grant is None:
+                continue
+            eff = min(qos, grant)
+            if eff > 0:
+                s.next_pid = s.next_pid % 65535 + 1
+                frame = mp.publish_packet(topic, payload, qos=1, packet_id=s.next_pid)
+            else:
+                frame = mp.publish_packet(topic, payload, qos=0)
+            try:
+                s.send(frame)
+            except OSError:
+                pass

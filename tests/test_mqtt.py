@@ -1,0 +1,241 @@
+"""MQTT backend tests against the in-process fake broker speaking the same
+3.1.1 codec (testutil/fakemqtt.py) — the FakeKafkaBroker playbook.
+
+Parity spec: reference pkg/gofr/datasource/pubsub/mqtt/mqtt.go (Publish
+:163-189, msgChanMap subscribe :132-161, Unsubscribe/Disconnect/Health
+:215-260).
+"""
+
+import asyncio
+import time
+
+import pytest
+
+from gofr_tpu.config import new_mock_config
+from gofr_tpu.datasource.pubsub import Message, new_pubsub
+from gofr_tpu.datasource.pubsub import mqttproto as mp
+from gofr_tpu.datasource.pubsub.mqtt import MQTTConfig, MQTTPubSub
+from gofr_tpu.testutil.fakemqtt import FakeMQTTBroker
+
+
+def run(coro):
+    return asyncio.new_event_loop().run_until_complete(coro)
+
+
+@pytest.fixture()
+def broker():
+    b = FakeMQTTBroker()
+    yield b
+    b.close()
+
+
+def make_client(broker, **over) -> MQTTPubSub:
+    cfg = {"MQTT_HOST": broker.host, "MQTT_PORT": str(broker.port),
+           "MQTT_TIMEOUT": "5", **over}
+    return MQTTPubSub(MQTTConfig(new_mock_config(cfg)))
+
+
+class TestProtocol:
+    def test_remaining_length_round_trip(self):
+        for n in (0, 1, 127, 128, 16383, 16384, 2097151):
+            enc = mp.encode_remaining_length(n)
+            mult, got, i = 1, 0, 0
+            for d in enc:
+                got += (d & 0x7F) * mult
+                mult *= 128
+                i += 1
+                if not d & 0x80:
+                    break
+            assert got == n and i == len(enc)
+
+    def test_connect_round_trip(self):
+        frame = mp.connect_packet("cid", keepalive=17, username="u", password="p")
+        buf = bytearray(frame)
+
+        def take(n):
+            out = bytes(buf[:n]); del buf[:n]; return out
+
+        p = mp.read_packet_from(take)
+        info = mp.parse_connect(p)
+        assert (info.client_id, info.keepalive) == ("cid", 17)
+        assert (info.username, info.password) == ("u", "p")
+        assert info.clean_session
+
+    def test_publish_qos1_round_trip(self):
+        frame = mp.publish_packet("a/b", b"payload", qos=1, packet_id=42)
+        buf = bytearray(frame)
+
+        def take(n):
+            out = bytes(buf[:n]); del buf[:n]; return out
+
+        p = mp.read_packet_from(take)
+        pub = mp.parse_publish(p)
+        assert (pub.topic, pub.payload, pub.qos, pub.packet_id) == (
+            "a/b", b"payload", 1, 42,
+        )
+
+    def test_topic_filter_matching(self):
+        assert mp.topic_matches("a/b", "a/b")
+        assert not mp.topic_matches("a/b", "a/c")
+        assert mp.topic_matches("a/+", "a/b")
+        assert not mp.topic_matches("a/+", "a/b/c")
+        assert mp.topic_matches("a/#", "a/b/c")
+        assert mp.topic_matches("#", "anything/at/all")
+        assert not mp.topic_matches("a/b/c", "a/b")
+
+
+class TestMQTTPubSub:
+    def test_publish_subscribe_round_trip(self, broker):
+        c = make_client(broker)
+        try:
+            c.create_topic("orders")  # subscribes
+            c.publish_sync("orders", b"hello")
+            msg = run(c.subscribe("orders", timeout=5))
+            assert msg is not None and msg.value == b"hello"
+            assert msg.metadata["qos"] == "1"
+        finally:
+            c.close()
+
+    def test_qos1_commit_sends_puback(self, broker):
+        c = make_client(broker)
+        try:
+            c.create_topic("t")
+            broker.inject("t", b"x", qos=1)
+            msg = run(c.subscribe("t", timeout=5))
+            assert msg is not None
+            assert broker.acked == []
+            msg.commit()
+            deadline = time.monotonic() + 5
+            while not broker.acked and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert len(broker.acked) == 1
+        finally:
+            c.close()
+
+    def test_qos0_no_puback_expected(self, broker):
+        c = make_client(broker, MQTT_QOS="0")
+        try:
+            c.create_topic("t0")
+            c.publish_sync("t0", b"fire-and-forget")
+            msg = run(c.subscribe("t0", timeout=5))
+            assert msg is not None and msg.value == b"fire-and-forget"
+            msg.commit()  # no-op for qos 0
+            assert broker.published == [("t0", b"fire-and-forget", 0)]
+        finally:
+            c.close()
+
+    def test_wildcard_subscription(self, broker):
+        c = make_client(broker)
+        try:
+            c.create_topic("sensors/+/temp")
+            broker.inject("sensors/kitchen/temp", b"21")
+            msg = run(c.subscribe("sensors/+/temp", timeout=5))
+            assert msg is not None and msg.topic == "sensors/kitchen/temp"
+        finally:
+            c.close()
+
+    def test_unsubscribe_stops_delivery(self, broker):
+        c = make_client(broker)
+        try:
+            c.create_topic("u")
+            c.unsubscribe("u")
+            assert "u" not in c._subscribed
+            # a message routed while unsubscribed must not be queued
+            broker.inject("u", b"after")
+            time.sleep(0.2)
+            assert not c._queues.get("u")
+        finally:
+            c.close()
+
+    def test_two_clients_fan_out(self, broker):
+        c1, c2 = make_client(broker), make_client(broker)
+        try:
+            c1.create_topic("fan")
+            c2.create_topic("fan")
+            c1.publish_sync("fan", b"m")
+            m1 = run(c1.subscribe("fan", timeout=5))
+            m2 = run(c2.subscribe("fan", timeout=5))
+            assert m1.value == m2.value == b"m"
+        finally:
+            c1.close()
+            c2.close()
+
+    def test_auth_password(self):
+        b = FakeMQTTBroker(password="sekrit")
+        try:
+            good = MQTTPubSub(MQTTConfig(new_mock_config({
+                "MQTT_HOST": b.host, "MQTT_PORT": str(b.port),
+                "MQTT_USER": "svc", "MQTT_PASSWORD": "sekrit",
+            })))
+            assert good.health()["status"] == "UP"
+            good.close()
+            bad = MQTTPubSub(MQTTConfig(new_mock_config({
+                "MQTT_HOST": b.host, "MQTT_PORT": str(b.port),
+                "MQTT_USER": "svc", "MQTT_PASSWORD": "wrong",
+            })))
+            assert bad.health()["status"] == "DOWN"
+            bad.close()
+        finally:
+            b.close()
+
+    def test_health_up_down(self, broker):
+        c = make_client(broker)
+        try:
+            h = c.health()
+            assert h["status"] == "UP" and h["details"]["backend"] == "MQTT"
+            broker.close()
+            with pytest.raises(Exception):
+                c.publish_sync("x", b"y")
+            assert c.health()["status"] == "DOWN"
+        finally:
+            c.close()
+
+    def test_reconnect_resubscribes(self, broker):
+        c = make_client(broker)
+        try:
+            c.create_topic("r")
+            # sever every session; client should reconnect + resume subs
+            # (shutdown, not just close: close alone may not interrupt the
+            # peer's blocked recv)
+            import socket as _socket
+
+            with broker._lock:
+                for s in list(broker._sessions):
+                    try:
+                        s.conn.shutdown(_socket.SHUT_RDWR)
+                    except OSError:
+                        pass
+                    s.conn.close()
+            deadline = time.monotonic() + 10
+            msg = None
+            while msg is None and time.monotonic() < deadline:
+                broker.inject("r", b"back")
+                msg = c._pop_blocking("r", timeout=0.5)
+            assert msg is not None and msg.value == b"back"
+        finally:
+            c.close()
+
+    def test_async_facade(self, broker):
+        c = make_client(broker)
+        try:
+            async def flow():
+                c.create_topic("af")
+                await c.publish("af", b"async")
+                return await c.subscribe("af", timeout=5)
+
+            msg = run(flow())
+            assert isinstance(msg, Message) and msg.value == b"async"
+        finally:
+            c.close()
+
+    def test_new_pubsub_switch(self, broker):
+        cfg = new_mock_config({
+            "PUBSUB_BACKEND": "MQTT",
+            "MQTT_HOST": broker.host, "MQTT_PORT": str(broker.port),
+        })
+        c = new_pubsub("MQTT", cfg)
+        try:
+            assert isinstance(c, MQTTPubSub)
+            assert c.health()["status"] == "UP"
+        finally:
+            c.close()
