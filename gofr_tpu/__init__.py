@@ -21,6 +21,7 @@ Quick start::
 """
 
 from .app import App, new
+from .container.mock import new_mock_container
 from .context import Context
 from .http import (
     ErrorEntityNotFound,
@@ -49,6 +50,7 @@ __all__ = [
     "StreamingResponse",
     "new",
     "new_cmd",
+    "new_mock_container",
 ]
 
 

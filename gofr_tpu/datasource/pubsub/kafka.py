@@ -61,6 +61,9 @@ class KafkaConfig:
         self.start_offset = config.get_or_default("KAFKA_START_OFFSET", "earliest")
         self.partitions = int(config.get_or_default("KAFKA_PARTITIONS", "1"))
         self.client_id = config.get_or_default("APP_NAME", "gofr-tpu")
+        # producer-buffer cap: with all brokers down, retries must not grow
+        # the buffer unboundedly (OOM); publish raises once it is full
+        self.max_buffer = int(config.get_or_default("KAFKA_MAX_BUFFER", "10000"))
 
 
 class _Broker:
@@ -131,6 +134,7 @@ class KafkaPubSub(_BasePubSub):
         # producer batch buffer
         self._buf: list[tuple[str, bytes]] = []
         self._buf_bytes = 0
+        self._inflight_flush = 0  # popped for sending, still counted vs cap
         self._buf_lock = threading.Lock()
         self._flush_evt = threading.Event()
         self._closed = False
@@ -215,17 +219,34 @@ class KafkaPubSub(_BasePubSub):
         )
 
     def publish_sync(self, topic: str, value: bytes | str) -> None:
+        """Buffer the message for the batched producer. The publish-total
+        counter increments here; publish-SUCCESS increments only when the
+        produce response confirms delivery (_flush) — counting success at
+        buffer time would report messages a dead broker later drops."""
         raw = value if isinstance(value, bytes) else str(value).encode()
         with self._buf_lock:
+            if len(self._buf) + self._inflight_flush >= self.cfg.max_buffer:
+                if self.metrics is not None:
+                    self.metrics.increment_counter(
+                        "app_pubsub_publish_total_count", topic=topic
+                    )
+                raise KafkaError(
+                    kp.REQUEST_TIMED_OUT,
+                    f"producer buffer full ({self.cfg.max_buffer} messages) — "
+                    "brokers unreachable?",
+                )
             self._buf.append((topic, raw))
             self._buf_bytes += len(raw)
             full = (
                 len(self._buf) >= self.cfg.batch_size
                 or self._buf_bytes >= self.cfg.batch_bytes
             )
+        if self.metrics is not None:
+            self.metrics.increment_counter("app_pubsub_publish_total_count", topic=topic)
+        if self.logger is not None:
+            self.logger.debug({"mode": "PUB", "topic": topic, "bytes": len(raw)})
         if full:
             self._flush()
-        self._log_pub(topic, raw, True)
 
     def _flush_loop(self) -> None:
         interval = max(0.01, self.cfg.batch_timeout_ms / 1000.0)
@@ -246,8 +267,19 @@ class KafkaPubSub(_BasePubSub):
         with self._buf_lock:
             batch, self._buf = self._buf, []
             self._buf_bytes = 0
+            # messages popped for sending still occupy cap space: a publish
+            # arriving mid-flush must not fill the room a failed send will
+            # reclaim via _requeue (accepted messages are never dropped)
+            self._inflight_flush += len(batch)
         if not batch:
             return
+        try:
+            self._flush_batch(batch)
+        finally:
+            with self._buf_lock:
+                self._inflight_flush -= len(batch)
+
+    def _flush_batch(self, batch: list[tuple[str, bytes]]) -> None:
         # group by (leader broker) -> {topic: {pid: [(topic, raw)]}}
         by_tp: dict[str, dict[int, list[tuple[str, bytes]]]] = {}
         try:
@@ -296,6 +328,12 @@ class KafkaPubSub(_BasePubSub):
                             first_err = first_err or KafkaError(
                                 err, f"produce {topic}/{pid}"
                             )
+                        elif self.metrics is not None:
+                            # delivery confirmed: NOW count success
+                            self.metrics.increment_counter(
+                                "app_pubsub_publish_success_count",
+                                by=len(topics[topic][pid]), topic=topic,
+                            )
             except (OSError, ConnectionError) as e:
                 # transport failure: requeue everything aimed at this broker;
                 # other leaders' sends proceed (at-least-once, never drop)
@@ -307,9 +345,12 @@ class KafkaPubSub(_BasePubSub):
             raise first_err
 
     def _requeue(self, originals: list[tuple[str, bytes]]) -> None:
+        """Put unsent messages back at the head. Never drops: the cap is
+        enforced at publish time against buffered + in-flight counts, so a
+        requeue can at most restore the buffer to its pre-flush size."""
         with self._buf_lock:
             self._buf = list(originals) + self._buf
-            self._buf_bytes += sum(len(raw) for _t, raw in originals)
+            self._buf_bytes = sum(len(raw) for _t, raw in self._buf)
 
     # -- consumer ----------------------------------------------------------
     def _init_offsets(self, topic: str) -> None:
@@ -417,9 +458,12 @@ class KafkaPubSub(_BasePubSub):
             self.logger.debug(
                 {"mode": "SUB", "topic": topic, "partition": pid, "offset": rec.offset}
             )
+        meta = {"partition": str(pid), "offset": str(rec.offset)}
+        if rec.value is None:
+            meta["tombstone"] = "true"  # compaction delete marker
         return Message(
-            topic, rec.value,
-            metadata={"partition": str(pid), "offset": str(rec.offset)},
+            topic, rec.value if rec.value is not None else b"",
+            metadata=meta,
             committer=committer,
         )
 

@@ -39,6 +39,7 @@ NONE = 0
 OFFSET_OUT_OF_RANGE = 1
 UNKNOWN_TOPIC_OR_PARTITION = 3
 NOT_LEADER_FOR_PARTITION = 6
+REQUEST_TIMED_OUT = 7
 TOPIC_ALREADY_EXISTS = 36
 
 EARLIEST = -2
@@ -174,7 +175,7 @@ def encode_response(corr_id: int, body: bytes) -> bytes:
 @dataclass
 class Record:
     key: bytes | None
-    value: bytes
+    value: bytes | None  # None = tombstone (compaction delete marker)
     timestamp: int = -1
     offset: int = 0
     headers: dict = field(default_factory=dict)  # carried out-of-band (not in v1 wire)
@@ -213,7 +214,9 @@ def decode_message_set(data: bytes) -> list[Record]:
             ts = msg.i64() if magic >= 1 else -1
             key = msg.bytes_()
             value = msg.bytes_()
-            out.append(Record(key=key, value=value or b"", timestamp=ts, offset=offset))
+            # value=None is a TOMBSTONE (compaction delete marker) — distinct
+            # from an empty value on the wire; preserve the difference
+            out.append(Record(key=key, value=value, timestamp=ts, offset=offset))
         except EOFError:
             break
     return out

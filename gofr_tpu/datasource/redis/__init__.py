@@ -105,14 +105,35 @@ class Redis:
         # leak it). A WeakSet tracks live states for close()/health only.
         self._loop_attr = f"_gofr_redis_{next(_CLIENT_SEQ)}"  # never-recycled key
         self._states: "weakref.WeakSet[_ConnState]" = weakref.WeakSet()
+        self._loop_states: "weakref.WeakKeyDictionary | None" = None  # uvloop fallback
         self._map_lock = threading.Lock()
 
     def _conn_state(self) -> "_ConnState":
         loop = asyncio.get_running_loop()
         state = getattr(loop, self._loop_attr, None)
         if state is None:
+            with self._map_lock:
+                if self._loop_states is not None:
+                    state = self._loop_states.get(loop)
+        if state is None:
             state = _ConnState()
-            setattr(loop, self._loop_attr, state)
+            try:
+                setattr(loop, self._loop_attr, state)
+            except AttributeError:
+                # C-implemented loops without an instance __dict__ (uvloop)
+                # reject arbitrary attributes; fall back to a weak-key map
+                # (weak keys avoid leaking dead loops, and no id-recycling
+                # hazard since the loop object itself is the key). Init and
+                # writes stay under _map_lock — two loops hitting the
+                # fallback concurrently must not clobber each other's map.
+                with self._map_lock:
+                    if self._loop_states is None:
+                        self._loop_states = weakref.WeakKeyDictionary()
+                    existing = self._loop_states.get(loop)
+                    if existing is not None:
+                        state = existing
+                    else:
+                        self._loop_states[loop] = state
         with self._map_lock:
             # idempotent: re-register states that reconnect after close()
             self._states.add(state)

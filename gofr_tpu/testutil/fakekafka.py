@@ -196,7 +196,8 @@ class FakeKafkaBroker:
                     out, size = [], 0
                     for rec in log[offset:]:
                         out.append(rec)
-                        size += len(rec.value) + 34
+                        # value=None is a tombstone (0 payload bytes on wire)
+                        size += len(rec.value or b"") + 34
                         if size >= max_bytes:
                             break
                     resp[name][pid] = (kp.NONE, hw, kp.encode_message_set(out))

@@ -1,0 +1,159 @@
+"""Concurrency stress: hammer the Batcher and LLMEngine with many threads
+submitting / cancelling / closing while serving (VERDICT r2 §5: the
+shutdown-race drain in datasource/tpu and the engine's two-thread
+scheduler/collector handoff are load-bearing and were untested under
+contention). Each scenario repeats enough to surface ordering races but
+stays CI-fast (<10 s total on CPU).
+"""
+
+import random
+import threading
+
+import jax
+import numpy as np
+import pytest
+
+from gofr_tpu.datasource.tpu import TPURuntime
+from gofr_tpu.llm import GenRequest, LLMEngine
+from gofr_tpu.logging import new_logger
+from gofr_tpu.models import TransformerConfig, init_params
+
+CFG = TransformerConfig.tiny()
+QUIET = new_logger(level_name="CRITICAL")
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(jax.random.PRNGKey(0), CFG)
+
+
+class TestBatcherStress:
+    def test_submit_storm_many_threads(self):
+        rt = TPURuntime(None, QUIET, None)
+        rt.register_model(
+            "sq", lambda p, x: x * x, {}, example_args=(np.zeros(4, np.float32),),
+            max_batch=16, max_delay_ms=0.5,
+        )
+        errs: list = []
+
+        def worker(seed):
+            rng = np.random.default_rng(seed)
+            try:
+                for _ in range(25):
+                    x = rng.normal(size=4).astype(np.float32)
+                    out = rt.infer_one("sq", x, timeout=30)
+                    assert np.allclose(out, x * x, atol=1e-5)
+            except Exception as e:  # noqa: BLE001
+                errs.append(e)
+
+        ts = [threading.Thread(target=worker, args=(i,)) for i in range(16)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=60)
+        rt.close()
+        assert not errs, errs[:3]
+
+    def test_close_while_submitting(self):
+        """close() must never hang or crash, and every in-flight future must
+        resolve (result or CancelledError/RuntimeError) — no stuck waiters."""
+        for _rep in range(5):
+            rt = TPURuntime(None, QUIET, None)
+            rt.register_model(
+                "sq", lambda p, x: x * x, {}, example_args=(np.zeros(4, np.float32),),
+                max_batch=8, max_delay_ms=0.2,
+            )
+            stop = threading.Event()
+            outcomes: list = []
+
+            def worker():
+                x = np.ones(4, np.float32)
+                while not stop.is_set():
+                    try:
+                        rt.infer_one("sq", x, timeout=10)
+                        outcomes.append("ok")
+                    except Exception:  # noqa: BLE001 — shutdown races surface here
+                        outcomes.append("err")
+                        return
+
+            ts = [threading.Thread(target=worker) for _ in range(8)]
+            for t in ts:
+                t.start()
+            # let traffic flow, then yank the runtime out from under it
+            deadline = threading.Event()
+            deadline.wait(0.15)
+            rt.close()
+            stop.set()
+            for t in ts:
+                t.join(timeout=20)
+                assert not t.is_alive(), "worker stuck after close()"
+            assert "ok" in outcomes or outcomes, "no requests completed at all"
+
+
+class TestEngineStress:
+    def test_submit_cancel_storm(self, params):
+        eng = LLMEngine(
+            CFG, params, slots=4, max_seq_len=64, prefill_buckets=(8,),
+            decode_chunk=4, logger=QUIET,
+        )
+        errs: list = []
+
+        def worker(seed):
+            rng = random.Random(seed)
+            try:
+                for _ in range(10):
+                    req = GenRequest(
+                        [rng.randrange(1, 500) for _ in range(rng.randrange(1, 8))],
+                        max_new_tokens=rng.randrange(1, 6),
+                    )
+                    if rng.random() < 0.3:
+                        req.cancel()  # sometimes before submit
+                    eng.submit(req)
+                    if rng.random() < 0.3:
+                        req.cancel()  # sometimes mid-flight
+                    toks = req.tokens(timeout=60)
+                    if not req.cancelled:
+                        assert len(toks) == req.max_new_tokens
+            except Exception as e:  # noqa: BLE001
+                errs.append(e)
+
+        ts = [threading.Thread(target=worker, args=(i,)) for i in range(8)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=120)
+            assert not t.is_alive(), "client stuck — token stream never ended"
+        eng.close()
+        assert not errs, errs[:3]
+
+    def test_close_with_inflight_requests(self, params):
+        """Every submitted request must see an end-of-stream (None) even
+        when the engine closes mid-generation — the drain path."""
+        for _rep in range(3):
+            eng = LLMEngine(
+                CFG, params, slots=2, max_seq_len=64, prefill_buckets=(8,),
+                decode_chunk=4, logger=QUIET,
+            )
+            reqs = [
+                eng.submit(GenRequest([1 + i, 2], max_new_tokens=40))
+                for i in range(6)
+            ]
+            eng.close()
+            for r in reqs:
+                # stream must terminate (possibly short) without hanging
+                toks = r.tokens(timeout=30)
+                assert len(toks) <= 40
+
+    def test_warmupless_engine_first_burst(self, params):
+        """warmup=False: the first real burst compiles on the engine
+        thread while clients wait — must still deliver."""
+        eng = LLMEngine(
+            CFG, params, slots=2, max_seq_len=64, prefill_buckets=(8,),
+            decode_chunk=4, warmup=False, logger=QUIET,
+        )
+        try:
+            reqs = [eng.submit(GenRequest([i + 1], max_new_tokens=2)) for i in range(4)]
+            for r in reqs:
+                assert len(r.tokens(timeout=120)) == 2
+        finally:
+            eng.close()
