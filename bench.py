@@ -234,6 +234,7 @@ def bench_serving(args) -> dict:
 
     S = args.prefill_len
     quantize = args.quantize and on_tpu
+    t0 = time.time()
     eng = LLMEngine(
         cfg, params, slots=args.batch,
         # prompts are S-8 long; leave new_tokens + 2 chunks of cap margin
@@ -241,6 +242,7 @@ def bench_serving(args) -> dict:
         prefill_buckets=(S,), decode_chunk=args.decode_chunk,
         admit_cap=args.admit_cap, quantize=quantize,
     )
+    engine_init_s = time.time() - t0
     n_params = sum(x.size for x in jax.tree.leaves(params))
     raw = _raw_probes(eng, cfg, args, S, args.batch)
 
@@ -285,6 +287,7 @@ def bench_serving(args) -> dict:
         "int8": quantize,
         "params_b": round(n_params / 1e9, 2),
         "init_s": round(init_s, 1),
+        "engine_init_s": round(engine_init_s, 1),
         "device": jax.devices()[0].device_kind,
         "target_note": (
             "vs_baseline = QPS / 1000 (north-star floor: >=1k QPS/chip at "

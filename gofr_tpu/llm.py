@@ -132,6 +132,9 @@ class LLMEngine:
 
         from .models.transformer import decode_chunk as chunk_fn
         from .models.transformer import init_cache, prefill
+        from .utils import enable_compilation_cache
+
+        enable_compilation_cache(logger=logger)
 
         if quantize:
             from .models.quant import is_quantized, quantize_param_specs, quantize_params
@@ -350,27 +353,62 @@ class LLMEngine:
 
     # -- engine internals -------------------------------------------------
     def _warm(self) -> None:
+        """Compile every serving executable before traffic arrives. The
+        compiles run CONCURRENTLY on a small thread pool: XLA releases the
+        GIL while compiling and each jitted function owns its own cache
+        entry, so the prefill variants, the decode chunk, and the admission
+        ops overlap instead of serializing (r2's sequential warm took ~21 s;
+        overlapped it is bounded by the slowest single program)."""
+        from concurrent.futures import ThreadPoolExecutor
+
         jnp = self._jnp
         t0 = time.perf_counter()
         zero_rng = self._rng
         meta = jnp.zeros((3, self.admit_cap), jnp.int32)
-        for b in self.prefill_buckets:
+
+        from .models.transformer import init_cache
+
+        def warm_prefill(nb: int, b: int):
+            pack = jnp.zeros((nb, b + 2), jnp.int32).at[:, -2].set(1)
+            first, c, _ = self._prefill_op(self.params, pack, zero_rng)
+            return first, c
+
+        def warm_cache_ops():
+            """insert (both admission batch sizes), admit_update (both
+            first-token shapes), then the decode chunk — CHAINED through
+            the real slot cache by donation, exactly like live serving, so
+            warm's peak memory never holds a second full-size cache and no
+            two ops donate the same buffer."""
+            cache = self.cache
             for nb in dict.fromkeys((1, self.admit_cap)):
-                pack = jnp.zeros((nb, b + 2), jnp.int32)
-                pack = pack.at[:, -2].set(1)  # lengths
-                first, c, _ = self._prefill_op(self.params, pack, zero_rng)
-                self.cache = self._insert_many(self.cache, c, meta)
-                self._tail, self._active, self._temps = self._admit_update(
-                    self._tail, self._active, self._temps, first, meta
+                scratch = init_cache(self.cfg, nb, self.max_seq_len)
+                cache = self._insert_many(cache, scratch, meta)
+                self._admit_update(
+                    jnp.zeros((self.slots,), jnp.int32),
+                    jnp.zeros((self.slots,), bool),
+                    jnp.zeros((self.slots,), jnp.float32),
+                    jnp.zeros((nb,), jnp.int32), meta,
                 )
-        toks, last, self.cache, _ = self._chunk_op(
-            self.params, self._tail, self.cache, self._active, self._temps, zero_rng,
-        )
+            toks, last, cache, _ = self._chunk_op(
+                self.params,
+                jnp.zeros((self.slots,), jnp.int32), cache,
+                jnp.zeros((self.slots,), bool),
+                jnp.zeros((self.slots,), jnp.float32), zero_rng,
+            )
+            return last, cache
+
+        with ThreadPoolExecutor(max_workers=4) as pool:
+            futs = [pool.submit(warm_cache_ops)]
+            for b in self.prefill_buckets:
+                for nb in dict.fromkeys((1, self.admit_cap)):
+                    futs.append(pool.submit(warm_prefill, nb, b))
+            last, cache = futs[0].result()
+            for f in futs[1:]:
+                f.result()
         _ = np.asarray(last)  # sync (block_until_ready is unreliable on axon)
-        self.cache = self.cache._replace(length=jnp.zeros((self.slots,), jnp.int32))
-        self._tail = jnp.zeros((self.slots,), jnp.int32)
-        self._active = jnp.zeros((self.slots,), bool)
-        self._temps = jnp.zeros((self.slots,), jnp.float32)
+        # the chain donated self.cache; adopt the output (zeros in, zeros
+        # out — only length needs resetting)
+        self.cache = cache._replace(length=jnp.zeros((self.slots,), jnp.int32))
         if self.logger is not None:
             self.logger.info(
                 f"LLM engine warmed in {time.perf_counter() - t0:.1f}s "
