@@ -199,20 +199,30 @@ def _open_loop(eng, cfg, prompt_len: int, new_tokens: int, rate: float,
     t0 = time.perf_counter()
     futs = []
     for i in range(n):
-        now = time.perf_counter() - t0
-        wait = arrivals[i] - now
-        if wait > 0:
-            time.sleep(wait)
+        # hybrid sleep+spin pacing: bare time.sleep overshoots by 1-5 ms
+        # under GIL contention with the consumer pool, silently lowering
+        # the offered rate ~10-20% at 200 QPS
+        while True:
+            wait = arrivals[i] - (time.perf_counter() - t0)
+            if wait <= 0:
+                break
+            if wait > 0.002:
+                time.sleep(wait - 0.002)
         t_arrival = t0 + arrivals[i]
         req = eng.submit(GenRequest(prompts[i], max_new_tokens=new_tokens))
         futs.append(pool.submit(consume, req, t_arrival))
+    submit_end = time.perf_counter() - t0
     for f in futs:
         f.result(timeout=600)
     wall = time.perf_counter() - t0
     pool.shutdown(wait=False)
     return {
         "offered_qps": rate,
+        # wall includes the post-window drain, so achieved < offered even
+        # when the engine keeps up; drain_ms tells the two cases apart
+        # (bounded drain = keeping up; drain ~ backlog = overloaded)
         "achieved_qps": round(n / wall, 1),
+        "drain_ms": round((wall - submit_end) * 1e3, 1),
         "p50_ms": round(_percentile(lat, 0.50) * 1e3, 1),
         "p99_ms": round(_percentile(lat, 0.99) * 1e3, 1),
         "ttft_p50_ms": round(_percentile(ttft, 0.50) * 1e3, 1),
