@@ -236,9 +236,24 @@ def bench_serving(args) -> dict:
     from gofr_tpu.models import TransformerConfig, init_params
 
     on_tpu = jax.default_backend() == "tpu"
-    cfg = TransformerConfig.gemma_2b() if on_tpu else TransformerConfig.tiny()
+    seven_b = on_tpu and args.model_size == "7b"
     t0 = time.time()
-    params = jax.jit(lambda k: init_params(k, cfg))(jax.random.PRNGKey(0))
+    if seven_b:
+        # Gemma-7B does NOT fit a v5e chip in bf16 (16.4 GB > 16 GB HBM);
+        # int8 (8.2 GB) does — init directly quantized on device.
+        from gofr_tpu.models.quant import init_params_quantized
+
+        cfg = TransformerConfig.gemma_7b()
+        params = jax.jit(lambda k: init_params_quantized(k, cfg))(jax.random.PRNGKey(0))
+        # 7B-sized engine defaults unless the user overrode them
+        if args.batch == 128:
+            args.batch = 32
+        if args.admit_cap == 16:
+            args.admit_cap = 8
+        args.no_short = True
+    else:
+        cfg = TransformerConfig.gemma_2b() if on_tpu else TransformerConfig.tiny()
+        params = jax.jit(lambda k: init_params(k, cfg))(jax.random.PRNGKey(0))
     _ = float(np.asarray(params["final_norm"])[0])  # sync
     init_s = time.time() - t0
 
@@ -336,7 +351,7 @@ def bench_serving(args) -> dict:
         }
 
     return {
-        "metric": "gemma2b_serving_qps_per_chip",
+        "metric": f"gemma{'7b' if seven_b else '2b'}_serving_qps_per_chip",
         "value": round(qps, 1),
         "unit": "req/s (16-tok completions)",
         "vs_baseline": round(qps / 1000.0, 3),
@@ -500,6 +515,8 @@ def main() -> None:
                     help="skip the short-prompt north-star operating point")
     ap.add_argument("--no-subruns", action="store_true",
                     help="skip the greet/mlp sub-benchmarks (configs 1-2)")
+    ap.add_argument("--model-size", choices=("2b", "7b"), default="2b",
+                    help="7b: Gemma-7B int8 single-chip (doesn't fit bf16)")
     # shared knobs
     ap.add_argument("--requests", type=int, default=None)
     ap.add_argument("--concurrency", type=int, default=512)

@@ -28,6 +28,7 @@ __all__ = [
     "qmm_a8",
     "quantize_params",
     "quantize_param_specs",
+    "init_params_quantized",
     "is_quantized",
 ]
 
@@ -116,6 +117,45 @@ def quantize_params(params: dict, dtype=jnp.bfloat16) -> dict:
         "embed": quantize(params["embed"], dtype),
         "final_norm": params["final_norm"],
         "layers": layers,
+    }
+
+
+def init_params_quantized(rng, cfg, dtype=jnp.bfloat16) -> dict:
+    """Random-weight int8 param tree built DIRECTLY on device.
+
+    Benchmark/test initializer for models whose bf16 tree does not fit
+    HBM: Gemma-7B is ~16.4 GB bf16 — over a v5e chip's 16 GB — but
+    8.2 GB int8, so init-then-quantize would OOM before quantize ran.
+    Draws int8 weights uniform in [-127, 127] with per-channel scales
+    matching init_params' 1/sqrt(fan_in) magnitude; norms stay zeros
+    (the real-weights path is models.checkpoint + quantize_params)."""
+    import jax
+
+    d, hd, hq, hkv, ff, L = (
+        cfg.d_model, cfg.head_dim, cfg.n_heads, cfg.n_kv_heads, cfg.d_ff, cfg.n_layers,
+    )
+    keys = iter(jax.random.split(rng, 8))
+
+    def qw(shape, fan_in):
+        q = jax.random.randint(next(keys), shape, -127, 128, jnp.int8)
+        # scale so dequantized std ~ 1/sqrt(fan_in) (uniform int8 std ~73)
+        s_shape = shape[:-2] + (1, shape[-1])
+        s = jnp.full(s_shape, 1.0 / (73.0 * fan_in**0.5), dtype)
+        return QTensor(q=q, s=s)
+
+    return {
+        "embed": qw((cfg.vocab_size, d), d),
+        "final_norm": jnp.zeros((d,), dtype),
+        "layers": {
+            "attn_norm": jnp.zeros((L, d), dtype),
+            "wq": qw((L, d, hq * hd), d),
+            "wkv": qw((L, d, 2 * hkv * hd), d),
+            "wo": qw((L, hq * hd, d), hq * hd),
+            "mlp_norm": jnp.zeros((L, d), dtype),
+            "w_gate": qw((L, d, ff), d),
+            "w_up": qw((L, d, ff), d),
+            "w_down": qw((L, ff, d), ff),
+        },
     }
 
 

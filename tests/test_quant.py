@@ -108,6 +108,27 @@ class TestQuantizedModel:
         assert np.abs(got - ref).max() / (np.abs(ref).max() + 1e-6) < 0.05
 
 
+class TestDirectQuantizedInit:
+    def test_init_params_quantized_serves(self):
+        """Device-direct int8 init (no bf16 materialization — how Gemma-7B
+        fits a 16 GB chip) must flow through the engine end to end."""
+        from gofr_tpu.llm import LLMEngine
+        from gofr_tpu.models.quant import init_params_quantized
+
+        qp = init_params_quantized(jax.random.PRNGKey(0), CFG, jnp.float32)
+        assert is_quantized(qp)
+        assert qp["layers"]["wq"].q.dtype == jnp.int8
+        assert qp["layers"]["wq"].s.shape == (CFG.n_layers, 1, 64)
+        eng = LLMEngine(
+            CFG, qp, slots=2, max_seq_len=64, prefill_buckets=(8,), quantize=True,
+        )
+        try:
+            out = eng.generate([3, 1, 4], max_new_tokens=4)
+            assert len(out) == 4
+        finally:
+            eng.close()
+
+
 class TestQuantizedEngine:
     def test_engine_serves_quantized(self, params):
         from gofr_tpu.llm import GenRequest, LLMEngine
