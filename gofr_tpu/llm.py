@@ -281,6 +281,7 @@ class LLMEngine:
         self._work_cv = threading.Condition(self._lock)  # inflight appended
         self._kick = threading.Event()  # scheduler wake: submit/slots freed
         self._processing: tuple | None = None  # entry popped, not yet emitted
+        self._jumped = False  # prefill-priority ration (one per chunk)
         self._jnp = jnp
         self._jax = jax
 
@@ -757,7 +758,29 @@ class LLMEngine:
                     if self._stop:
                         return
                     continue
-                entry = self._inflight.popleft()
+                # TTFT: serve prefill entries (first tokens of fresh
+                # requests) before queued chunk fetches. Only ordering
+                # WITHIN a request matters, and a request's prefill always
+                # precedes its chunks in the deque — jumping a prefill
+                # ahead of other requests' chunk tokens is safe. The jump
+                # is rationed to one per processed chunk: unbounded
+                # priority starves chunk emission whenever fresh arrivals
+                # keep the prefill queue non-empty (measured: p50 3x worse
+                # at 50 QPS).
+                idx = 0
+                if not self._jumped:
+                    idx = next(
+                        (i for i, e in enumerate(self._inflight) if e[0] == "prefill"),
+                        0,
+                    )
+                if idx:
+                    entry = self._inflight[idx]
+                    del self._inflight[idx]
+                    self._jumped = True
+                else:
+                    entry = self._inflight.popleft()
+                    if entry[0] == "chunk":
+                        self._jumped = False
                 self._processing = entry
             try:
                 self._process_entry(entry)

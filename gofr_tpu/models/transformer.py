@@ -336,6 +336,7 @@ def decode_chunk(
     *,
     n_steps: int,
     sample_fn,  # (logits [b, vocab] f32, temps [b], key) -> tokens [b] int32
+    unroll: int = 1,  # outer-scan unroll (XLA overlaps step boundaries)
 ) -> tuple[jnp.ndarray, jnp.ndarray, KVCache, jax.Array]:
     """n_steps fused decode steps — the serving engine's hot loop.
 
@@ -404,7 +405,8 @@ def decode_chunk(
         return (nt, kb, vb), nt
 
     (last, kb, vb), toks = jax.lax.scan(
-        step, (tokens, kb0, vb0), (jnp.arange(K, dtype=jnp.int32), keys)
+        step, (tokens, kb0, vb0), (jnp.arange(K, dtype=jnp.int32), keys),
+        unroll=unroll,
     )
 
     # merge: one scatter per chunk. Inactive slots write garbage rows at a
