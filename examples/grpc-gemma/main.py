@@ -71,6 +71,10 @@ def build_engine(app):
         slots=int(os.environ.get("LLM_SLOTS", "4")),
         max_seq_len=int(os.environ.get("LLM_MAX_SEQ", "256")),
         prefill_buckets=(16, 64, 128),
+        # GEMMA_INT8=1: serve int8 weights (W8A8 prefill, weight-only
+        # decode) — halves the HBM stream decode is bound by, and the only
+        # way 7B fits one v5e chip
+        quantize=os.environ.get("GEMMA_INT8", "").lower() in ("1", "true"),
         **kw,
     )
 
