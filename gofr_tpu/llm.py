@@ -59,7 +59,9 @@ __all__ = ["LLMEngine", "GenRequest"]
 _EOS_DEFAULT = -1  # no EOS cut by default (random-weight models)
 
 
-@dataclass
+@dataclass(eq=False)  # identity semantics: requests are handles, and the
+# engine's error path collects them in sets (dataclass __eq__ would make
+# them unhashable and value-compared)
 class GenRequest:
     prompt_tokens: list[int]
     max_new_tokens: int = 32

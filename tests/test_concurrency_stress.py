@@ -144,6 +144,39 @@ class TestEngineStress:
                 toks = r.tokens(timeout=30)
                 assert len(toks) <= 40
 
+    def test_recovers_from_device_error(self, params):
+        """A transient dispatch failure must close every live request with
+        an end-of-stream (including virtually-freed ones living only in
+        chunk snapshots) and leave the engine serving new traffic."""
+        eng = LLMEngine(
+            CFG, params, slots=2, max_seq_len=64, prefill_buckets=(8,),
+            decode_chunk=4, logger=QUIET,
+        )
+        try:
+            real_chunk = eng._chunk_op
+            fails = {"n": 2}
+
+            def flaky(*a, **k):
+                if fails["n"] > 0:
+                    fails["n"] -= 1
+                    raise RuntimeError("injected device error")
+                return real_chunk(*a, **k)
+
+            eng._chunk_op = flaky
+            victims = [
+                eng.submit(GenRequest([1 + i], max_new_tokens=8)) for i in range(4)
+            ]
+            # every victim's stream must terminate (aborted or served);
+            # generous timeout: a cold XLA cache recompiles on this path
+            for r in victims:
+                toks = r.tokens(timeout=180)
+                assert len(toks) <= 8
+            # engine must still be alive and correct afterwards
+            out = eng.generate([5, 9, 2], max_new_tokens=3)
+            assert len(out) == 3 and fails["n"] == 0
+        finally:
+            eng.close()
+
     def test_warmupless_engine_first_burst(self, params):
         """warmup=False: the first real burst compiles on the engine
         thread while clients wait — must still deliver."""

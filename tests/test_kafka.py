@@ -49,6 +49,30 @@ class TestProtocol:
             (b"k", b"hello", 7), (None, b"x" * 100, 8),
         ]
 
+    def test_tombstone_distinct_from_empty(self):
+        """A null value (compaction delete marker) must survive the codec
+        as None — distinct from b'' — and surface as metadata on the
+        delivered Message."""
+        recs = [
+            kp.Record(key=b"k", value=None, timestamp=1, offset=0),
+            kp.Record(key=b"k", value=b"", timestamp=2, offset=1),
+        ]
+        out = kp.decode_message_set(kp.encode_message_set(recs))
+        assert [r.value for r in out] == [None, b""]
+
+    def test_tombstone_delivery_metadata(self, broker):
+        broker.seed("compacted", [b"live"])
+        broker.seed("compacted", [None])  # tombstone after a live record
+        c = make_client(broker)
+        try:
+            m1 = c.subscribe_sync("compacted", timeout=5)
+            assert m1.value == b"live" and "tombstone" not in m1.metadata
+            m1.commit()
+            m2 = c.subscribe_sync("compacted", timeout=5)
+            assert m2.value == b"" and m2.metadata.get("tombstone") == "true"
+        finally:
+            c.close()
+
     def test_message_set_tolerates_truncated_tail(self):
         data = kp.encode_message_set([kp.Record(key=None, value=b"a", offset=0)])
         cut = data + data[: len(data) // 2]  # second message truncated
