@@ -55,7 +55,7 @@ def _raw_probes(eng, cfg, args, S: int, B: int) -> dict:
     K = args.decode_chunk
     rng = jax.random.PRNGKey(7)
     cache = eng.cache._replace(length=jnp.full((B,), S, jnp.int32))
-    toks, last, cache, rng = eng._chunk_op(
+    toks, last, cache, rng = eng._chunk_ops[K](
         eng.params, jnp.zeros((B,), jnp.int32), cache, eng._active, eng._temps, rng
     )
     _ = np.asarray(last)  # compile + sync
@@ -63,7 +63,7 @@ def _raw_probes(eng, cfg, args, S: int, B: int) -> dict:
     for n in (2, 8):
         t0 = time.perf_counter()
         for _i in range(n):
-            toks, last, cache, rng = eng._chunk_op(
+            toks, last, cache, rng = eng._chunk_ops[K](
                 eng.params, last, cache, eng._active, eng._temps, rng
             )
         _ = np.asarray(last)

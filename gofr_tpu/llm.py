@@ -199,13 +199,14 @@ class LLMEngine:
             first = _sample(last_logits, temps, sub)
             return first, cache, rng
 
-        K = decode_chunk
+        def _make_chunk_op(K: int):
+            def _chunk_op(params, tokens, cache, active, temps, rng):
+                return chunk_fn(
+                    params, cfg, tokens, cache, active, temps, rng,
+                    n_steps=K, sample_fn=_sample,
+                )
 
-        def _chunk_op(params, tokens, cache, active, temps, rng):
-            return chunk_fn(
-                params, cfg, tokens, cache, active, temps, rng,
-                n_steps=K, sample_fn=_sample,
-            )
+            return jax.jit(_chunk_op, donate_argnums=(2,))
 
         M = self.admit_cap
 
@@ -249,7 +250,14 @@ class LLMEngine:
             return tail, active, temps
 
         self._prefill_op = jax.jit(_prefill_op)
-        self._chunk_op = jax.jit(_chunk_op, donate_argnums=(2,))
+        # Two chunk lengths: the full chunk amortizes dispatch at load; a
+        # short chunk (quarter length) runs when the batch is quiet so a
+        # fresh request's prefill never queues behind ~90 ms of decode —
+        # pipeline granularity is the TTFT floor at low occupancy.
+        self._chunk_short = max(1, decode_chunk // 4)
+        self._chunk_ops = {decode_chunk: _make_chunk_op(decode_chunk)}
+        if self._chunk_short != decode_chunk:
+            self._chunk_ops[self._chunk_short] = _make_chunk_op(self._chunk_short)
         self._insert_many = jax.jit(_insert_many, donate_argnums=(0,))
         self._admit_update = jax.jit(_admit_update, donate_argnums=(0, 1, 2))
         self._rng = jax.random.PRNGKey(0)
@@ -419,12 +427,13 @@ class LLMEngine:
                     jnp.zeros((self.slots,), jnp.float32),
                     jnp.zeros((nb,), jnp.int32), meta,
                 )
-            toks, last, cache, _ = self._chunk_op(
-                self.params,
-                jnp.zeros((self.slots,), jnp.int32), cache,
-                jnp.zeros((self.slots,), bool),
-                jnp.zeros((self.slots,), jnp.float32), zero_rng,
-            )
+            for op in self._chunk_ops.values():
+                toks, last, cache, _ = op(
+                    self.params,
+                    jnp.zeros((self.slots,), jnp.int32), cache,
+                    jnp.zeros((self.slots,), bool),
+                    jnp.zeros((self.slots,), jnp.float32), zero_rng,
+                )
             return last, cache
 
         n_tasks = len(self.prefill_buckets) * len(nbs) + 1
@@ -464,10 +473,10 @@ class LLMEngine:
         for e in entries:
             if e[0] != "chunk":
                 continue
-            snapshot = e[2]
+            snapshot, k = e[2], e[3]
             for slot, r in enumerate(snapshot):
                 if r is not None and r is self._slot_req[slot]:
-                    steps[slot] = steps.get(slot, 0) + self.decode_chunk
+                    steps[slot] = steps.get(slot, 0) + k
         return steps
 
     def _free_slots(self) -> list[int]:
@@ -490,8 +499,8 @@ class LLMEngine:
     def _any_active(self) -> bool:
         return any(r is not None for r in self._slot_req)
 
-    def _needed_chunks(self) -> int:
-        """Decode chunks still required to finish every current occupant,
+    def _needed_steps(self) -> int:
+        """Decode steps still required to finish every current occupant,
         beyond what is already in flight — the dispatch gate. Bounds
         speculation by real demand (an upper bound under eos/cancel, which
         the host cannot project)."""
@@ -503,7 +512,7 @@ class LLMEngine:
             remaining = r.max_new_tokens - r.emitted - steps.get(i, 0)
             if remaining > worst:
                 worst = remaining
-        return -(-worst // self.decode_chunk)
+        return worst
 
     def _admit(self) -> bool:
         """Pull waiting requests into (virtually) free slots, prefilling
@@ -657,18 +666,32 @@ class LLMEngine:
             if self._slot_req[slot] is r:
                 self._slot_req[slot] = None
 
-    def _dispatch(self) -> None:
-        """Launch one decode chunk chained from the on-device tail. All
-        inputs are device-resident — zero h2d transfers per chunk."""
+    def _dispatch(self, needed_steps: int) -> int:
+        """Launch one decode chunk chained from the on-device tail and
+        return the dispatched chunk length (the scheduler debits it from
+        its step budget). All inputs are device-resident — zero h2d
+        transfers per chunk. Chunk length adapts: the short variant runs
+        for tail ends (fewer steps needed than a short chunk) and for
+        quiet batches (low occupancy, empty queue) where fine pipeline
+        granularity keeps a fresh request's prefill from queueing behind a
+        long chunk."""
         with self._work_cv:
             snapshot = list(self._slot_req)
-            toks, last, self.cache, self._rng = self._chunk_op(
+            active_n = sum(r is not None for r in snapshot)
+            quiet = active_n <= self.slots // 4 and not self._waiting
+            k = (
+                self._chunk_short
+                if needed_steps <= self._chunk_short or quiet
+                else self.decode_chunk
+            )
+            toks, last, self.cache, self._rng = self._chunk_ops[k](
                 self.params, self._tail, self.cache, self._active, self._temps, self._rng,
             )
             self._tail = last
             self._start_fetch(toks)
-            self._inflight.append(("chunk", toks, snapshot))
+            self._inflight.append(("chunk", toks, snapshot, k))
             self._work_cv.notify()
+            return k
 
     def _process_entry(self, entry: tuple) -> None:
         """Fetch one device result (outside the lock — the blocking RTT
@@ -683,7 +706,7 @@ class LLMEngine:
                 # a separate clear would let the scheduler double-count
                 # this entry in _inflight_steps after emitted already grew
             return
-        _, toks_dev, snapshot = entry
+        _, toks_dev, snapshot, _k = entry
         t0 = time.perf_counter()
         toks = np.asarray(toks_dev)  # [K, S] — blocks; device runs next chunk
         if self.metrics is not None:
@@ -720,9 +743,10 @@ class LLMEngine:
                     depth = sum(1 for e in self._inflight if e[0] == "chunk")
                     if self._processing is not None and self._processing[0] == "chunk":
                         depth += 1
-                    want = min(self._needed_chunks(), self.lookahead - depth)
+                    needed = self._needed_steps()
+                    want = min(-(-needed // self.decode_chunk), self.lookahead - depth)
                 for _ in range(max(0, want)):
-                    self._dispatch()
+                    needed = max(0, needed - self._dispatch(needed))
                 if not did and want <= 0:
                     self._kick.wait(timeout=0.005)
                     self._kick.clear()

@@ -153,16 +153,19 @@ class TestEngineStress:
             decode_chunk=4, logger=QUIET,
         )
         try:
-            real_chunk = eng._chunk_op
+            real_chunk = dict(eng._chunk_ops)
             fails = {"n": 2}
 
-            def flaky(*a, **k):
-                if fails["n"] > 0:
-                    fails["n"] -= 1
-                    raise RuntimeError("injected device error")
-                return real_chunk(*a, **k)
+            def wrap(k):
+                def flaky(*a, **kw):
+                    if fails["n"] > 0:
+                        fails["n"] -= 1
+                        raise RuntimeError("injected device error")
+                    return real_chunk[k](*a, **kw)
 
-            eng._chunk_op = flaky
+                return flaky
+
+            eng._chunk_ops = {k: wrap(k) for k in eng._chunk_ops}
             victims = [
                 eng.submit(GenRequest([1 + i], max_new_tokens=8)) for i in range(4)
             ]
