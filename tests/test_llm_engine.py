@@ -98,6 +98,19 @@ class TestEngine:
         s = engine.stats()
         assert s["slots"] == 4 and s["max_seq_len"] == 64
 
+    def test_temperature_sampling_valid_and_varied(self, engine):
+        """Sampled decode (temp > 0): correct count, valid ids, and not
+        the greedy sequence for every seed (top-k sampling is live)."""
+        greedy = engine.generate([5, 9, 2], max_new_tokens=8)
+        sampled = [
+            engine.generate([5, 9, 2], max_new_tokens=8, temperature=1.5)
+            for _ in range(4)
+        ]
+        for s in sampled:
+            assert len(s) == 8
+            assert all(0 <= t < CFG.vocab_size for t in s)
+        assert any(s != greedy for s in sampled), "temperature had no effect"
+
 
 class TestEngineTP:
     def test_tensor_parallel_engine_matches(self, params):

@@ -228,6 +228,49 @@ class TestMQTTPubSub:
         finally:
             c.close()
 
+    def test_app_subscriber_integration(self, broker):
+        """Full framework path: App with PUBSUB_BACKEND=MQTT — subscriber
+        runtime delivers to the handler and commit-on-success PUBACKs."""
+        import socket
+        import time as _time
+
+        from gofr_tpu import App
+
+        def free_port():
+            with socket.socket() as s:
+                s.bind(("127.0.0.1", 0))
+                return s.getsockname()[1]
+
+        app = App(config=new_mock_config({
+            "APP_NAME": "mqtt-int", "HTTP_PORT": str(free_port()),
+            "METRICS_PORT": str(free_port()), "LOG_LEVEL": "ERROR",
+            "PUBSUB_BACKEND": "MQTT",
+            "MQTT_HOST": broker.host, "MQTT_PORT": str(broker.port),
+        }))
+        got = []
+
+        async def handler(ctx):
+            got.append(ctx.bind())
+
+        app.subscribe("orders", handler)
+        app.run_in_background()
+        try:
+            deadline = _time.time() + 10
+            # wait for the subscriber loop to SUBSCRIBE before routing
+            while not any(s.subs for s in broker._sessions) and _time.time() < deadline:
+                _time.sleep(0.05)
+            broker.inject("orders", b'{"id": 7}', qos=1)
+            while not got and _time.time() < deadline:
+                _time.sleep(0.05)
+            assert got == [{"id": 7}]
+            # commit-on-success: the handler succeeded -> PUBACK reached broker
+            while not broker.acked and _time.time() < deadline:
+                _time.sleep(0.05)
+            assert len(broker.acked) == 1
+            assert app.container.pubsub.health()["status"] == "UP"
+        finally:
+            app.shutdown()
+
     def test_new_pubsub_switch(self, broker):
         cfg = new_mock_config({
             "PUBSUB_BACKEND": "MQTT",
