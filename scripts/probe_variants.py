@@ -1,5 +1,11 @@
 """Round-3 probe: variants for each decode cost center found by
-profile_decode3.py. Scalar-only outputs (axon tunnel)."""
+profile_decode3.py. Scalar-only outputs (axon tunnel).
+
+WARNING: absolute timings here are POISONED by the tunnel's ~95 ms fixed
+dispatch+fetch round trip (every probe reads ~3 ms/step regardless of
+work), and `*0`-style dead outputs get DCE'd by XLA. probe_delta.py holds
+the corrected methodology; this file is kept as the record of how the
+wrong numbers were produced."""
 import os
 import sys
 import time
