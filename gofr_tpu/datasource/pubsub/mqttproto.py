@@ -72,8 +72,12 @@ def _frame(ptype: int, flags: int, body: bytes) -> bytes:
     return bytes([(ptype << 4) | flags]) + encode_remaining_length(len(body)) + body
 
 
-def read_packet_from(recv_exact) -> Packet:
-    """Read one packet using recv_exact(n) -> bytes (socket or buffer)."""
+def read_packet_from(recv_exact, max_size: int = 16 << 20) -> Packet:
+    """Read one packet using recv_exact(n) -> bytes (socket or buffer).
+
+    max_size caps the declared body (default 16 MiB): the spec's varint
+    admits 256 MB, and a malicious/corrupt peer must not be able to make
+    the reader attempt that allocation."""
     h = recv_exact(1)[0]
     mult, n, i = 1, 0, 0
     while True:
@@ -85,6 +89,8 @@ def read_packet_from(recv_exact) -> Packet:
             break
         if i > 3:
             raise ValueError("malformed MQTT remaining length")
+    if n > max_size:
+        raise ValueError(f"MQTT packet of {n} bytes exceeds cap {max_size}")
     return Packet(type=h >> 4, flags=h & 0xF, body=recv_exact(n) if n else b"")
 
 
