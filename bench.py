@@ -438,6 +438,9 @@ def bench_mlp(args) -> dict:
         "unit": "req/s",
         "vs_baseline": round(qps / 1000.0, 3),
         "detail": {
+            # on the axon tunnel, per-request p50 is dominated by the
+            # ~95 ms device round trip (pipelined batches keep QPS high);
+            # on a locally-attached chip the same path is single-digit ms
             "p50_ms": round(_percentile(latencies, 0.50) * 1e3, 3),
             "p99_ms": round(_percentile(latencies, 0.99) * 1e3, 3),
             "requests": args.requests,
