@@ -36,6 +36,11 @@ from . import mqttproto as mp
 
 __all__ = ["MQTTPubSub", "MQTTConfig"]
 
+# waiter sentinel: ack expected but result discarded (fire-and-forget
+# subscribe) — the pid stays reserved until the ack arrives, then _handle
+# pops it without waking anyone
+_DISCARD = object()
+
 
 class MQTTConfig:
     def __init__(self, config):
@@ -172,9 +177,13 @@ class MQTTPubSub(_BasePubSub):
             self._connected = False
             self._last_error = str(err)
             sock, self._sock = self._sock, None
-            # unblock anything waiting for an ack
+            # unblock anything waiting for an ack; discard-marked waiters
+            # have no waiting thread to pop them — release their pids here
             for pid in list(self._waiters):
-                self._waiters[pid] = None
+                if self._waiters[pid] is _DISCARD:
+                    self._waiters.pop(pid)
+                else:
+                    self._waiters[pid] = None
             self._cond.notify_all()
         if sock is not None:
             try:
@@ -228,7 +237,11 @@ class MQTTPubSub(_BasePubSub):
         elif p.type in (mp.SUBACK, mp.UNSUBACK, mp.PUBACK):
             pid = mp.parse_packet_id(p)
             with self._cond:
-                if pid in self._waiters:
+                if self._waiters.get(pid) is _DISCARD:
+                    self._waiters.pop(pid)  # fire-and-forget ack: release pid
+                elif self._waiters.get(pid) is ...:
+                    # only fill an EMPTY slot: a late duplicate must not
+                    # clobber a delivered ack the waiter hasn't consumed yet
                     self._waiters[pid] = p
                     self._cond.notify_all()
         elif p.type == mp.PINGRESP:
@@ -263,47 +276,80 @@ class MQTTPubSub(_BasePubSub):
 
     def _next_pid(self) -> int:
         with self._cond:
-            self._pid = self._pid % 65535 + 1
+            # skip pids with a waiter still outstanding (slow broker):
+            # reusing one would mis-pair its ack or orphan the old waiter
+            for _ in range(65535):
+                self._pid = self._pid % 65535 + 1
+                if self._pid not in self._waiters:
+                    break
+            else:
+                raise ConnectionError("MQTT: all 65535 packet ids in flight")
             pid = self._pid
             self._waiters[pid] = ...  # placeholder: "waiting"
             return pid
 
-    def _send_acked(self, pid: int, frame: bytes) -> None:
-        """Send a frame that expects an ack; drop the waiter on send
-        failure so _waiters never accumulates dead entries."""
+    def _request_ack(self, build, what: str) -> mp.Packet:
+        """Allocate a pid, send build(pid), await its ack. The waiter is
+        popped on EVERY exit — send failure, builder error, ack timeout,
+        or success — so _waiters never accumulates dead entries (a leaked
+        pid would be skipped by _next_pid forever)."""
+        pid = self._next_pid()
         try:
-            self._send(frame)
-        except OSError:
+            self._send(build(pid))
+        except BaseException:
             with self._cond:
                 self._waiters.pop(pid, None)
             raise
+        return self._await_ack(pid, what)
+
+    _ACK_TYPES = {"SUBACK": mp.SUBACK, "UNSUBACK": mp.UNSUBACK, "PUBACK": mp.PUBACK}
 
     def _await_ack(self, pid: int, what: str) -> mp.Packet:
+        expected = self._ACK_TYPES[what]
         deadline = time.monotonic() + self.cfg.timeout
         with self._cond:
-            while self._waiters.get(pid) is ...:
-                remaining = deadline - time.monotonic()
-                if remaining <= 0 or self._closed:
-                    self._waiters.pop(pid, None)
-                    raise TimeoutError(f"MQTT {what} timed out (pid {pid})")
-                self._cond.wait(remaining)
-            p = self._waiters.pop(pid)
+            while True:
+                v = self._waiters.get(pid)
+                if v is ...:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0 or self._closed:
+                        self._waiters.pop(pid, None)
+                        raise TimeoutError(f"MQTT {what} timed out (pid {pid})")
+                    self._cond.wait(remaining)
+                    continue
+                if v is not None and v.type != expected:
+                    # late duplicate ack from an earlier life of this pid
+                    # (e.g. a rebroadcast SUBACK) — discard, keep waiting
+                    self._waiters[pid] = ...
+                    continue
+                p = self._waiters.pop(pid)
+                break
         if p is None:
             raise ConnectionError(f"MQTT connection lost awaiting {what}")
         return p
 
     def _send_subscribe(self, topic: str, qos: int, *, wait: bool = True) -> None:
-        pid = self._next_pid()
         if not wait:
+            # fire-and-forget (reader-thread resubscribe can't block), but
+            # the pid stays RESERVED until its SUBACK arrives: releasing it
+            # now would let a following publish reuse the pid and mis-pair
+            # the late SUBACK. _handle pops discard-marked waiters.
+            pid = self._next_pid()
             with self._cond:
-                self._waiters.pop(pid, None)
-            self._send(mp.subscribe_packet(pid, [(topic, qos)]))
+                self._waiters[pid] = _DISCARD
+            try:
+                self._send(mp.subscribe_packet(pid, [(topic, qos)]))
+            except BaseException:
+                with self._cond:
+                    self._waiters.pop(pid, None)
+                raise
             with self._cond:
                 self._subscribed.setdefault(topic, qos)
                 self._queues.setdefault(topic, collections.deque())
             return
-        self._send_acked(pid, mp.subscribe_packet(pid, [(topic, qos)]))
-        p = self._await_ack(pid, "SUBACK")
+        p = self._request_ack(
+            lambda pid: mp.subscribe_packet(pid, [(topic, qos)]), "SUBACK"
+        )
         _, codes = mp.parse_suback(p)
         if codes and codes[0] >= 0x80:
             raise ConnectionError(f"MQTT subscription to {topic!r} refused")
@@ -327,9 +373,10 @@ class MQTTPubSub(_BasePubSub):
             if self.cfg.qos == 0:
                 self._send(mp.publish_packet(topic, raw, qos=0))
             else:
-                pid = self._next_pid()
-                self._send_acked(pid, mp.publish_packet(topic, raw, qos=1, packet_id=pid))
-                self._await_ack(pid, "PUBACK")
+                self._request_ack(
+                    lambda pid: mp.publish_packet(topic, raw, qos=1, packet_id=pid),
+                    "PUBACK",
+                )
             ok = True
         finally:
             self._log_pub(topic, raw, ok)
@@ -369,9 +416,9 @@ class MQTTPubSub(_BasePubSub):
         with self._cond:
             known = topic in self._subscribed
         if known:
-            pid = self._next_pid()
-            self._send_acked(pid, mp.unsubscribe_packet(pid, [topic]))
-            self._await_ack(pid, "UNSUBACK")
+            self._request_ack(
+                lambda pid: mp.unsubscribe_packet(pid, [topic]), "UNSUBACK"
+            )
         with self._cond:
             self._subscribed.pop(topic, None)
             self._queues.pop(topic, None)

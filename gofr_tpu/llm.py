@@ -111,6 +111,8 @@ class GenRequest:
 
 
 class LLMEngine:
+    _FETCH_FAIL_LIMIT = 3  # consecutive fetch failures before full reset
+
     def __init__(
         self,
         cfg,
@@ -292,6 +294,7 @@ class LLMEngine:
         self._kick = threading.Event()  # scheduler wake: submit/slots freed
         self._processing: tuple | None = None  # entry popped, not yet emitted
         self._jumped = False  # prefill-priority ration (one per chunk)
+        self._fetch_fail_streak = 0  # consecutive collector fetch failures
         self._jnp = jnp
         self._jax = jax
 
@@ -471,7 +474,14 @@ class LLMEngine:
         if self._processing is not None:
             entries.append(self._processing)
         for e in entries:
-            if e[0] != "chunk":
+            if e[0] == "prefill":
+                # an un-fetched prefill entry carries each request's first
+                # token — without counting it, demand is overestimated by 1
+                # per fresh request and an extra decode chunk occasionally
+                # dispatched
+                for slot, r in e[2]:
+                    if r is not None and r is self._slot_req[slot]:
+                        steps[slot] = steps.get(slot, 0) + 1
                 continue
             snapshot, k = e[2], e[3]
             for slot, r in enumerate(snapshot):
@@ -753,27 +763,34 @@ class LLMEngine:
             except Exception as e:  # noqa: BLE001 — engine must not die silently
                 if self.logger is not None:
                     self.logger.error(f"LLM engine step failed: {e!r}")
-                with self._lock:
-                    # virtually-freed requests live ONLY in the snapshots
-                    # being discarded — close them before clearing, or
-                    # their consumers never see an end-of-stream
-                    orphans: set = set()
-                    entries = list(self._inflight)
-                    if self._processing is not None:
-                        entries.append(self._processing)
-                    for entry in entries:
-                        if entry[0] == "prefill":
-                            orphans.update(r for _, r in entry[2])
-                        else:
-                            orphans.update(r for r in entry[2] if r is not None)
-                    for r in orphans:
-                        if r.finish_reason is None:
-                            r.finish_reason = "cancelled"
-                            r.out.put(None)
-                    self._inflight.clear()
-                self._tail = jnp.zeros((self.slots,), jnp.int32)
-                self._abort_all()
+                self._recover_all()
                 time.sleep(0.1)
+
+    def _recover_all(self) -> None:
+        """Full-stop recovery: close every request reachable from in-flight
+        snapshots or slots, discard queued work, and reset device state.
+        ONE critical section (callable from either thread): releasing the
+        lock mid-way would let the other thread admit fresh requests into
+        slots/tail that the remainder of the reset then clobbers."""
+        with self._lock:
+            # virtually-freed requests live ONLY in the snapshots
+            # being discarded — close them before clearing, or
+            # their consumers never see an end-of-stream
+            orphans: set = set()
+            entries = list(self._inflight)
+            if self._processing is not None:
+                entries.append(self._processing)
+            for entry in entries:
+                orphans.update(self._entry_requests(entry))
+            for r in orphans:
+                if r.finish_reason is None:
+                    r.finish_reason = "cancelled"
+                    r.out.put(None)
+            self._inflight.clear()
+            self._processing = None
+            self._fetch_fail_streak = 0  # fresh state deserves a fresh count
+            self._tail = self._jnp.zeros((self.slots,), self._jnp.int32)
+            self._abort_all()
 
     def _collect_loop(self) -> None:
         while True:
@@ -810,10 +827,62 @@ class LLMEngine:
                 self._processing = entry
             try:
                 self._process_entry(entry)
+                self._fetch_fail_streak = 0
             except Exception as e:  # noqa: BLE001
                 if self.logger is not None:
                     self.logger.error(f"LLM engine fetch failed: {e!r}")
+                self._fetch_fail_streak += 1
+                if self._fetch_fail_streak >= self._FETCH_FAIL_LIMIT:
+                    # persistent device-side failure: make-up chunks would
+                    # fail too, so sparing slot occupants just busy-loops
+                    # dispatch/fail forever — full reset like the
+                    # scheduler's error path
+                    self._fetch_fail_streak = 0
+                    self._recover_all()
+                else:
+                    self._close_unreachable(entry)
             finally:
                 with self._lock:
                     self._processing = None
             self._kick.set()
+
+    @staticmethod
+    def _entry_requests(entry: tuple):
+        """Requests carried by an in-flight entry (both entry kinds)."""
+        if entry[0] == "prefill":
+            return [r for _, r in entry[2] if r is not None]
+        return [r for r in entry[2] if r is not None]
+
+    def _close_unreachable(self, failed: tuple) -> None:
+        """A failed fetch permanently loses its entry's tokens. A request
+        in its snapshot can still reach max_new_tokens only if it owns a
+        slot (the scheduler sees its stalled emitted count and dispatches
+        make-up chunks) or if SURVIVING queued entries carry enough tokens
+        to finish it. A virtually-freed predecessor with neither would
+        never see end-of-stream and block its consumer until the stream
+        timeout — close exactly those. (Survivors' streams carry a token
+        gap where the lost entry's tokens were; loss is inherent to a
+        failed fetch, and termination is the contract being kept.)"""
+        with self._lock:
+            # clear under the SAME acquisition as the closes: the failed
+            # entry's tokens are lost, and leaving it visible lets the
+            # scheduler count them in _inflight_steps and virtually free a
+            # slot on the strength of tokens that will never arrive
+            self._processing = None
+            lost = set(self._entry_requests(failed))
+            lost.difference_update(self._slot_req)
+            if not lost:
+                return
+            cover: dict = {}
+            for e in self._inflight:
+                n = 1 if e[0] == "prefill" else e[3]
+                for r in self._entry_requests(e):
+                    if r in lost:
+                        cover[r] = cover.get(r, 0) + n
+            for r in lost:
+                if (
+                    r.finish_reason is None
+                    and r.emitted + cover.get(r, 0) < r.max_new_tokens
+                ):
+                    r.finish_reason = "cancelled"
+                    r.out.put(None)

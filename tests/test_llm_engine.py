@@ -131,3 +131,39 @@ class TestEngineTP:
             assert got == expect
         finally:
             eng.close()
+
+
+class TestCollectorFailure:
+    def test_close_unreachable_closes_slotless_only(self):
+        """A failed chunk fetch loses its tokens for good: requests in its
+        snapshot that no longer own a slot (virtually-freed predecessors)
+        can never reach max_new_tokens — even later queued entries leave
+        them short — and must be end-of-streamed; current slot occupants
+        stay open (the scheduler dispatches make-up chunks). Runs against
+        a thread-free stand-in so live engine threads can't race the
+        injected state (advisor r3, llm.py collector error path)."""
+        import collections
+        import types
+
+        orphan = GenRequest([1], max_new_tokens=4)
+        occupant = GenRequest([2], max_new_tokens=4)
+        covered = GenRequest([3], max_new_tokens=2)  # 2 <= surviving k
+        fake = types.SimpleNamespace(
+            _lock=threading.RLock(),
+            _entry_requests=LLMEngine._entry_requests,
+            _processing=("chunk", None, [orphan, occupant, covered, None], 2),
+            _inflight=collections.deque(
+                [("chunk", None, [None, None, orphan, covered], 2)]
+            ),
+            _slot_req=[None, occupant, None, None],
+        )
+        failed = fake._processing
+        LLMEngine._close_unreachable(fake, failed)
+        # orphan: slotless, surviving coverage 2 < 4 remaining -> closed
+        assert orphan.finish_reason == "cancelled"
+        assert orphan.out.get_nowait() is None
+        # occupant keeps its slot; covered finishes via the surviving entry
+        assert occupant.finish_reason is None
+        assert covered.finish_reason is None
+        # lost entry must vanish from _processing in the same lock hold
+        assert fake._processing is None
