@@ -27,7 +27,17 @@ from typing import Any, Protocol, runtime_checkable
 
 from .. import STATUS_DOWN, STATUS_UP, health
 
-__all__ = ["MongoProvider", "InMemoryMongo", "InstrumentedMongo"]
+__all__ = ["MongoProvider", "InMemoryMongo", "InstrumentedMongo", "WireMongo"]
+
+
+def __getattr__(name: str):
+    # lazy: WireMongo lives in .wire (which imports the mongoproto codec);
+    # most apps use the in-memory provider and never pay the import
+    if name == "WireMongo":
+        from .wire import WireMongo
+
+        return WireMongo
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 
 @runtime_checkable

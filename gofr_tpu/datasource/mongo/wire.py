@@ -1,0 +1,219 @@
+"""WireMongo: a MongoProvider that speaks the real MongoDB wire protocol.
+
+Drop-in peer of InMemoryMongo behind the same seam (`MongoProvider`):
+`app.add_mongo(WireMongo(host, port, database))` injects logger/metrics and
+calls connect(), after which the full CRUD surface of the reference driver
+wrapper (pkg/gofr/datasource/mongo/mongo.go:77-188 — Find/FindOne/
+Insert{One,Many}/Update{ByID,One,Many}/Delete{One,Many}/CountDocuments/
+Drop) runs over OP_MSG against a live server. The codec is mongoproto.py
+(from scratch, like kafkaproto.py); the in-process fake server for tests
+is testutil/fakemongo.py, speaking the same wire format.
+
+Commands used: hello (handshake/health), find (single firstBatch with
+getMore follow-ups), insert, update, delete, count, drop, ping. No
+authentication (SCRAM) — like the Kafka client, this targets unauthed
+deployments and the test fake; the seam accepts an authenticating provider
+without interface change.
+"""
+
+from __future__ import annotations
+
+import itertools
+import socket
+import threading
+
+from .. import STATUS_DOWN, STATUS_UP, health
+from . import mongoproto as mb
+
+__all__ = ["WireMongo", "MongoError"]
+
+
+class MongoError(Exception):
+    """Server-reported command failure ({ok: 0} or writeErrors)."""
+
+    def __init__(self, message: str, code: int = 0):
+        super().__init__(message)
+        self.code = code
+
+
+class WireMongo:
+    """Synchronous wire-protocol MongoDB client (thread-safe: one
+    in-flight command at a time over a single connection, mirroring the
+    reference's default single-session usage)."""
+
+    def __init__(
+        self,
+        host: str = "localhost",
+        port: int = 27017,
+        database: str = "test",
+        *,
+        timeout: float = 5.0,
+    ):
+        self.host, self.port, self.database = host, port, database
+        self.timeout = timeout
+        self._sock: socket.socket | None = None
+        self._lock = threading.Lock()
+        self._ids = itertools.count(1)
+        self.logger = None
+        self.metrics = None
+
+    # -- provider seam -----------------------------------------------------
+    def use_logger(self, logger) -> None:
+        self.logger = logger
+
+    def use_metrics(self, metrics) -> None:
+        self.metrics = metrics
+
+    def connect(self) -> None:
+        with self._lock:
+            self._connect_locked()
+        hello = self._command({"hello": 1}, db="admin")
+        if self.logger is not None:
+            self.logger.info(
+                f"connected to MongoDB at {self.host}:{self.port} "
+                f"(maxWireVersion {hello.get('maxWireVersion')})"
+            )
+
+    def close(self) -> None:
+        with self._lock:
+            if self._sock is not None:
+                try:
+                    self._sock.close()
+                finally:
+                    self._sock = None
+
+    # -- wire --------------------------------------------------------------
+    def _connect_locked(self) -> None:
+        if self._sock is not None:
+            return
+        self._sock = socket.create_connection(
+            (self.host, self.port), timeout=self.timeout
+        )
+        self._sock.settimeout(self.timeout)
+
+    def _recv_exact(self, n: int) -> bytes:
+        buf = b""
+        while len(buf) < n:
+            chunk = self._sock.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError("MongoDB server closed connection")
+            buf += chunk
+        return buf
+
+    def _command(self, body: dict, *, db: str | None = None) -> dict:
+        """Send one command, return the reply body; raises MongoError on
+        {ok: 0} and surfaces writeErrors."""
+        body = dict(body)
+        body["$db"] = db or self.database
+        with self._lock:
+            try:
+                self._connect_locked()
+                rid = next(self._ids)
+                self._sock.sendall(mb.encode_op_msg(body, request_id=rid))
+                frame = mb.read_message(self._recv_exact)
+            except (OSError, ValueError) as e:
+                # drop the connection so the next command redials
+                if self._sock is not None:
+                    try:
+                        self._sock.close()
+                    finally:
+                        self._sock = None
+                raise ConnectionError(f"MongoDB wire failure: {e}") from e
+        _, _, reply = mb.decode_op_msg(frame)
+        if not reply.get("ok"):
+            raise MongoError(
+                str(reply.get("errmsg", "command failed")),
+                int(reply.get("code", 0)),
+            )
+        errors = reply.get("writeErrors")
+        if errors:
+            first = errors[0]
+            raise MongoError(
+                str(first.get("errmsg", "write failed")), int(first.get("code", 0))
+            )
+        return reply
+
+    # -- CRUD surface (mongo.go:77-188 parity) -----------------------------
+    def find(self, collection: str, filter: dict | None = None) -> list[dict]:
+        reply = self._command({"find": collection, "filter": filter or {}})
+        cursor = reply["cursor"]
+        docs = list(cursor["firstBatch"])
+        while cursor.get("id"):
+            reply = self._command(
+                {"getMore": cursor["id"], "collection": collection}
+            )
+            cursor = reply["cursor"]
+            docs.extend(cursor["nextBatch"])
+        return docs
+
+    def find_one(self, collection: str, filter: dict | None = None) -> dict | None:
+        reply = self._command(
+            {"find": collection, "filter": filter or {}, "limit": 1}
+        )
+        batch = reply["cursor"]["firstBatch"]
+        return batch[0] if batch else None
+
+    def insert_one(self, collection: str, document: dict):
+        doc = dict(document)
+        doc.setdefault("_id", mb.ObjectId())
+        self._command({"insert": collection, "documents": [doc]})
+        return doc["_id"]
+
+    def insert_many(self, collection: str, documents: list[dict]) -> list:
+        docs = [dict(d) for d in documents]
+        for d in docs:
+            d.setdefault("_id", mb.ObjectId())
+        if docs:
+            self._command({"insert": collection, "documents": docs})
+        return [d["_id"] for d in docs]
+
+    def update_by_id(self, collection: str, id, update: dict) -> int:
+        return self._update(collection, {"_id": id}, update, multi=False)
+
+    def update_one(self, collection: str, filter: dict, update: dict) -> int:
+        return self._update(collection, filter, update, multi=False)
+
+    def update_many(self, collection: str, filter: dict, update: dict) -> int:
+        return self._update(collection, filter, update, multi=True)
+
+    def _update(self, collection: str, q: dict, u: dict, *, multi: bool) -> int:
+        reply = self._command(
+            {"update": collection, "updates": [{"q": q, "u": u, "multi": multi}]}
+        )
+        return int(reply.get("nModified", reply.get("n", 0)))
+
+    def delete_one(self, collection: str, filter: dict) -> int:
+        return self._delete(collection, filter, limit=1)
+
+    def delete_many(self, collection: str, filter: dict) -> int:
+        return self._delete(collection, filter, limit=0)
+
+    def _delete(self, collection: str, q: dict, *, limit: int) -> int:
+        reply = self._command(
+            {"delete": collection, "deletes": [{"q": q, "limit": limit}]}
+        )
+        return int(reply.get("n", 0))
+
+    def count_documents(self, collection: str, filter: dict | None = None) -> int:
+        reply = self._command({"count": collection, "query": filter or {}})
+        return int(reply.get("n", 0))
+
+    def drop_collection(self, collection: str) -> None:
+        try:
+            self._command({"drop": collection})
+        except MongoError as e:
+            if e.code != 26:  # NamespaceNotFound: dropping absent is a no-op
+                raise
+
+    def health_check(self) -> dict:
+        try:
+            self._command({"ping": 1}, db="admin")
+            return health(
+                STATUS_UP, backend="mongo-wire",
+                host=f"{self.host}:{self.port}", database=self.database,
+            )
+        except Exception as e:  # noqa: BLE001
+            return health(
+                STATUS_DOWN, backend="mongo-wire",
+                host=f"{self.host}:{self.port}", error=str(e),
+            )

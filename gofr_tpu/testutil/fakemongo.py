@@ -1,0 +1,217 @@
+"""In-process MongoDB server speaking the real wire protocol.
+
+The Mongo analogue of FakeKafkaBroker: a TCP server that parses OP_MSG
+frames with the same codec the client uses (datasource/mongo/mongoproto),
+executes commands against an InMemoryMongo document store, and replies in
+kind. Lets WireMongo be tested end-to-end over a real socket without a
+mongod — the role CI service containers play for the reference
+(.github/workflows/go.yml provisions real brokers; we provision protocol-
+faithful fakes).
+
+Commands: hello, ping, find (with cursor batching + getMore), insert,
+update, delete, count, drop. Error replies use real server shapes
+({ok: 0, errmsg, code} and writeErrors).
+"""
+
+from __future__ import annotations
+
+import itertools
+import socket
+import struct
+import threading
+
+from ..datasource.mongo import InMemoryMongo, _apply_update, _matches
+from ..datasource.mongo import mongoproto as mb
+
+__all__ = ["FakeMongoServer"]
+
+
+class FakeMongoServer:
+    """Minimal mongod stand-in. `batch_size` forces cursor paging so the
+    client's getMore path is exercised."""
+
+    def __init__(self, batch_size: int = 101):
+        self.store = InMemoryMongo()
+        self.store.connect()
+        self.batch_size = batch_size
+        self._cursors: dict[int, list[dict]] = {}
+        self._cursor_ids = itertools.count(1)
+        self._lock = threading.Lock()
+        self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind(("127.0.0.1", 0))
+        self._srv.listen(8)
+        self.port = self._srv.getsockname()[1]
+        self._closed = False
+        self._thread = threading.Thread(target=self._accept_loop, daemon=True)
+        self._thread.start()
+
+    def close(self) -> None:
+        self._closed = True
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+
+    # -- plumbing ----------------------------------------------------------
+    def _accept_loop(self) -> None:
+        while not self._closed:
+            try:
+                conn, _ = self._srv.accept()
+            except OSError:
+                return
+            threading.Thread(
+                target=self._serve_conn, args=(conn,), daemon=True
+            ).start()
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        def recv_exact(n: int) -> bytes:
+            buf = b""
+            while len(buf) < n:
+                chunk = conn.recv(n - len(buf))
+                if not chunk:
+                    raise ConnectionError("client closed")
+                buf += chunk
+            return buf
+
+        try:
+            while True:
+                frame = mb.read_message(recv_exact)
+                rid, _, body = mb.decode_op_msg(frame)
+                try:
+                    reply = self._execute(body)
+                except _CommandError as e:
+                    reply = {"ok": 0.0, "errmsg": e.args[0], "code": e.code}
+                conn.sendall(
+                    mb.encode_op_msg(
+                        reply, request_id=next(self._cursor_ids) + 1_000_000,
+                        response_to=rid,
+                    )
+                )
+        except (ConnectionError, OSError, ValueError):
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    # -- command dispatch --------------------------------------------------
+    def _execute(self, body: dict) -> dict:
+        db = body.get("$db", "test")
+        if "hello" in body or "isMaster" in body:
+            return {
+                "ok": 1.0, "isWritablePrimary": True,
+                "maxWireVersion": 17, "minWireVersion": 0,
+                "maxBsonObjectSize": 16 * 1024 * 1024,
+            }
+        if "ping" in body:
+            return {"ok": 1.0}
+        if "find" in body:
+            return self._find(db, body)
+        if "getMore" in body:
+            return self._get_more(db, body)
+        if "insert" in body:
+            return self._insert(body)
+        if "update" in body:
+            return self._update(body)
+        if "delete" in body:
+            return self._delete(body)
+        if "count" in body:
+            n = self.store.count_documents(body["count"], body.get("query"))
+            return {"ok": 1.0, "n": n}
+        if "drop" in body:
+            with self._lock:
+                if body["drop"] not in self.store._collections:
+                    raise _CommandError("ns not found", 26)
+            self.store.drop_collection(body["drop"])
+            return {"ok": 1.0, "nIndexesWas": 1}
+        raise _CommandError(f"no such command: {next(iter(body))!r}", 59)
+
+    def _find(self, db: str, body: dict) -> dict:
+        coll = body["find"]
+        docs = self.store.find(coll, body.get("filter"))
+        limit = int(body.get("limit", 0))
+        if limit:
+            docs = docs[:limit]
+        first, rest = docs[: self.batch_size], docs[self.batch_size :]
+        cursor_id = 0
+        if rest:
+            with self._lock:
+                cursor_id = next(self._cursor_ids)
+                self._cursors[cursor_id] = rest
+        return {
+            "ok": 1.0,
+            "cursor": {"firstBatch": first, "id": cursor_id, "ns": f"{db}.{coll}"},
+        }
+
+    def _get_more(self, db: str, body: dict) -> dict:
+        cid = body["getMore"]
+        with self._lock:
+            rest = self._cursors.pop(cid, None)
+        if rest is None:
+            raise _CommandError(f"cursor id {cid} not found", 43)
+        batch, rest = rest[: self.batch_size], rest[self.batch_size :]
+        new_id = 0
+        if rest:
+            with self._lock:
+                new_id = next(self._cursor_ids)
+                self._cursors[new_id] = rest
+        return {
+            "ok": 1.0,
+            "cursor": {
+                "nextBatch": batch, "id": new_id,
+                "ns": f"{db}.{body['collection']}",
+            },
+        }
+
+    def _insert(self, body: dict) -> dict:
+        coll = body["insert"]
+        n = 0
+        write_errors = []
+        for i, doc in enumerate(body.get("documents", [])):
+            if "_id" in doc and self.store.find_one(coll, {"_id": doc["_id"]}):
+                write_errors.append(
+                    {"index": i, "code": 11000, "errmsg": "E11000 duplicate key"}
+                )
+                continue
+            self.store.insert_one(coll, doc)
+            n += 1
+        reply = {"ok": 1.0, "n": n}
+        if write_errors:
+            reply["writeErrors"] = write_errors
+        return reply
+
+    def _update(self, body: dict) -> dict:
+        coll = body["update"]
+        n = modified = 0
+        for u in body.get("updates", []):
+            q, doc, multi = u.get("q", {}), u.get("u", {}), u.get("multi", False)
+            # reuse the store's matcher/updater so wire and in-memory
+            # backends share one query-semantics implementation
+            with self.store._lock:
+                for d in self.store._coll(coll):
+                    if _matches(d, q):
+                        _apply_update(d, doc)
+                        n += 1
+                        modified += 1
+                        if not multi:
+                            break
+        return {"ok": 1.0, "n": n, "nModified": modified}
+
+    def _delete(self, body: dict) -> dict:
+        coll = body["delete"]
+        n = 0
+        for d in body.get("deletes", []):
+            q, limit = d.get("q", {}), d.get("limit", 0)
+            if limit == 1:
+                n += self.store.delete_one(coll, q)
+            else:
+                n += self.store.delete_many(coll, q)
+        return {"ok": 1.0, "n": n}
+
+
+class _CommandError(Exception):
+    def __init__(self, msg: str, code: int):
+        super().__init__(msg)
+        self.code = code

@@ -1,0 +1,110 @@
+"""Round-4: decode-step component costs at bench shapes (B=128, K=16).
+Each probe is delta-timed (min of 3) on a scalar output. Run:
+  python scripts/probe_r4_parts.py mm un sample glue
+"""
+import sys
+import time
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from gofr_tpu.utils import enable_compilation_cache
+
+enable_compilation_cache()
+from gofr_tpu.models import TransformerConfig, init_params
+from gofr_tpu.models.quant import qmm, quantize_params
+
+cfg = TransformerConfig.gemma_2b()
+B, K = 128, 16
+print("init params...", flush=True)
+params = jax.jit(lambda k: init_params(k, cfg))(jax.random.PRNGKey(0))
+qp = jax.jit(lambda p: quantize_params(p, cfg.dtype))(params)
+_ = np.asarray(qp["final_norm"])
+print("params ready", flush=True)
+
+
+K2 = 80  # delta partner: per-step = (T(K2) - T(K)) / (K2 - K)
+
+
+def timed(name, make_fn, *args):
+    """make_fn(k) -> fn whose scalar output chains k steps. DELTA method:
+    a single timing through the axon tunnel carries a ~95 ms fixed RTT, so
+    per-step cost must come from the difference of two chain lengths."""
+    fa, fb = jax.jit(make_fn(K)), jax.jit(make_fn(K2))
+    t0 = time.perf_counter()
+    _ = float(np.asarray(fa(*args)))
+    _ = float(np.asarray(fb(*args)))
+    print(f"  [{name} compiled+first in {time.perf_counter()-t0:.1f}s]", flush=True)
+    ta = min(_once(fa, *args) for _ in range(3))
+    tb = min(_once(fb, *args) for _ in range(3))
+    dt = (tb - ta) / (K2 - K)
+    print(f"{name:44s} {dt*1e3:7.3f} ms/step", flush=True)
+
+
+def _once(f, *args):
+    t0 = time.perf_counter()
+    _ = float(np.asarray(f(*args)))
+    return time.perf_counter() - t0
+
+
+probes = set(sys.argv[1:]) or {"mm", "un", "sample"}
+
+if "mm" in probes:
+    def make_mm(k):
+        def mm_chain(x, layers):
+            def body(x, _):
+                def layer(x, lp):
+                    q = qmm(x, lp["wq"]); kv = qmm(x, lp["wkv"]); o = qmm(q, lp["wo"])
+                    d = qmm(jax.nn.gelu(qmm(x, lp["w_gate"])) * qmm(x, lp["w_up"]),
+                            lp["w_down"])
+                    return (x + o + d + kv.sum() * 0).astype(x.dtype), None
+                x, _ = jax.lax.scan(layer, x, layers)
+                return x, None
+            x, _ = jax.lax.scan(body, x, None, length=k)
+            return x.sum().astype(jnp.float32)
+        return mm_chain
+    timed("18-layer int8 matvecs", make_mm,
+          jnp.ones((B, cfg.d_model), cfg.dtype), qp["layers"])
+
+if "un" in probes:
+    emb = qp["embed"]
+    def make_un(k):
+        def un_chain(x):
+            def body(x, _):
+                logits = ((x * emb.s.astype(cfg.dtype))
+                          @ emb.q.T.astype(cfg.dtype)).astype(jnp.float32)
+                return (logits[:, : cfg.d_model] * 1e-6).astype(cfg.dtype), None
+            x, _ = jax.lax.scan(body, x, None, length=k)
+            return x.sum().astype(jnp.float32)
+        return un_chain
+    timed("unembed [B,d]@[d,256k]", make_un, jnp.ones((B, cfg.d_model), cfg.dtype))
+
+if "sample" in probes:
+    topk = 64
+    def _sample(logits, temps, key):
+        greedy = jnp.argmax(logits, axis=-1)
+        topv, topi = jax.lax.approx_max_k(logits, topk)
+        local = jax.random.categorical(
+            key, topv / jnp.maximum(temps, 1e-4)[:, None], axis=-1)
+        sampled = jnp.take_along_axis(topi, local[:, None], axis=1)[:, 0]
+        return jnp.where(temps > 0.0, sampled, greedy).astype(jnp.int32)
+    logits0 = jax.random.normal(jax.random.PRNGKey(1), (B, cfg.vocab_size),
+                                jnp.float32)
+    temps0 = jnp.zeros((B,), jnp.float32)
+    def make_sample(k):
+        def sample_chain(logits0, temps, key):
+            def body(c, _):
+                key, acc = c
+                key, sub = jax.random.split(key)
+                t = _sample(logits0 + acc[:1, None].astype(jnp.float32) * 1e-9,
+                            temps, sub)
+                return (key, t), None
+            (key, t), _ = jax.lax.scan(
+                body, (key, jnp.zeros((B,), jnp.int32)), None, length=k)
+            return t.sum().astype(jnp.float32)
+        return sample_chain
+    timed("engine sample_fn (argmax+topk64)", make_sample, logits0, temps0,
+          jax.random.PRNGKey(2))

@@ -1,0 +1,134 @@
+"""Round-4 attribution probe: what does decode attention cost inside the
+real serving chunk at bench shapes (B=128, max_len=176, K=16, int8)?
+
+Three timings (delta method per axon-tunnel methodology — sync once, chain
+chunks, subtract two run lengths):
+  full   — the real decode_chunk (transformer.decode_chunk)
+  noattn — identical chunk with chunk_decode_attention replaced by a
+           zero-cost stand-in (q reshaped) — difference isolates attention
+  attn   — chunk_decode_attention alone, 18 layers x 16 steps, dep-chained
+
+Usage: python scripts/profile_attn_r4.py
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from gofr_tpu.models import TransformerConfig, init_params
+from gofr_tpu.models.quant import qmm, quantize_params
+from gofr_tpu.models.transformer import (
+    KVCache, _embed_tokens, _unembed_last, init_cache,
+)
+from gofr_tpu.ops import apply_rope, chunk_decode_attention, rms_norm
+
+cfg = TransformerConfig.gemma_2b()
+B, MAX, K, S = 128, 176, 16, 128
+print("device:", jax.devices()[0].device_kind, flush=True)
+
+params = jax.jit(lambda k: init_params(k, cfg))(jax.random.PRNGKey(0))
+params = jax.jit(lambda p: quantize_params(p, cfg.dtype))(params)
+_ = np.asarray(params["final_norm"])
+
+
+def make_chunk(attn_fn):
+    """decode_chunk clone with a pluggable attention (mirrors
+    transformer.decode_chunk, greedy sampling)."""
+    L, hq, hkv, hd = cfg.n_layers, cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+
+    def chunk(params, tokens, cache):
+        b = tokens.shape[0]
+        kb0 = jnp.zeros((L, b, K, hkv, hd), cache.k.dtype)
+        vb0 = jnp.zeros((L, b, K, hkv, hd), cache.v.dtype)
+
+        def step(carry, k_i):
+            tok, kb, vb = carry
+            positions = (cache.length + k_i)[:, None]
+            x = _embed_tokens(params, cfg, tok[:, None])
+
+            def layer(x, xs):
+                lp, kc_l, vc_l, kb_l, vb_l = xs
+                h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
+                q = qmm(h, lp["wq"]).reshape(b, 1, hq, hd)
+                kv = qmm(h, lp["wkv"]).reshape(b, 1, hkv, 2, hd)
+                k_new, v_new = kv[:, :, :, 0], kv[:, :, :, 1]
+                q = apply_rope(q, positions, cfg.rope_theta)
+                k_new = apply_rope(k_new, positions, cfg.rope_theta)
+                kb_l = jax.lax.dynamic_update_slice(
+                    kb_l, k_new.astype(kb_l.dtype), (0, k_i, 0, 0))
+                vb_l = jax.lax.dynamic_update_slice(
+                    vb_l, v_new.astype(vb_l.dtype), (0, k_i, 0, 0))
+                attn = attn_fn(q, kc_l, vc_l, kb_l, vb_l, cache.length, k_i)
+                x = x + qmm(attn.reshape(b, 1, hq * hd), lp["wo"]).astype(x.dtype)
+                h = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
+                x = x + qmm(
+                    jax.nn.gelu(qmm(h, lp["w_gate"])) * qmm(h, lp["w_up"]),
+                    lp["w_down"])
+                return x, (kb_l, vb_l)
+
+            x, (kb, vb) = jax.lax.scan(
+                layer, x, (params["layers"], cache.k, cache.v, kb, vb))
+            logits = _unembed_last(params, cfg, x)
+            nt = jnp.argmax(logits, -1).astype(jnp.int32)
+            return (nt, kb, vb), nt
+
+        (last, kb, vb), toks = jax.lax.scan(
+            step, (tokens, kb0, vb0), jnp.arange(K, dtype=jnp.int32))
+        start = jnp.minimum(cache.length, MAX - K)
+        merge = jax.vmap(
+            lambda c, u, i: jax.lax.dynamic_update_slice(c, u, (0, i, 0, 0)),
+            in_axes=(1, 1, 0), out_axes=1)
+        new_k = merge(cache.k, kb, start)
+        new_v = merge(cache.v, vb, start)
+        return toks, last, KVCache(k=new_k, v=new_v,
+                                   length=cache.length + K)
+
+    return jax.jit(chunk)
+
+
+def real_attn(q, kc, vc, kb, vb, lengths, k_i):
+    return chunk_decode_attention(q, kc, vc, kb, vb, lengths, k_i,
+                                  logit_cap=cfg.attn_logit_cap)
+
+
+def stub_attn(q, kc, vc, kb, vb, lengths, k_i):
+    # zero-compute stand-in keeping shapes/dtype; touches kb so the buffer
+    # write isn't dead-code-eliminated
+    return q + kb[:, :1].astype(q.dtype).sum(2, keepdims=True) * 0
+
+
+def time_chunk(name, chunk):
+    cache = init_cache(cfg, B, MAX)
+    cache = cache._replace(length=jnp.full((B,), S, jnp.int32))
+    toks, last, cache, = None, jnp.zeros((B,), jnp.int32), cache
+    toks, last, cache = chunk(params, last, cache)
+    _ = np.asarray(last)  # compile + sync
+    cache = cache._replace(length=jnp.full((B,), S, jnp.int32))
+    totals = {}
+    for n in (2, 8):
+        c, l = cache, last
+        t0 = time.perf_counter()
+        for _i in range(n):
+            toks, l, c = chunk(params, l, c)
+            c = c._replace(length=jnp.full((B,), S, jnp.int32))
+        _ = np.asarray(l)
+        totals[n] = time.perf_counter() - t0
+    per_step = (totals[8] - totals[2]) / 6 / K
+    print(f"{name:28s} {per_step*1e3:7.3f} ms/step", flush=True)
+    return per_step
+
+
+full = time_chunk("full chunk (real attn)", make_chunk(real_attn))
+noat = time_chunk("chunk, attention stubbed", make_chunk(stub_attn))
+print(f"{'attention share':28s} {(full-noat)*1e3:7.3f} ms/step "
+      f"({(full-noat)/full*100:.1f}% of step)", flush=True)
+
+# irreducible KV stream at stored width for the live prefix
+kv_bytes = cfg.n_layers * B * S * cfg.n_kv_heads * cfg.head_dim * 2 * 2
+print(f"KV stream (S={S} prefix): {kv_bytes/1e6:.0f} MB -> "
+      f"{kv_bytes/819e9*1e3:.3f} ms at 819 GB/s", flush=True)
