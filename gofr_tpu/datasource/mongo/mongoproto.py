@@ -26,6 +26,7 @@ import threading
 
 __all__ = [
     "ObjectId",
+    "Int64",
     "encode_document",
     "decode_document",
     "encode_op_msg",
@@ -33,6 +34,12 @@ __all__ = [
     "read_message",
     "OP_MSG",
 ]
+
+
+class Int64(int):
+    """Force BSON int64 ('long') encoding regardless of magnitude. Some
+    server fields are type-checked, not just range-checked — getMore's
+    cursor id must be a long even when it fits in 32 bits."""
 
 OP_MSG = 2013
 
@@ -108,7 +115,7 @@ def _encode_value(name: bytes, value) -> bytes:
     if value is None:
         return b"\x0a" + name + b"\x00"
     if isinstance(value, int):
-        if -(2**31) <= value < 2**31:
+        if not isinstance(value, Int64) and -(2**31) <= value < 2**31:
             return b"\x10" + name + b"\x00" + struct.pack("<i", value)
         return b"\x12" + name + b"\x00" + struct.pack("<q", value)
     if isinstance(value, _dt.datetime):
@@ -154,6 +161,13 @@ def _decode_value(tag: int, buf: bytes, at: int):
         (n,) = struct.unpack_from("<i", buf, at)
         if n < 0 or at + 5 + n > len(buf):
             raise ValueError("BSON binary length out of range")
+        subtype = buf[at + 4]
+        if subtype != 0x00:
+            # legacy 0x02 carries an inner length prefix and typed subtypes
+            # (UUID 0x04, ...) would be silently flattened to generic bytes
+            # on re-encode — refuse rather than corrupt data shared with
+            # other drivers
+            raise ValueError(f"unsupported BSON binary subtype 0x{subtype:02x}")
         return bytes(buf[at + 5 : at + 5 + n]), at + 5 + n
     if tag == 0x07:
         return ObjectId(bytes(buf[at : at + 12])), at + 12

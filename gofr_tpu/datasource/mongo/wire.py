@@ -100,16 +100,26 @@ class WireMongo:
             buf += chunk
         return buf
 
-    def _command(self, body: dict, *, db: str | None = None) -> dict:
+    def _command(
+        self,
+        body: dict,
+        *,
+        db: str | None = None,
+        sequences: dict[str, list[dict]] | None = None,
+    ) -> dict:
         """Send one command, return the reply body; raises MongoError on
         {ok: 0} and surfaces writeErrors."""
         body = dict(body)
         body["$db"] = db or self.database
         with self._lock:
+            rid = next(self._ids)
+            # encode OUTSIDE the wire try-block: a BSON error is a caller
+            # bug, not a connection failure, and must not tear down a
+            # healthy socket or masquerade as a server outage
+            frame_out = mb.encode_op_msg(body, request_id=rid, sequences=sequences)
             try:
                 self._connect_locked()
-                rid = next(self._ids)
-                self._sock.sendall(mb.encode_op_msg(body, request_id=rid))
+                self._sock.sendall(frame_out)
                 frame = mb.read_message(self._recv_exact)
             except (OSError, ValueError) as e:
                 # drop the connection so the next command redials
@@ -139,8 +149,9 @@ class WireMongo:
         cursor = reply["cursor"]
         docs = list(cursor["firstBatch"])
         while cursor.get("id"):
+            # cursor id is type-checked server-side: must be BSON int64
             reply = self._command(
-                {"getMore": cursor["id"], "collection": collection}
+                {"getMore": mb.Int64(cursor["id"]), "collection": collection}
             )
             cursor = reply["cursor"]
             docs.extend(cursor["nextBatch"])
@@ -164,7 +175,11 @@ class WireMongo:
         for d in docs:
             d.setdefault("_id", mb.ObjectId())
         if docs:
-            self._command({"insert": collection, "documents": docs})
+            # documents ride a kind-1 sequence: the command body document is
+            # capped at 16MB but sequences are not, matching real drivers
+            self._command(
+                {"insert": collection}, sequences={"documents": docs}
+            )
         return [d["_id"] for d in docs]
 
     def update_by_id(self, collection: str, id, update: dict) -> int:

@@ -394,16 +394,26 @@ class TPURuntime:
     # -- LLM engines (continuous batching; gofr_tpu.llm) -------------------
     def register_llm(self, name: str, cfg, params, **engine_kw):
         """Register a continuous-batching text-generation engine alongside
-        the plain models; reachable as ctx.tpu().llm(name)."""
-        from ...llm import LLMEngine
+        the plain models; reachable as ctx.tpu().llm(name). Pass
+        `replicas=N` (or `devices=[...]` / `meshes=[(mesh, specs), ...]`)
+        for data-parallel replicated serving — N independent engines with
+        a per-request router behind the same handle (SURVEY §2.8 row 1)."""
+        from ...llm import LLMEngine, ReplicatedLLMEngine
 
         if not hasattr(self, "_llms"):
             self._llms: dict[str, Any] = {}
         if name in self._llms:
             self._llms[name].close()
-        engine = LLMEngine(
-            cfg, params, logger=self.logger, metrics=self.metrics, **engine_kw
-        )
+        replicas = engine_kw.pop("replicas", None)
+        if (replicas or 1) > 1 or "devices" in engine_kw or "meshes" in engine_kw:
+            engine = ReplicatedLLMEngine(
+                cfg, params, replicas=replicas,
+                logger=self.logger, metrics=self.metrics, **engine_kw,
+            )
+        else:
+            engine = LLMEngine(
+                cfg, params, logger=self.logger, metrics=self.metrics, **engine_kw
+            )
         self._llms[name] = engine
         return engine
 

@@ -54,7 +54,7 @@ from typing import Any, Iterator
 
 import numpy as np
 
-__all__ = ["LLMEngine", "GenRequest"]
+__all__ = ["LLMEngine", "ReplicatedLLMEngine", "GenRequest"]
 
 _EOS_DEFAULT = -1  # no EOS cut by default (random-weight models)
 
@@ -127,6 +127,7 @@ class LLMEngine:
         admit_delay_ms: float = 40.0,
         mesh=None,
         param_specs: Any = None,
+        device=None,
         logger=None,
         metrics=None,
         warmup: bool = True,
@@ -167,9 +168,14 @@ class LLMEngine:
             from .parallel.sharding import shard_params
 
             params = shard_params(params, mesh, param_specs)
+        elif device is not None:
+            # replica pinning (data-parallel serving): committing params to
+            # a device makes every jitted call and its donated state follow
+            params = jax.device_put(params, device)
         else:
             params = jax.device_put(params)
         self.params = params
+        self.device = device
 
         # -- jitted programs (one dispatch each) --------------------------
         topk = min(64, cfg.vocab_size)
@@ -265,6 +271,8 @@ class LLMEngine:
         self._rng = jax.random.PRNGKey(0)
 
         self.cache = init_cache(cfg, slots, max_seq_len)
+        if device is not None:
+            self.cache = jax.device_put(self.cache, device)
         self._slot_req: list[GenRequest | None] = [None] * slots
         # device-resident batch state: chain tail, active mask, temps.
         # active is never cleared on retire (a stale True only advances a
@@ -273,8 +281,14 @@ class LLMEngine:
         self._tail = jnp.zeros((slots,), jnp.int32)
         self._active = jnp.zeros((slots,), bool)
         self._temps = jnp.zeros((slots,), jnp.float32)
+        if device is not None:
+            self._tail, self._active, self._temps, self._rng = jax.device_put(
+                (self._tail, self._active, self._temps, self._rng), device
+            )
         self._admit_q: queue.Queue[GenRequest | None] = queue.Queue()
         self._waiting: list[GenRequest] = []  # drained queue, scheduler-only
+        self.submitted = 0  # total requests ever submitted (router telemetry)
+        self._admitting = 0  # sliced out of _waiting, not yet slotted
         self._last_submit_t: float | None = None
         self._ema_gap: float | None = None  # EMA inter-arrival (rate estimate)
         self._stop = False
@@ -334,6 +348,7 @@ class LLMEngine:
             req.capped = True
         now = time.perf_counter()
         req.submitted_at = now
+        self.submitted += 1  # routing/diagnostic counter (GIL-atomic enough)
         with self._lock:
             # EMA update under the lock: concurrent submitters racing the
             # read-modify-write could blend NEGATIVE gaps into the estimate
@@ -360,7 +375,20 @@ class LLMEngine:
                 "max_seq_len": self.max_seq_len,
                 "decode_chunk": self.decode_chunk,
                 "inflight_chunks": sum(1 for e in self._inflight if e[0] == "chunk"),
+                "submitted": self.submitted,
             }
+
+    def load(self) -> int:
+        """Cheap routing signal for the replica router: occupants plus
+        queue depth plus requests mid-admission (sliced out of _waiting,
+        not yet slotted). Lock-free — _slot_req is only ever mutated in
+        place (no resize), so a torn read costs at most a stale unit."""
+        return (
+            sum(r is not None for r in self._slot_req)
+            + self._admit_q.qsize()
+            + len(self._waiting)
+            + self._admitting
+        )
 
     def close(self) -> None:
         self._stop = True
@@ -578,6 +606,10 @@ class LLMEngine:
             return False
         pulled = self._waiting[: len(free)]
         self._waiting = self._waiting[len(free):]
+        # visible to load() while in flight between _waiting and _slot_req —
+        # without this the router undercounts a replica mid-admission and
+        # least-loaded piles every request onto it
+        self._admitting += len(pulled)
         # group by bucket to share prefill executions; chunks of admit_cap
         by_bucket: dict[int, list[GenRequest]] = {}
         for r in pulled:
@@ -634,6 +666,7 @@ class LLMEngine:
                 )
                 self._start_fetch(first_dev)
                 self._inflight.append(("prefill", first_dev, taken))
+                self._admitting -= len(reqs)
                 self._work_cv.notify()
         return True
 
@@ -789,6 +822,7 @@ class LLMEngine:
             self._inflight.clear()
             self._processing = None
             self._fetch_fail_streak = 0  # fresh state deserves a fresh count
+            self._admitting = 0  # an aborted wave never reaches its slots
             self._tail = self._jnp.zeros((self.slots,), self._jnp.int32)
             self._abort_all()
 
@@ -886,3 +920,121 @@ class LLMEngine:
                 ):
                     r.finish_reason = "cancelled"
                     r.out.put(None)
+
+
+class ReplicatedLLMEngine:
+    """Data-parallel replicated serving: N independent LLMEngine replicas —
+    one per chip (or per tensor-parallel submesh) — behind a per-request
+    router (SURVEY §2.8 row 1: "Replicated serving across chips;
+    per-replica dispatch of batched requests").
+
+    Each replica owns its full weight copy, KV cache, and scheduler, so
+    replicas never synchronize: DP serving scales throughput linearly the
+    way the reference scales by stateless pod replication (README.md:25),
+    but within one process over the local device set. Composition with TP:
+    pass `meshes=[(mesh, param_specs), ...]` and each replica runs
+    tensor-parallel over its own submesh — dp x tp serving from one API.
+
+    Routing: "least_loaded" (default) sends each request to the replica
+    with the fewest occupants+queued — robust when request durations vary;
+    "round_robin" is stateless and optimal for uniform work.
+
+    The public surface mirrors LLMEngine (submit/generate/stats/close), so
+    ctx.tpu().llm(name) callers cannot tell one replica from many.
+    """
+
+    def __init__(
+        self,
+        cfg,
+        params,
+        *,
+        replicas: int | None = None,
+        devices: list | None = None,
+        meshes: list | None = None,
+        router: str = "least_loaded",
+        logger=None,
+        **engine_kw,
+    ):
+        import jax
+
+        if router not in ("least_loaded", "round_robin"):
+            raise ValueError(f"unknown router {router!r}")
+        self.router = router
+        self._rr = itertools.count()
+        specs: list[dict]
+        if meshes is not None:
+            specs = [{"mesh": m, "param_specs": s} for m, s in meshes]
+        else:
+            if devices is None:
+                devices = jax.devices()[: replicas or 1]
+            if replicas is not None and len(devices) < replicas:
+                raise ValueError(
+                    f"need {replicas} devices for {replicas} replicas, "
+                    f"have {len(devices)}"
+                )
+            specs = [{"device": d} for d in devices]
+        if not specs:
+            raise ValueError("no replicas configured")
+        if logger is not None:
+            logger.info(
+                f"replicated LLM serving: {len(specs)} replicas, "
+                f"router={router}"
+            )
+        # build replicas concurrently: XLA releases the GIL while compiling,
+        # so N warmups overlap instead of serializing construction N-fold.
+        # On any failure, close the replicas that DID come up — each holds
+        # scheduler threads plus device-resident weights and KV cache that
+        # would otherwise leak with no handle to free them.
+        from concurrent.futures import ThreadPoolExecutor
+
+        with ThreadPoolExecutor(max_workers=len(specs)) as pool:
+            futures = [
+                pool.submit(
+                    LLMEngine, cfg, params, logger=logger, **spec, **engine_kw
+                )
+                for spec in specs
+            ]
+            engines, first_err = [], None
+            for f in futures:
+                try:
+                    engines.append(f.result())
+                except Exception as e:  # noqa: BLE001
+                    first_err = first_err or e
+        if first_err is not None:
+            for e in engines:
+                e.close()
+            raise first_err
+        self.engines = engines
+
+    # -- routing -----------------------------------------------------------
+    def _pick(self) -> "LLMEngine":
+        if self.router == "round_robin" or len(self.engines) == 1:
+            return self.engines[next(self._rr) % len(self.engines)]
+        return min(self.engines, key=lambda e: e.load())
+
+    # -- LLMEngine surface -------------------------------------------------
+    def submit(self, req: GenRequest) -> GenRequest:
+        return self._pick().submit(req)
+
+    def generate(self, prompt_tokens: list[int], **kw) -> list[int]:
+        return self.submit(GenRequest(prompt_tokens, **kw)).tokens()
+
+    def load(self) -> int:
+        return sum(e.load() for e in self.engines)
+
+    def stats(self) -> dict:
+        per = [e.stats() for e in self.engines]
+        return {
+            "replicas": len(per),
+            "router": self.router,
+            "slots": sum(s["slots"] for s in per),
+            "active": sum(s["active"] for s in per),
+            "waiting": sum(s["waiting"] for s in per),
+            "max_seq_len": per[0]["max_seq_len"],
+            "decode_chunk": per[0]["decode_chunk"],
+            "per_replica": per,
+        }
+
+    def close(self) -> None:
+        for e in self.engines:
+            e.close()

@@ -20,7 +20,7 @@ import socket
 import struct
 import threading
 
-from ..datasource.mongo import InMemoryMongo, _apply_update, _matches
+from ..datasource.mongo import InMemoryMongo
 from ..datasource.mongo import mongoproto as mb
 
 __all__ = ["FakeMongoServer"]
@@ -184,20 +184,16 @@ class FakeMongoServer:
 
     def _update(self, body: dict) -> dict:
         coll = body["update"]
-        n = modified = 0
+        n = 0
         for u in body.get("updates", []):
             q, doc, multi = u.get("q", {}), u.get("u", {}), u.get("multi", False)
-            # reuse the store's matcher/updater so wire and in-memory
-            # backends share one query-semantics implementation
-            with self.store._lock:
-                for d in self.store._coll(coll):
-                    if _matches(d, q):
-                        _apply_update(d, doc)
-                        n += 1
-                        modified += 1
-                        if not multi:
-                            break
-        return {"ok": 1.0, "n": n, "nModified": modified}
+            # delegate to the store's own update methods so wire and
+            # in-memory backends share one query/update-semantics impl
+            if multi:
+                n += self.store.update_many(coll, q, doc)
+            else:
+                n += self.store.update_one(coll, q, doc)
+        return {"ok": 1.0, "n": n, "nModified": n}
 
     def _delete(self, body: dict) -> dict:
         coll = body["delete"]

@@ -1,6 +1,6 @@
 """Round-4: decode-step component costs at bench shapes (B=128, K=16).
 Each probe is delta-timed (min of 3) on a scalar output. Run:
-  python scripts/probe_r4_parts.py mm un sample glue
+  python scripts/probe_r4_parts.py mm un sample
 """
 import sys
 import time
@@ -26,7 +26,7 @@ _ = np.asarray(qp["final_norm"])
 print("params ready", flush=True)
 
 
-K2 = 80  # delta partner: per-step = (T(K2) - T(K)) / (K2 - K)
+K2 = int(__import__("os").environ.get("K2", "48"))  # delta partner
 
 
 def timed(name, make_fn, *args):
@@ -51,6 +51,9 @@ def _once(f, *args):
 
 
 probes = set(sys.argv[1:]) or {"mm", "un", "sample"}
+unknown = probes - {"mm", "un", "sample"}
+if unknown:
+    sys.exit(f"unknown probes: {sorted(unknown)} (choose mm/un/sample)")
 
 if "mm" in probes:
     def make_mm(k):

@@ -115,3 +115,112 @@ class TestTrainStep:
         l1 = lm_loss(params, cfg, toks, half)
         l2 = lm_loss(params, cfg, toks2, half)
         assert abs(float(l1) - float(l2)) < 1e-6
+
+
+class TestDPServing:
+    """SURVEY §2.8 row 1: replicated serving across chips with per-replica
+    dispatch. Replicas are full engines pinned to distinct devices; the
+    router must preserve per-request results exactly (continuous batching
+    may change placement, never tokens)."""
+
+    def _reference(self, params, cfg, prompt, n):
+        from gofr_tpu.models import generate
+        import numpy as np
+
+        toks = jnp.asarray([prompt], jnp.int32)
+        lens = jnp.asarray([len(prompt)], jnp.int32)
+        return [int(t) for t in np.asarray(generate(params, cfg, toks, lens, n))[0]]
+
+    def test_dp_replicas_match_single_engine(self):
+        from gofr_tpu.llm import ReplicatedLLMEngine
+
+        cfg = TransformerConfig.tiny()
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        eng = ReplicatedLLMEngine(
+            cfg, params, replicas=2, slots=2, max_seq_len=64,
+            prefill_buckets=(8,), router="least_loaded",
+        )
+        try:
+            assert len(eng.engines) == 2
+            # replicas sit on distinct devices
+            devs = {
+                next(iter(jax.tree.leaves(e.params)[0].devices()))
+                for e in eng.engines
+            }
+            assert len(devs) == 2
+            from gofr_tpu.llm import GenRequest
+
+            # submit back-to-back (before any completes): least-loaded sees
+            # each prior submission in load() and must alternate replicas
+            prompts = [[5, 9, 2], [7, 1], [3, 3, 4], [11, 2, 6, 1]]
+            reqs = [
+                eng.submit(GenRequest(p, max_new_tokens=5)) for p in prompts
+            ]
+            outs = [r.tokens() for r in reqs]
+            for p, got in zip(prompts, outs):
+                assert got == self._reference(params, cfg, p, 5)
+            # the router must actually have dispatched to BOTH replicas
+            st = eng.stats()
+            assert st["replicas"] == 2 and st["slots"] == 4
+            assert all(s["submitted"] >= 1 for s in st["per_replica"]), st
+        finally:
+            eng.close()
+
+    def test_round_robin_alternates(self):
+        from gofr_tpu.llm import ReplicatedLLMEngine
+
+        cfg = TransformerConfig.tiny()
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        eng = ReplicatedLLMEngine(
+            cfg, params, replicas=2, slots=2, max_seq_len=32,
+            prefill_buckets=(8,), router="round_robin", warmup=False,
+        )
+        try:
+            picks = [eng._pick() for _ in range(4)]
+            assert picks[0] is not picks[1] and picks[0] is picks[2]
+        finally:
+            eng.close()
+
+    def test_dp_over_tp_submeshes(self):
+        """dp=2 x tp=4: each replica tensor-parallel over its own 4-device
+        submesh — the full composition config 5 implies."""
+        from gofr_tpu.llm import ReplicatedLLMEngine
+
+        cfg = TransformerConfig.tiny()
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        devs = jax.devices()
+        meshes = []
+        for half in (devs[:4], devs[4:]):
+            mesh = jax.sharding.Mesh([half], ("data", "model"))
+            meshes.append((mesh, param_specs(cfg, mesh)))
+        eng = ReplicatedLLMEngine(
+            cfg, params, meshes=meshes, slots=2, max_seq_len=64,
+            prefill_buckets=(8,),
+        )
+        try:
+            prompt = [5, 9, 2]
+            got = eng.generate(prompt, max_new_tokens=5)
+            assert got == self._reference(params, cfg, prompt, 5)
+            # both replicas alive and on disjoint device sets
+            d0 = set(jax.tree.leaves(eng.engines[0].params)[0].devices())
+            d1 = set(jax.tree.leaves(eng.engines[1].params)[0].devices())
+            assert d0.isdisjoint(d1) and len(d0) == 4 and len(d1) == 4
+        finally:
+            eng.close()
+
+    def test_register_llm_replicated(self):
+        from gofr_tpu.datasource.tpu import TPURuntime
+        from gofr_tpu.llm import ReplicatedLLMEngine
+
+        cfg = TransformerConfig.tiny()
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        rt = TPURuntime()
+        try:
+            eng = rt.register_llm(
+                "tiny", cfg, params, replicas=2, slots=2, max_seq_len=32,
+                prefill_buckets=(8,), warmup=False,
+            )
+            assert isinstance(eng, ReplicatedLLMEngine)
+            assert rt.llm("tiny") is eng
+        finally:
+            rt.close()
