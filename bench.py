@@ -452,10 +452,90 @@ def bench_mlp(args) -> dict:
     return out
 
 
+_GREET_CLIENT = r"""
+import sys, time, threading, http.client, urllib.request
+host, port, mode, nt, per = (
+    sys.argv[1], int(sys.argv[2]), sys.argv[3], int(sys.argv[4]), int(sys.argv[5]),
+)
+lat, errs = [], []
+lock = threading.Lock()
+def ka_client(n):
+    try:
+        conn = http.client.HTTPConnection(host, port, timeout=10)
+        local = []
+        for _ in range(n):
+            t0 = time.perf_counter()
+            conn.request("GET", "/greet")
+            r = conn.getresponse()
+            assert r.status == 200
+            r.read()
+            local.append(time.perf_counter() - t0)
+        conn.close()
+        with lock:
+            lat.extend(local)
+    except BaseException as e:
+        with lock:
+            errs.append(repr(e))
+def fresh_client(n):
+    try:
+        url = f"http://{host}:{port}/greet"
+        local = []
+        for _ in range(n):
+            t0 = time.perf_counter()
+            with urllib.request.urlopen(url, timeout=10) as r:
+                assert r.status == 200
+                r.read()
+            local.append(time.perf_counter() - t0)
+        with lock:
+            lat.extend(local)
+    except BaseException as e:
+        with lock:
+            errs.append(repr(e))
+fn = ka_client if mode == "keepalive" else fresh_client
+threads = [threading.Thread(target=fn, args=(per,)) for _ in range(nt)]
+t0 = time.perf_counter()
+[t.start() for t in threads]
+[t.join() for t in threads]
+wall = time.perf_counter() - t0
+if errs:
+    sys.exit("client errors: " + errs[0])
+lat.sort()
+import json
+print(json.dumps({
+    "qps": nt * per / wall,
+    "p50": lat[len(lat) // 2],
+    "p99": lat[min(len(lat) - 1, int(0.99 * len(lat)))],
+}))
+"""
+
+
+def _greet_load(port: int, mode: str, nt: int, per: int) -> dict:
+    """Run one load storm from a SEPARATE process. In-process clients
+    share the GIL with the server's event loop and measure their own
+    contention, not the server (r3 reported 703 QPS that way; the same
+    server sustains ~4.4k from an external keep-alive client)."""
+    import subprocess
+    import sys
+
+    proc = subprocess.run(
+        [sys.executable, "-c", _GREET_CLIENT, "127.0.0.1", str(port), mode,
+         str(nt), str(per)],
+        capture_output=True, text=True, timeout=300,
+    )
+    if proc.returncode != 0:
+        raise RuntimeError(f"greet load failed: {proc.stderr or proc.stdout}")
+    return json.loads(proc.stdout)
+
+
 def bench_greet(args) -> dict:
-    """BASELINE config 1: stock app, GET /greet over real sockets."""
+    """BASELINE config 1: stock app, GET /greet over real sockets.
+    Load is generated out-of-process; keep-alive is the primary number
+    (the reference league's benchmarks — wrk/hey against net/http — all
+    use persistent connections), with a fresh-connection storm reported
+    alongside. NOTE: this host has ONE core (os.cpu_count()==1), so
+    client and server still share it; on multi-core hosts HTTP_WORKERS=N
+    prefork raises this further (kernel-balanced SO_REUSEPORT accepts)."""
     import socket
-    import urllib.request
 
     from gofr_tpu import App
     from gofr_tpu.config import new_mock_config
@@ -472,63 +552,31 @@ def bench_greet(args) -> dict:
     }))
     app.get("/greet", lambda ctx: "Hello World!")
     app.run_in_background()
-    url = f"http://127.0.0.1:{port}/greet"
 
-    lat: list[float] = []
-    errors: list[BaseException] = []
-    lock = threading.Lock()
-
-    def client(n: int):
-        try:
-            for _ in range(n):
-                t0 = time.perf_counter()
-                with urllib.request.urlopen(url, timeout=5) as r:
-                    assert r.status == 200
-                    r.read()
-                dt = time.perf_counter() - t0
-                with lock:
-                    lat.append(dt)
-        except BaseException as e:  # noqa: BLE001 — surface after join
-            with lock:
-                errors.append(e)
-
-    nthreads = min(args.clients, args.requests)
+    # modest client concurrency, like wrk/hey defaults: hundreds of client
+    # THREADS on a small host measure client-side thrash (512 threads on
+    # this 1-core box: p50 108 ms, QPS 1.3k vs 4.4k at 8 threads)
+    nthreads = min(args.clients, 8)
     per = max(1, args.requests // nthreads)
-    threads = [threading.Thread(target=client, args=(per,)) for _ in range(nthreads)]
-    t0 = time.perf_counter()
-    for th in threads:
-        th.start()
-    for th in threads:
-        th.join()
-    wall = time.perf_counter() - t0
-    if errors:
-        raise RuntimeError(f"{len(errors)} greet clients failed: {errors[0]!r}")
-    qps = per * nthreads / wall
-    storm_p50 = _percentile(lat, 0.50)
-    storm_p99 = _percentile(lat, 0.99)
-
-    # uncongested latency: the saturation run's p50 is dominated by client
-    # GIL contention, not server time — the BASELINE <=10 ms p50 target is
-    # evaluated here, with a single closed-loop client
-    lat.clear()
-    lone = threading.Thread(target=client, args=(200,))
-    lone.start()
-    lone.join()
+    storm = _greet_load(port, "keepalive", nthreads, per)
+    fresh = _greet_load(port, "fresh", nthreads, max(1, per // 2))
+    lone = _greet_load(port, "keepalive", 1, 200)
     app.shutdown()
-    if errors:
-        raise RuntimeError(f"greet lone client failed: {errors[0]!r}")
     return {
         "metric": "greet_qps_cpu",
-        "value": round(qps, 1),
+        "value": round(storm["qps"], 1),
         "unit": "req/s",
         "vs_baseline": 1.0,  # no reference number exists (BASELINE.md: none published; Go toolchain absent)
         "detail": {
-            "p50_ms": round(storm_p50 * 1e3, 3),
-            "p99_ms": round(storm_p99 * 1e3, 3),
-            "uncongested_p50_ms": round(_percentile(lat, 0.50) * 1e3, 3),
-            "uncongested_p99_ms": round(_percentile(lat, 0.99) * 1e3, 3),
+            "p50_ms": round(storm["p50"] * 1e3, 3),
+            "p99_ms": round(storm["p99"] * 1e3, 3),
+            "fresh_conn_qps": round(fresh["qps"], 1),
+            "fresh_conn_p50_ms": round(fresh["p50"] * 1e3, 3),
+            "uncongested_p50_ms": round(lone["p50"] * 1e3, 3),
+            "uncongested_p99_ms": round(lone["p99"] * 1e3, 3),
             "requests": per * nthreads,
             "clients": nthreads,
+            "host_cores": os.cpu_count(),
         },
     }
 

@@ -302,7 +302,22 @@ class App:
             self._loop.call_soon_threadsafe(self._shutdown_event.set)
 
     def run(self) -> None:
-        """Blocking entrypoint with signal-driven graceful shutdown."""
+        """Blocking entrypoint with signal-driven graceful shutdown.
+
+        HTTP_WORKERS=N (N>1) enables prefork multi-worker serving for
+        CPU-bound apps: N processes share HTTP and metrics ports via
+        SO_REUSEPORT (kernel-balanced accepts), sidestepping the GIL the
+        way the reference relies on Go's runtime threads (httpServer.go:26
+        league). The fork happens before any server starts; a scrape of
+        /metrics samples one worker. Not compatible with an initialized
+        JAX runtime (device handles don't survive fork) — TPU apps scale
+        by engine replicas (ReplicatedLLMEngine) instead, so if JAX is
+        already imported the app logs a warning and serves single-process.
+        """
+        workers = self.config.get_int("HTTP_WORKERS", 1)
+        child_pids: list[int] = []
+        if workers > 1:
+            child_pids = self._fork_workers(workers)
 
         async def main():
             loop = asyncio.get_running_loop()
@@ -313,7 +328,94 @@ class App:
                     pass
             await self.serve()
 
-        asyncio.run(main())
+        try:
+            asyncio.run(main())
+        finally:
+            if child_pids:
+                self._reap_workers(child_pids)
+
+    @staticmethod
+    def _reap_workers(pids: list[int], grace: float = 10.0) -> None:
+        """SIGTERM each worker, wait up to `grace` seconds, SIGKILL any
+        survivor — a worker wedged in a C call must not hang the parent's
+        exit forever."""
+        import os
+        import time as _time
+
+        for pid in pids:
+            try:
+                os.kill(pid, signal.SIGTERM)
+            except ProcessLookupError:
+                pass
+        deadline = _time.monotonic() + grace
+        remaining = list(pids)
+        while remaining and _time.monotonic() < deadline:
+            for pid in list(remaining):
+                try:
+                    done, _ = os.waitpid(pid, os.WNOHANG)
+                except ChildProcessError:
+                    done = pid
+                if done:
+                    remaining.remove(pid)
+            if remaining:
+                _time.sleep(0.05)
+        for pid in remaining:
+            try:
+                os.kill(pid, signal.SIGKILL)
+                os.waitpid(pid, 0)
+            except (ProcessLookupError, ChildProcessError):
+                pass
+
+    def _fork_workers(self, workers: int) -> list[int]:
+        """Fork workers-1 children sharing the ports via SO_REUSEPORT.
+        Returns child pids in the parent, [] in a child (or when multi-
+        worker is unavailable on this platform/runtime)."""
+        import os
+        import socket
+        import sys
+
+        if not hasattr(socket, "SO_REUSEPORT"):
+            self.logger.warn("HTTP_WORKERS: SO_REUSEPORT unsupported; single worker")
+            return []
+        if "jax" in sys.modules:
+            self.logger.warn(
+                "HTTP_WORKERS>1 ignored: JAX already imported and device "
+                "state does not survive fork — use engine replicas to scale"
+            )
+            return []
+        if self.http_port == 0 or self.metrics_port == 0:
+            # reuse_port on port 0 would give every worker its OWN random
+            # port — three of four workers would serve unreachable sockets
+            self.logger.warn(
+                "HTTP_WORKERS>1 ignored: ephemeral port 0 cannot be shared "
+                "across workers; set fixed HTTP_PORT/METRICS_PORT"
+            )
+            return []
+        if self.config.get("REMOTE_LOG_URL"):
+            # threads do not survive fork: only the parent's poller lives on
+            self.logger.warn(
+                "HTTP_WORKERS>1: remote log-level polling runs in the "
+                "parent worker only"
+            )
+        # NOTE: datasource connections opened BEFORE run() (user startup
+        # code) would share one socket fd across workers and interleave
+        # protocol frames — framework datasources connect lazily/reconnect
+        # per process, but user-held sockets are the caller's contract.
+        self.http_server.reuse_port = True
+        self.metrics_server.reuse_port = True
+        pids: list[int] = []
+        try:
+            for _ in range(workers - 1):
+                pid = os.fork()
+                if pid == 0:
+                    return []  # child: serve like a normal process
+                pids.append(pid)
+        except OSError:
+            # partial fork failure: never orphan the workers already alive
+            self._reap_workers(pids)
+            raise
+        self.logger.info(f"HTTP multi-worker: {workers} processes on :{self.http_port}")
+        return pids
 
     # -- test helper: run the app in a daemon thread, return when ready --
     def run_in_background(self) -> threading.Thread:

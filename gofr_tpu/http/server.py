@@ -124,11 +124,15 @@ class AsyncHTTPServer:
         self.port = port
         self.host = host
         self.logger = logger
+        # SO_REUSEPORT bind: lets N worker processes share the port with
+        # kernel-level connection balancing (App multi-worker mode)
+        self.reuse_port = False
         self._server: asyncio.AbstractServer | None = None
 
     async def start(self) -> None:
         self._server = await asyncio.start_server(
-            self._handle_conn, self.host, self.port, limit=MAX_HEADER_BYTES
+            self._handle_conn, self.host, self.port, limit=MAX_HEADER_BYTES,
+            reuse_port=self.reuse_port or None,
         )
         self.port = self._server.sockets[0].getsockname()[1]
         if self.logger:

@@ -188,3 +188,72 @@ def test_request_timeout_408():
         assert status == 408
     finally:
         app.shutdown()
+
+
+def test_multi_worker_prefork_serves_and_shuts_down(tmp_path):
+    """HTTP_WORKERS=N forks N processes sharing the port via SO_REUSEPORT;
+    requests succeed, and SIGTERM to the parent reaps every worker."""
+    import os
+    import signal
+    import socket
+    import subprocess
+    import sys
+    import time
+
+    if not hasattr(socket, "SO_REUSEPORT"):
+        pytest.skip("no SO_REUSEPORT on this platform")
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        mport = s.getsockname()[1]
+    script = (
+        "import sys, os\n"
+        "from gofr_tpu import App\n"
+        "from gofr_tpu.config import new_mock_config\n"
+        "app = App(config=new_mock_config({'APP_NAME': 'mw',"
+        f" 'HTTP_PORT': '{port}', 'METRICS_PORT': '{mport}',"
+        " 'LOG_LEVEL': 'ERROR', 'HTTP_WORKERS': '3'}))\n"
+        "app.get('/pid', lambda ctx: {'pid': os.getpid()})\n"
+        "app.run()\n"
+    )
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.Popen(
+        [sys.executable, "-c", script], env=env,
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+    try:
+        deadline = time.time() + 15
+        pids = set()
+        last_err = None
+        while time.time() < deadline:
+            try:
+                with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/pid", timeout=2
+                ) as r:
+                    pids.add(json.load(r)["data"]["pid"])
+                if len(pids) >= 2:
+                    break
+            except (urllib.error.URLError, ConnectionError, OSError) as e:
+                last_err = e
+                time.sleep(0.2)
+        assert pids, f"no worker answered: {last_err!r}"
+        # kernel balancing is stochastic: with many sequential fresh
+        # connections, >=2 distinct worker pids should answer
+        assert len(pids) >= 2, f"only one worker served: {pids}"
+        proc.send_signal(signal.SIGTERM)
+        proc.wait(timeout=15)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=10)
+    # no orphaned worker may still be serving the port
+    time.sleep(0.5)
+    try:
+        urllib.request.urlopen(f"http://127.0.0.1:{port}/pid", timeout=1)
+        survived = True
+    except (urllib.error.URLError, ConnectionError, OSError):
+        survived = False
+    assert not survived, "a worker kept serving after parent SIGTERM"
