@@ -145,6 +145,7 @@ def _closed_loop(eng, cfg, prompt_len, new_tokens: int, requests: int,
             with lock:
                 errors.append(e)
 
+    st0 = eng.stats()  # snapshot: report THIS run's telemetry, not lifetime
     nthreads = min(clients, requests)
     per = max(1, requests // nthreads)
     done = per * nthreads
@@ -161,6 +162,13 @@ def _closed_loop(eng, cfg, prompt_len, new_tokens: int, requests: int,
     wall = time.perf_counter() - t0
     if errors:
         raise RuntimeError(f"{len(errors)} bench clients failed: {errors[0]!r}")
+    st1 = eng.stats()
+    chunks = st1["chunks"] - st0["chunks"]
+    active_sum = st1["active_sum"] - st0["active_sum"]
+    waves = {
+        nb: st1["prefill_waves"].get(nb, 0) - st0["prefill_waves"].get(nb, 0)
+        for nb in st1["prefill_waves"]
+    }
     return {
         "qps": round(done / wall, 1),
         "p50_ms": round(_percentile(lat, 0.50) * 1e3, 1),
@@ -168,6 +176,9 @@ def _closed_loop(eng, cfg, prompt_len, new_tokens: int, requests: int,
         "ttft_p50_ms": round(_percentile(ttft, 0.50) * 1e3, 1),
         "requests": done,
         "clients": nthreads,
+        "avg_active_at_dispatch": round(active_sum / chunks, 2) if chunks else 0.0,
+        "prefill_waves": {k: v for k, v in sorted(waves.items()) if v},
+        "chunks": chunks,
     }
 
 

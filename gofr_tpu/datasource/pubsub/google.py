@@ -19,10 +19,14 @@ Capability parity with the reference's cloud.google.com/go/pubsub wrapper
 - receive loop -> per-topic queue; Message.commit() acks (google.go:113-148)
 - health: endpoint + project reachability (google.go health.go)
 
-Against the REAL cloud service this client would additionally need OAuth;
-the emulator and fake (like the real emulator) are unauthenticated, which
-is exactly the surface CI exercises. Credentials-bearing deployments
-should front this with a token-injecting gRPC interceptor.
+Against the REAL cloud service, set GOOGLE_CREDENTIALS_FILE (a standard
+service-account JSON key): every call then carries
+`authorization: Bearer <RS256 self-signed JWT>` metadata minted by
+googleauth.ServiceAccountAuth (pure-stdlib signing mirroring the
+framework's existing RS256 verifier), over a TLS channel — the auth
+surface the reference gets from cloud.google.com/go's credential chain
+(google.go:36-79). The emulator and the in-process fake remain
+unauthenticated, which is exactly the surface CI exercises.
 """
 
 from __future__ import annotations
@@ -138,16 +142,53 @@ class GooglePubSub(_BasePubSub):
             or config.get("GOOGLE_ENDPOINT")
             or ""
         )
+        self._auth = None
+        creds_file = config.get("GOOGLE_CREDENTIALS_FILE")
+        ambient = None if creds_file else os.environ.get(
+            "GOOGLE_APPLICATION_CREDENTIALS"
+        )
+        if creds_file:
+            from .googleauth import ServiceAccountAuth
+
+            # explicit config: a bad key file is a loud startup error
+            self._auth = ServiceAccountAuth(creds_file)
+        elif ambient:
+            # ambient ADC env var: may be an authorized_user file from
+            # `gcloud auth application-default login`, a stale path, etc. —
+            # never a startup crash for an app that ran fine without it
+            from .googleauth import ServiceAccountAuth
+
+            try:
+                self._auth = ServiceAccountAuth(ambient)
+            except (OSError, ValueError, KeyError) as e:
+                if logger is not None:
+                    logger.warn(
+                        f"ignoring GOOGLE_APPLICATION_CREDENTIALS "
+                        f"({ambient!r}): not a usable service-account key: {e}"
+                    )
+        if self._auth is not None:
+            self.endpoint = self.endpoint or "pubsub.googleapis.com:443"
         if not self.endpoint:
             raise RuntimeError(
-                "GOOGLE pub/sub backend needs PUBSUB_EMULATOR_HOST (or "
-                "GOOGLE_ENDPOINT) — the cloud service additionally requires "
-                "credentials this environment cannot hold"
+                "GOOGLE pub/sub backend needs PUBSUB_EMULATOR_HOST / "
+                "GOOGLE_ENDPOINT, or GOOGLE_CREDENTIALS_FILE for the "
+                "authenticated cloud service"
             )
         import grpc
 
         self._grpc = grpc
-        self._channel = grpc.insecure_channel(self.endpoint)
+        # TLS iff talking to the real Google service (or explicitly asked):
+        # a plaintext GOOGLE_ENDPOINT proxy/emulator must not get a TLS
+        # handshake just because credentials happen to be present
+        use_tls = config.get_or_default("GOOGLE_TLS", "").lower() in ("1", "true") or (
+            "googleapis.com" in self.endpoint
+        )
+        if use_tls:
+            self._channel = grpc.secure_channel(
+                self.endpoint, grpc.ssl_channel_credentials()
+            )
+        else:
+            self._channel = grpc.insecure_channel(self.endpoint)
         self._calls: dict[str, object] = {}  # cached unary_unary multicallables
         self._lock = threading.Lock()
         self._topics: set[str] = set()
@@ -163,7 +204,8 @@ class GooglePubSub(_BasePubSub):
                 path, request_serializer=_ident, response_deserializer=_ident
             )
         try:
-            resp = fn(body, timeout=timeout)
+            metadata = self._auth.metadata() if self._auth is not None else None
+            resp = fn(body, timeout=timeout, metadata=metadata)
             self._last_error = None
             return resp
         except Exception as e:  # noqa: BLE001 — surfaced via health + reraise

@@ -289,6 +289,13 @@ class LLMEngine:
         self._waiting: list[GenRequest] = []  # drained queue, scheduler-only
         self.submitted = 0  # total requests ever submitted (router telemetry)
         self._admitting = 0  # sliced out of _waiting, not yet slotted
+        # dispatch telemetry (cheap counters; exposed via stats() so a
+        # saturation run reveals occupancy and wave-size efficiency)
+        self._stat_chunks = 0  # decode chunks dispatched
+        self._stat_chunk_steps = 0  # decode steps dispatched
+        self._stat_active_sum = 0  # sum of active slots at chunk dispatch
+        self._stat_waves: dict[int, int] = {}  # prefill wave width -> count
+        self._stat_wave_reqs = 0  # requests admitted via waves
         self._last_submit_t: float | None = None
         self._ema_gap: float | None = None  # EMA inter-arrival (rate estimate)
         self._stop = False
@@ -376,6 +383,16 @@ class LLMEngine:
                 "decode_chunk": self.decode_chunk,
                 "inflight_chunks": sum(1 for e in self._inflight if e[0] == "chunk"),
                 "submitted": self.submitted,
+                "chunks": self._stat_chunks,
+                "chunk_steps": self._stat_chunk_steps,
+                "active_sum": self._stat_active_sum,  # raw: callers can delta
+                "avg_active_at_dispatch": (
+                    round(self._stat_active_sum / self._stat_chunks, 2)
+                    if self._stat_chunks
+                    else 0.0
+                ),
+                "prefill_waves": dict(sorted(self._stat_waves.items())),
+                "wave_reqs": self._stat_wave_reqs,
             }
 
     def load(self) -> int:
@@ -667,6 +684,9 @@ class LLMEngine:
                 self._start_fetch(first_dev)
                 self._inflight.append(("prefill", first_dev, taken))
                 self._admitting -= len(reqs)
+                # under the lock: stats() iterates _stat_waves concurrently
+                self._stat_waves[nb] = self._stat_waves.get(nb, 0) + 1
+                self._stat_wave_reqs += len(reqs)
                 self._work_cv.notify()
         return True
 
@@ -733,6 +753,9 @@ class LLMEngine:
             self._tail = last
             self._start_fetch(toks)
             self._inflight.append(("chunk", toks, snapshot, k))
+            self._stat_chunks += 1
+            self._stat_chunk_steps += k
+            self._stat_active_sum += active_n
             self._work_cv.notify()
             return k
 

@@ -73,9 +73,11 @@ if "mm" in probes:
           jnp.ones((B, cfg.d_model), cfg.dtype), qp["layers"])
 
 if "un" in probes:
-    emb = qp["embed"]
     def make_un(k):
-        def un_chain(x):
+        # emb must be an ARGUMENT: closing over it makes the QTensor a
+        # compile-time constant and XLA constant-folds the 0.5 GB
+        # transpose+cast, hanging the (remote) compile
+        def un_chain(x, emb):
             def body(x, _):
                 logits = ((x * emb.s.astype(cfg.dtype))
                           @ emb.q.T.astype(cfg.dtype)).astype(jnp.float32)
@@ -83,7 +85,8 @@ if "un" in probes:
             x, _ = jax.lax.scan(body, x, None, length=k)
             return x.sum().astype(jnp.float32)
         return un_chain
-    timed("unembed [B,d]@[d,256k]", make_un, jnp.ones((B, cfg.d_model), cfg.dtype))
+    timed("unembed [B,d]@[d,256k]", make_un,
+          jnp.ones((B, cfg.d_model), cfg.dtype), qp["embed"])
 
 if "sample" in probes:
     topk = 64
