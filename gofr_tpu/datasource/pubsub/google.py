@@ -160,7 +160,7 @@ class GooglePubSub(_BasePubSub):
 
             try:
                 self._auth = ServiceAccountAuth(ambient)
-            except (OSError, ValueError, KeyError) as e:
+            except Exception as e:  # noqa: BLE001 — any malformed key shape
                 if logger is not None:
                     logger.warn(
                         f"ignoring GOOGLE_APPLICATION_CREDENTIALS "
@@ -189,6 +189,17 @@ class GooglePubSub(_BasePubSub):
             )
         else:
             self._channel = grpc.insecure_channel(self.endpoint)
+            if self._auth is not None:
+                # never send a bearer credential in cleartext — it would be
+                # replayable against the REAL service for its whole lifetime
+                # (standard gRPC clients refuse call creds on insecure
+                # channels for the same reason)
+                if logger is not None:
+                    logger.warn(
+                        "Google Pub/Sub: plaintext channel — bearer auth "
+                        "metadata will NOT be attached"
+                    )
+        self._send_auth = self._auth is not None and use_tls
         self._calls: dict[str, object] = {}  # cached unary_unary multicallables
         self._lock = threading.Lock()
         self._topics: set[str] = set()
@@ -204,7 +215,7 @@ class GooglePubSub(_BasePubSub):
                 path, request_serializer=_ident, response_deserializer=_ident
             )
         try:
-            metadata = self._auth.metadata() if self._auth is not None else None
+            metadata = self._auth.metadata() if self._send_auth else None
             resp = fn(body, timeout=timeout, metadata=metadata)
             self._last_error = None
             return resp

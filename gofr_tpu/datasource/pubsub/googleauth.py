@@ -205,8 +205,16 @@ class ServiceAccountAuth:
 
     def token(self) -> str:
         with self._lock:
-            if self._token is None or time.time() >= self._expiry - self._EARLY:
-                self._token, self._expiry = self._fresh_token()
+            tok, exp = self._token, self._expiry
+        if tok is not None and time.time() < exp - self._EARLY:
+            return tok
+        # refresh OUTSIDE the lock: in oauth mode this is a blocking HTTP
+        # round trip (up to 10 s), and holding the lock would convoy every
+        # concurrent caller behind one slow token endpoint
+        new_tok, new_exp = self._fresh_token()
+        with self._lock:
+            if new_exp > self._expiry:  # keep whichever refresh is fresher
+                self._token, self._expiry = new_tok, new_exp
             return self._token
 
     def metadata(self) -> list[tuple[str, str]]:

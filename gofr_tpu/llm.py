@@ -54,9 +54,18 @@ from typing import Any, Iterator
 
 import numpy as np
 
-__all__ = ["LLMEngine", "ReplicatedLLMEngine", "GenRequest"]
+__all__ = ["LLMEngine", "ReplicatedLLMEngine", "GenRequest", "EngineOverloaded"]
 
 _EOS_DEFAULT = -1  # no EOS cut by default (random-weight models)
+
+
+class EngineOverloaded(RuntimeError):
+    """Raised by submit() when the admission queue cap is hit — the
+    SLO-preserving alternative to unbounded queueing (map to HTTP 429).
+    Carries `status_code` so the responder's statusCodeResponder seam
+    translates it without a handler-side catch."""
+
+    status_code = 429
 
 
 @dataclass(eq=False)  # identity semantics: requests are handles, and the
@@ -128,6 +137,8 @@ class LLMEngine:
         mesh=None,
         param_specs: Any = None,
         device=None,
+        max_queue: int | None = None,
+        ttft_deadline_ms: float | None = None,
         logger=None,
         metrics=None,
         warmup: bool = True,
@@ -162,6 +173,18 @@ class LLMEngine:
         self.lookahead = max(1, lookahead)
         self.admit_cap = min(admit_cap, slots)
         self.admit_delay = admit_delay_ms / 1000.0
+        # SLO-aware overload control (both optional, both mutable at
+        # runtime): max_queue bounds requests waiting for a slot — beyond
+        # it submit() raises EngineOverloaded (-> 429) instead of letting
+        # p99 grow with an unbounded closed-loop queue; ttft_deadline_ms
+        # sheds a request still queued when its first token could no
+        # longer arrive in time (finish_reason "shed").
+        self.max_queue = max_queue
+        self.ttft_deadline = (
+            ttft_deadline_ms / 1000.0 if ttft_deadline_ms else None
+        )
+        self.rejected = 0  # submit-time cap rejections
+        self.shed = 0  # deadline sheds at admission
         self.logger = logger
         self.metrics = metrics
         if mesh is not None and param_specs is not None:
@@ -353,6 +376,13 @@ class LLMEngine:
         if req.max_new_tokens > room:
             req.max_new_tokens = room
             req.capped = True
+        if self.max_queue is not None:
+            depth = self._admit_q.qsize() + len(self._waiting) + self._admitting
+            if depth >= self.max_queue:
+                self.rejected += 1
+                raise EngineOverloaded(
+                    f"admission queue full ({depth} >= {self.max_queue})"
+                )
         now = time.perf_counter()
         req.submitted_at = now
         self.submitted += 1  # routing/diagnostic counter (GIL-atomic enough)
@@ -393,6 +423,8 @@ class LLMEngine:
                 ),
                 "prefill_waves": dict(sorted(self._stat_waves.items())),
                 "wave_reqs": self._stat_wave_reqs,
+                "rejected": self.rejected,
+                "shed": self.shed,
             }
 
     def load(self) -> int:
@@ -600,6 +632,23 @@ class LLMEngine:
                 req.out.put(None)
                 continue
             self._waiting.append(req)
+        if self.ttft_deadline is not None and self._waiting:
+            # shed-on-deadline: a request whose first token can no longer
+            # arrive inside its TTFT budget gets a fast end-of-stream now
+            # instead of consuming a prefill slot it can't benefit from
+            now_t = time.perf_counter()
+            kept = []
+            for r in self._waiting:
+                if (
+                    r.submitted_at is not None
+                    and now_t - r.submitted_at > self.ttft_deadline
+                ):
+                    self.shed += 1
+                    r.finish_reason = "shed"
+                    r.out.put(None)
+                else:
+                    kept.append(r)
+            self._waiting = kept
         if not self._waiting or not free:
             return False
         # Rate-gated wave-fill hold: a prefill wave costs device time that

@@ -1,0 +1,64 @@
+"""Round-4: open-loop mid-load investigation with engine telemetry.
+
+Reruns the bench's open-loop points (default 100 and 200 QPS) on the
+serving engine and prints per-point stats deltas (wave widths, chunk
+occupancy) plus a submit->first-dispatch wait histogram, to find where
+the 200-QPS shed (offered 200 -> achieved ~179, r3+r4) comes from.
+Run with the host otherwise QUIET — everything shares one core.
+"""
+import json
+import sys
+import time
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+import numpy as np
+
+import bench as B  # reuse engine construction + open loop
+from gofr_tpu.llm import LLMEngine
+from gofr_tpu.models import TransformerConfig
+
+cfg = TransformerConfig.gemma_2b()
+S, NEW, K = 128, 16, 16
+
+
+def main():
+    import jax
+
+    from gofr_tpu.models.quant import init_params_quantized
+
+    rates = [float(x) for x in sys.argv[1:]] or [100.0, 200.0]
+    params = jax.jit(lambda k: init_params_quantized(k, cfg))(jax.random.PRNGKey(0))
+    # EXACT bench configuration (admit_cap 16, prompts S-8) — telemetry
+    # must describe the run it diagnoses
+    eng = LLMEngine(
+        cfg, params, slots=128, max_seq_len=S + NEW + 2 * K,
+        prefill_buckets=(S,), decode_chunk=K, admit_cap=16, quantize=True,
+    )
+    # warmup
+    B._closed_loop(eng, cfg, S - 8, NEW, requests=256, clients=64)
+    for rate in rates:
+        st0 = eng.stats()
+        t0 = time.perf_counter()
+        out = B._open_loop(eng, cfg, S - 8, NEW, rate, duration_s=10.0)
+        st1 = eng.stats()
+        waves = {
+            nb: st1["prefill_waves"].get(nb, 0) - st0["prefill_waves"].get(nb, 0)
+            for nb in st1["prefill_waves"]
+        }
+        chunks = st1["chunks"] - st0["chunks"]
+        act = st1["active_sum"] - st0["active_sum"]
+        print(json.dumps({
+            "rate": rate,
+            **{k: out[k] for k in ("achieved_qps", "p50_ms", "p99_ms",
+                                    "ttft_p50_ms", "drain_ms")},
+            "waves": {k: v for k, v in sorted(waves.items()) if v},
+            "chunks": chunks,
+            "avg_active": round(act / chunks, 1) if chunks else 0,
+            "wall_s": round(time.perf_counter() - t0, 1),
+        }), flush=True)
+    eng.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -243,9 +243,11 @@ class TestOAuthGrant:
 
 
 class TestPubSubIntegration:
-    def test_credentials_file_wires_auth_metadata(self, sa_info, tmp_path):
-        """GOOGLE_CREDENTIALS_FILE + emulator endpoint: calls must carry
-        the bearer metadata (the fake broker surface just ignores it)."""
+    def test_credentials_file_configures_auth(self, sa_info, tmp_path):
+        """GOOGLE_CREDENTIALS_FILE + emulator endpoint: auth is configured
+        but bearer metadata is WITHHELD on the plaintext channel (a JWT in
+        cleartext would be replayable against the real service); traffic
+        still flows."""
         from gofr_tpu.config import new_mock_config
         from gofr_tpu.datasource.pubsub.google import GooglePubSub
         from gofr_tpu.testutil.fakegooglepubsub import FakeGooglePubSub
@@ -261,10 +263,34 @@ class TestPubSubIntegration:
             })
             ps = GooglePubSub(cfg)
             assert ps._auth is not None
+            assert ps._send_auth is False  # insecure channel: no bearer
             ps._ensure_subscription("t-auth")  # subscribe-before-publish
             ps.publish_sync("t-auth", b"hello")
             msg = ps._pull_blocking("t-auth", timeout=5.0)
             assert msg is not None and msg.value == b"hello"
             ps.close()
+        finally:
+            fake.close()
+
+    def test_ambient_bad_credentials_never_crash(self, tmp_path, monkeypatch):
+        """A stale/foreign GOOGLE_APPLICATION_CREDENTIALS (authorized_user
+        ADC file, truncated key, missing path) must not break an app that
+        worked against the emulator before."""
+        from gofr_tpu.config import new_mock_config
+        from gofr_tpu.datasource.pubsub.google import GooglePubSub
+        from gofr_tpu.testutil.fakegooglepubsub import FakeGooglePubSub
+
+        bad = tmp_path / "adc.json"
+        bad.write_text(json.dumps({"type": "authorized_user", "refresh_token": "x"}))
+        fake = FakeGooglePubSub()
+        try:
+            for path in (str(bad), str(tmp_path / "missing.json")):
+                monkeypatch.setenv("GOOGLE_APPLICATION_CREDENTIALS", path)
+                cfg = new_mock_config({
+                    "PUBSUB_EMULATOR_HOST": f"127.0.0.1:{fake.port}",
+                })
+                ps = GooglePubSub(cfg)
+                assert ps._auth is None
+                ps.close()
         finally:
             fake.close()

@@ -167,3 +167,58 @@ class TestCollectorFailure:
         assert covered.finish_reason is None
         # lost entry must vanish from _processing in the same lock hold
         assert fake._processing is None
+
+
+class TestSLOAdmission:
+    def test_max_queue_rejects_with_429(self, params):
+        from gofr_tpu.llm import EngineOverloaded
+
+        eng = LLMEngine(
+            CFG, params, slots=1, max_seq_len=64, prefill_buckets=(8,),
+            max_queue=2, warmup=False,
+        )
+        try:
+            reqs = []
+            rejected = 0
+            for i in range(40):
+                try:
+                    reqs.append(
+                        eng.submit(GenRequest([1 + i % 7, 2], max_new_tokens=8))
+                    )
+                except EngineOverloaded as e:
+                    rejected += 1
+                    assert e.status_code == 429
+            assert rejected > 0, "cap never hit"
+            for r in reqs:  # accepted requests must all complete normally
+                toks = r.tokens()
+                assert r.finish_reason in ("length", "eos"), r.finish_reason
+                assert len(toks) == 8
+            assert eng.stats()["rejected"] == rejected
+        finally:
+            eng.close()
+
+    def test_ttft_deadline_sheds_stale_requests(self, params):
+        eng = LLMEngine(
+            CFG, params, slots=1, max_seq_len=64, prefill_buckets=(8,),
+            ttft_deadline_ms=1.0, warmup=False,
+        )
+        try:
+            # pile up more work than one slot can start within 1 ms
+            reqs = [
+                eng.submit(GenRequest([1 + i % 7, 2], max_new_tokens=8))
+                for i in range(30)
+            ]
+            finished = [list(r.stream(timeout=120)) for r in reqs]
+            shed = [r for r in reqs if r.finish_reason == "shed"]
+            served = [
+                (r, t) for r, t in zip(reqs, finished) if r.finish_reason != "shed"
+            ]
+            assert shed, "deadline never shed anything"
+            assert all(
+                t == [] for r, t in zip(reqs, finished) if r.finish_reason == "shed"
+            )
+            for r, toks in served:
+                assert len(toks) == 8
+            assert eng.stats()["shed"] == len(shed)
+        finally:
+            eng.close()
