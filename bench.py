@@ -6,8 +6,9 @@ through the LLMEngine (slot continuous batching + fused decode chunks) —
 the BASELINE.json metric ("QPS/chip + p50/p99 latency serving Gemma-2B").
 vs_baseline normalizes against the north-star floor of >=1,000 QPS/chip
 (BASELINE.md): vs_baseline = measured QPS-equivalent / 1000, where a
-"query" is a 16-token completion. detail reports prefill MFU% and decode
-HBM-bandwidth utilization so perf regressions are visible.
+"query" is a 16-token completion. detail reports prefill %-of-bf16-nominal
+(int8 path: a utilization index, not MFU) and decode HBM-bandwidth
+utilization so perf regressions are visible.
 
 --model mlp: end-to-end serving QPS of the MNIST MLP through the TPU
 datasource's dynamic batcher (BASELINE.json config 2 minus the socket);
@@ -103,7 +104,10 @@ def _raw_probes(eng, cfg, args, S: int, B: int) -> dict:
         "raw_decode_tok_s": round(raw_tok_s, 0),
         "decode_hbm_bw_pct": round(bw_util * 100, 1),
         f"prefill_ms_b{nb}": round(prefill_s * 1e3, 1),
-        "prefill_mfu_pct_of_bf16peak": round(mfu * 100, 1),
+        # % of the 197 TF/s bf16 NOMINAL figure; the prefill path runs
+        # int8 (W8A8) where the MXU's nominal is 2x, so >100 is expected —
+        # this is a utilization index, not an MFU claim (VERDICT r3 weak #6)
+        "prefill_pct_of_bf16_nominal": round(mfu * 100, 1),
     }
 
 
