@@ -56,7 +56,7 @@ class App:
         self.router.use(cors_middleware(self._cors_overrides()))
         self.router.use(metrics_middleware(self.container.metrics))
 
-        self.http_server = AsyncHTTPServer(self.router.dispatch, self.http_port, logger=self.logger)
+        self.http_server = self._make_http_server()
         self.metrics_server = MetricsServer(self.container.metrics, self.metrics_port)
         self.grpc_server = None  # created on first register_service
         self._grpc_registered = False
@@ -68,6 +68,23 @@ class App:
         self._shutdown_event: asyncio.Event | None = None
         self._loop: asyncio.AbstractEventLoop | None = None
         self._bg_tasks: list[asyncio.Task] = []
+
+    def _make_http_server(self):
+        """Native-codec protocol server when the C++ extension builds
+        (gofr_tpu/native), pure-Python asyncio streams server otherwise.
+        GOFR_HTTP_NATIVE=0 forces the fallback; both pass the same
+        conformance suite (tests/test_native_http.py)."""
+        if self.config.get_or_default("GOFR_HTTP_NATIVE", "1") != "0":
+            from .http.nativeserver import NativeHTTPServer
+
+            if NativeHTTPServer.available():
+                return NativeHTTPServer(
+                    self.router.dispatch, self.http_port, logger=self.logger
+                )
+            self.logger.warn(
+                "native HTTP codec unavailable; using pure-Python server"
+            )
+        return AsyncHTTPServer(self.router.dispatch, self.http_port, logger=self.logger)
 
     def _cors_overrides(self) -> dict[str, str]:
         """ACCESS_CONTROL_ALLOW_* env overrides -> header names."""

@@ -1,0 +1,313 @@
+"""Protocol-mode HTTP/1.1 server over the native C++ wire codec.
+
+Parity: reference pkg/gofr/httpServer.go:19-50 — same observable behavior
+as gofr_tpu/http/server.py (AsyncHTTPServer): keep-alive, chunked request
+bodies, Expect: 100-continue, HEAD, chunked streaming responses, 5 s
+read-header timeout, 64 KiB header cap, 100 MB body cap, identical error
+envelopes. Re-designed transport: instead of asyncio streams (whose
+readuntil/readexactly layers dominate per-request CPU), connections are
+asyncio.Protocol instances feeding a byte buffer into `_gofr_http.parse`
+(gofr_tpu/native/httpcore.cc) and writing responses serialized by
+`build_head` in a single transport.write. The reference's HTTP plane is
+compiled Go; this is the equivalent native fast path for the CPU-bound
+configs, with AsyncHTTPServer as the always-available pure-Python
+fallback (App picks at startup; GOFR_HTTP_NATIVE=0 forces the fallback).
+
+Request dispatch, routing, and middleware stay 100% Python and identical
+between the two servers — tests/test_native_http.py runs the same
+conformance suite against both.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Callable
+
+from ..logging import Logger
+from ..native import load_http_codec
+from .request import Request
+from .responder import Response
+from .server import _status_line  # shared status-reason table (server.py)
+
+MAX_HEADER_BYTES = 64 * 1024
+READ_HEADER_TIMEOUT = 5.0  # httpServer.go:37
+KEEPALIVE_IDLE_TIMEOUT = 75.0
+# receive-side high-water mark: while a request is processing, a client
+# streaming ahead (pipelining/flooding) is paused once this much is
+# buffered — the streams server gets the same protection from asyncio
+# flow control; without this the protocol server would buffer unbounded
+RECV_HIGH_WATER = 256 * 1024
+
+_ERR_HEAD = b"Content-Type: application/json\r\nConnection: close\r\n"
+
+
+class _HTTPProtocol(asyncio.Protocol):
+    """One connection: buffer -> native parse -> dispatch -> native head."""
+
+    __slots__ = (
+        "server", "codec", "transport", "buf", "head", "remote",
+        "processing", "closed", "timer", "paused_reading", "can_write",
+        "_loop",
+    )
+
+    def __init__(self, server: "NativeHTTPServer"):
+        self.server = server
+        self.codec = server.codec
+        self.transport: asyncio.Transport | None = None
+        self.buf = bytearray()
+        self.head = None  # parsed tuple awaiting its body
+        self.remote = ""
+        self.processing = False
+        self.closed = False
+        self.paused_reading = False
+        self.timer: asyncio.TimerHandle | None = None
+        self.can_write: asyncio.Event | None = None  # created lazily (streams)
+        self._loop = server._loop
+
+    # ---- transport callbacks -------------------------------------------
+    def connection_made(self, transport) -> None:
+        self.transport = transport
+        peer = transport.get_extra_info("peername")
+        self.remote = f"{peer[0]}:{peer[1]}" if isinstance(peer, tuple) else ""
+        self._arm_timer(READ_HEADER_TIMEOUT)
+
+    def connection_lost(self, exc) -> None:
+        self.closed = True
+        if self.timer is not None:
+            self.timer.cancel()
+            self.timer = None
+        if self.can_write is not None:
+            self.can_write.set()  # unblock a draining stream writer
+
+    def pause_writing(self) -> None:
+        if self.can_write is None:
+            self.can_write = asyncio.Event()
+        self.can_write.clear()
+
+    def resume_writing(self) -> None:
+        if self.can_write is not None:
+            self.can_write.set()
+
+    def data_received(self, data: bytes) -> None:
+        self.buf += data
+        if self.processing:
+            if len(self.buf) > RECV_HIGH_WATER and not self.paused_reading:
+                self.paused_reading = True
+                self.transport.pause_reading()
+            return
+        self._pump()
+
+    # ---- timers ---------------------------------------------------------
+    def _arm_timer(self, timeout: float) -> None:
+        if self.timer is not None:
+            self.timer.cancel()
+        self.timer = self._loop.call_later(timeout, self._on_timeout)
+
+    def _on_timeout(self) -> None:
+        self.timer = None
+        if not self.processing and self.transport is not None:
+            self.transport.close()
+
+    # ---- request assembly ----------------------------------------------
+    def _pump(self) -> None:
+        """Parse as many complete requests as the buffer holds (one at a
+        time — the next parse happens after the current response)."""
+        if self.closed or self.transport is None:
+            return
+        try:
+            if self.head is None:
+                parsed = self.codec.parse(self.buf)
+                if parsed is None:
+                    if len(self.buf) > MAX_HEADER_BYTES:
+                        self._protocol_error(431, "headers too large")
+                    return
+                if parsed[0] > MAX_HEADER_BYTES:
+                    self._protocol_error(431, "headers too large")
+                    return
+                self.head = parsed
+                # header block complete: body reads are not timed (streams
+                # server parity — its wait_for wraps _read_headers only)
+                if self.timer is not None:
+                    self.timer.cancel()
+                    self.timer = None
+                if parsed[6] & self.codec.F_EXPECT_CONTINUE:
+                    self.transport.write(b"HTTP/1.1 100 Continue\r\n\r\n")
+            end, method, target, minor, headers, clen, flags = self.head
+            if flags & self.codec.F_CHUNKED:
+                done = self.codec.parse_chunked(self.buf, end)
+                if done is None:
+                    return
+                body, consumed = done
+            elif clen > 0:
+                if len(self.buf) - end < clen:
+                    return
+                body = bytes(self.buf[end : end + clen])
+                consumed = end + clen
+            else:
+                body = b""
+                consumed = end
+        except ValueError as e:
+            if len(e.args) == 2 and isinstance(e.args[0], int):
+                status, msg = e.args
+            else:
+                status, msg = 400, "bad request"
+            self._protocol_error(status, msg)
+            return
+
+        del self.buf[:consumed]
+        self.head = None
+        # server.py parity: HTTP/1.0 always closes (even with an explicit
+        # keep-alive header — the pure-Python server ignores it too)
+        close = bool(flags & self.codec.F_CLOSE) or minor == 0
+        req = Request(method, target, headers, body, self.remote)
+        self.processing = True
+        self._loop.create_task(self._respond(req, method, close))
+
+    def _protocol_error(self, status: int, msg: str) -> None:
+        if self.transport is None:
+            return
+        body = ('{"error":{"message":"' + msg + '"}}').encode()
+        self.transport.write(
+            _status_line(status)
+            + _ERR_HEAD
+            + f"Content-Length: {len(body)}\r\n\r\n".encode()
+            + body
+        )
+        self.transport.close()
+        self.closed = True
+
+    # ---- response -------------------------------------------------------
+    async def _respond(self, req: Request, method: str, close: bool) -> None:
+        try:
+            try:
+                resp = await self.server.dispatch(req)
+            except Exception as e:  # noqa: BLE001 - middleware recovers first
+                if self.server.logger:
+                    self.server.logger.error(f"unhandled dispatch error: {e!r}")
+                resp = Response(
+                    500,
+                    [("Content-Type", "application/json")],
+                    b'{"error":{"message":"internal error"}}',
+                )
+            if self.closed or self.transport is None:
+                return
+            if resp.stream is not None and method != "HEAD":
+                ok = await self._write_stream(resp, close)
+                if not ok:
+                    return
+            else:
+                body = b"" if method == "HEAD" else resp.body
+                # HEAD advertises the real entity length (server.py parity)
+                self.transport.write(
+                    self.codec.build_head(
+                        resp.status, resp.headers, len(resp.body),
+                        1 if close else 0, 0,
+                        body if body else None,
+                    )
+                )
+                # drain: a pipelining client that reads slowly must not
+                # grow the transport buffer unbounded (server.py awaits
+                # writer.drain() after every response)
+                if self.can_write is not None and not self.can_write.is_set():
+                    await self.can_write.wait()
+                    if self.closed:
+                        return
+            if close:
+                self.transport.close()
+                self.closed = True
+                return
+        finally:
+            self.processing = False
+            if self.paused_reading and self.transport is not None and not self.closed:
+                self.paused_reading = False
+                self.transport.resume_reading()
+        self._arm_timer(KEEPALIVE_IDLE_TIMEOUT)
+        if self.buf:
+            self._pump()  # pipelined request already buffered
+
+    async def _write_stream(self, resp: Response, close: bool) -> bool:
+        """Chunked streaming response with transport flow control.
+        Returns False when the connection is dead (caller stops serving)."""
+        assert self.transport is not None
+        self.transport.write(
+            self.codec.build_head(resp.status, resp.headers, -1, 1 if close else 0, 1)
+        )
+        try:
+            async for chunk in resp.stream:
+                if not chunk:
+                    continue
+                if self.closed:
+                    return False
+                self.transport.write(
+                    f"{len(chunk):x}\r\n".encode() + chunk + b"\r\n"
+                )
+                if self.can_write is not None and not self.can_write.is_set():
+                    await self.can_write.wait()
+                    if self.closed:
+                        return False
+        except Exception as e:  # noqa: BLE001
+            # Mid-stream failure: abort WITHOUT the chunked terminator so the
+            # client sees truncation, not a silently-short success (server.py
+            # semantics).
+            if self.server.logger:
+                self.server.logger.error(f"stream aborted: {e!r}")
+            self.transport.abort()
+            self.closed = True
+            return False
+        self.transport.write(b"0\r\n\r\n")
+        return True
+
+
+class NativeHTTPServer:
+    """Drop-in alternative to AsyncHTTPServer backed by the C++ codec.
+
+    Construction requires the codec: callers use `available()` (or let
+    gofr_tpu.app.App decide) and fall back to AsyncHTTPServer otherwise.
+    """
+
+    def __init__(
+        self,
+        dispatch: Callable,
+        port: int = 8000,
+        host: str = "0.0.0.0",
+        logger: Logger | None = None,
+    ):
+        codec = load_http_codec()
+        if codec is None:
+            raise RuntimeError("native HTTP codec unavailable")
+        self.codec = codec
+        self.dispatch = dispatch  # async (Request) -> Response
+        self.port = port
+        self.host = host
+        self.logger = logger
+        self.reuse_port = False
+        self._server: asyncio.AbstractServer | None = None
+        self._loop: asyncio.AbstractEventLoop | None = None
+
+    @staticmethod
+    def available() -> bool:
+        return load_http_codec() is not None
+
+    async def start(self) -> None:
+        self._loop = asyncio.get_running_loop()
+        self._server = await self._loop.create_server(
+            lambda: _HTTPProtocol(self),
+            self.host,
+            self.port,
+            reuse_port=self.reuse_port or None,
+        )
+        self.port = self._server.sockets[0].getsockname()[1]
+        if self.logger:
+            self.logger.info(f"HTTP server (native codec) listening on :{self.port}")
+
+    async def serve_forever(self) -> None:
+        if self._server is None:
+            await self.start()
+        assert self._server is not None
+        await self._server.serve_forever()
+
+    async def shutdown(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None

@@ -1,0 +1,414 @@
+"""Conformance suite for the native-codec HTTP server.
+
+Every test runs against BOTH server implementations (pure-Python
+AsyncHTTPServer and the C++-codec NativeHTTPServer) through one raw-socket
+client, asserting byte-level wire behavior is identical: keep-alive,
+pipelining, chunked request bodies, Expect: 100-continue, HEAD, streaming
+responses, protocol errors (400/413/431/505), and header-cap enforcement.
+Plus direct unit/fuzz coverage of the `_gofr_http` codec against the
+pure-Python parser. Parity anchor: reference pkg/gofr/httpServer.go and
+net/http semantics the Go plane inherits.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import functools
+import json
+
+import pytest
+
+from gofr_tpu.http.request import Request
+from gofr_tpu.http.responder import Response
+from gofr_tpu.http.server import AsyncHTTPServer
+from gofr_tpu.native import load_http_codec
+
+codec = load_http_codec()
+needs_codec = pytest.mark.skipif(codec is None, reason="native codec unavailable")
+
+
+def async_test(fn):
+    """Run an async test to completion (no pytest-asyncio in the image)."""
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        asyncio.run(fn(*args, **kwargs))
+
+    return wrapper
+
+
+async def echo_dispatch(req: Request) -> Response:
+    """Dispatch that mirrors the request back for assertions."""
+    if req.path == "/stream":
+        async def gen():
+            for part in (b"alpha", b"", b"beta"):
+                yield part
+        return Response(200, [("Content-Type", "text/plain")], stream=gen())
+    if req.path == "/boom-stream":
+        async def gen():
+            yield b"partial"
+            raise RuntimeError("mid-stream failure")
+        return Response(200, [], stream=gen())
+    if req.path == "/boom":
+        raise RuntimeError("handler exploded")
+    payload = {
+        "method": req.method,
+        "path": req.path,
+        "query": {k: v[0] for k, v in req.query.items()},
+        "body": req.body.decode("latin-1"),
+        "hdr": req.header("x-probe") or "",
+    }
+    return Response(
+        200, [("Content-Type", "application/json")], json.dumps(payload).encode()
+    )
+
+
+def _servers():
+    out = [("python", AsyncHTTPServer)]
+    if codec is not None:
+        from gofr_tpu.http.nativeserver import NativeHTTPServer
+
+        out.append(("native", NativeHTTPServer))
+    return out
+
+
+@pytest.fixture(params=_servers(), ids=lambda p: p[0])
+def server_cls(request):
+    return request.param[1]
+
+
+@contextlib.asynccontextmanager
+async def serving(server_cls):
+    """Start a server; yield (srv, connect). All connections opened through
+    `connect` are force-aborted before shutdown — Python 3.12's
+    Server.wait_closed() blocks while any handler is alive, so a test that
+    fails mid-connection must not wedge the suite on a keep-alive socket."""
+    srv = server_cls(echo_dispatch, port=0, host="127.0.0.1")
+    await srv.start()
+    writers: list[asyncio.StreamWriter] = []
+
+    async def connect():
+        reader, writer = await asyncio.open_connection("127.0.0.1", srv.port)
+        writers.append(writer)
+        return reader, writer
+
+    try:
+        yield srv, connect
+    finally:
+        for w in writers:
+            with contextlib.suppress(Exception):
+                w.transport.abort()
+        await asyncio.wait_for(srv.shutdown(), timeout=10)
+
+
+async def _talk(connect, payload: bytes) -> bytes:
+    reader, writer = await connect()
+    writer.write(payload)
+    await writer.drain()
+    return await asyncio.wait_for(reader.read(), timeout=5)
+
+
+async def _read_response(reader) -> tuple[int, dict, bytes]:
+    head = await asyncio.wait_for(reader.readuntil(b"\r\n\r\n"), timeout=5)
+    lines = head.decode().split("\r\n")
+    status = int(lines[0].split(" ")[1])
+    headers = {}
+    for ln in lines[1:]:
+        if ":" in ln:
+            k, _, v = ln.partition(":")
+            headers[k.strip().lower()] = v.strip()
+    if "content-length" in headers:
+        body = await reader.readexactly(int(headers["content-length"]))
+    elif headers.get("transfer-encoding") == "chunked":
+        body = b""
+        while True:
+            size_line = await reader.readline()
+            size = int(size_line.strip(), 16)
+            if size == 0:
+                await reader.readline()
+                break
+            body += await reader.readexactly(size)
+            await reader.readline()
+    else:
+        body = b""
+    return status, headers, body
+
+
+@async_test
+async def test_get_roundtrip_and_keepalive(server_cls):
+    async with serving(server_cls) as (srv, connect):
+        reader, writer = await connect()
+        for i in range(3):  # same connection three times = keep-alive works
+            writer.write(
+                f"GET /echo?i={i} HTTP/1.1\r\nHost: t\r\nX-Probe: v{i}\r\n\r\n".encode()
+            )
+            await writer.drain()
+            status, headers, body = await _read_response(reader)
+            assert status == 200
+            got = json.loads(body)
+            assert got["method"] == "GET"
+            assert got["path"] == "/echo"
+            assert got["query"] == {"i": str(i)}
+            assert got["hdr"] == f"v{i}"
+
+
+@async_test
+async def test_post_body_and_pipelining(server_cls):
+    async with serving(server_cls) as (srv, connect):
+        reader, writer = await connect()
+        # two pipelined requests in one write
+        writer.write(
+            b"POST /a HTTP/1.1\r\nHost: t\r\nContent-Length: 5\r\n\r\nhello"
+            b"POST /b HTTP/1.1\r\nHost: t\r\nContent-Length: 5\r\n\r\nworld"
+        )
+        await writer.drain()
+        s1, _, b1 = await _read_response(reader)
+        s2, _, b2 = await _read_response(reader)
+        assert (s1, s2) == (200, 200)
+        assert json.loads(b1)["body"] == "hello"
+        assert json.loads(b2)["body"] == "world"
+
+
+@async_test
+async def test_chunked_request_body(server_cls):
+    async with serving(server_cls) as (srv, connect):
+        raw = (
+            b"POST /c HTTP/1.1\r\nHost: t\r\nTransfer-Encoding: chunked\r\n\r\n"
+            b"4\r\nwiki\r\n5;ext=1\r\npedia\r\n0\r\nTrailer: x\r\n\r\n"
+        )
+        reader, writer = await connect()
+        writer.write(raw)
+        await writer.drain()
+        status, _, body = await _read_response(reader)
+        assert status == 200
+        assert json.loads(body)["body"] == "wikipedia"
+
+
+@async_test
+async def test_expect_100_continue(server_cls):
+    async with serving(server_cls) as (srv, connect):
+        reader, writer = await connect()
+        writer.write(
+            b"POST /e HTTP/1.1\r\nHost: t\r\nContent-Length: 2\r\n"
+            b"Expect: 100-continue\r\n\r\n"
+        )
+        await writer.drain()
+        interim = await asyncio.wait_for(reader.readuntil(b"\r\n\r\n"), timeout=5)
+        assert interim.startswith(b"HTTP/1.1 100")
+        writer.write(b"ok")
+        await writer.drain()
+        status, _, body = await _read_response(reader)
+        assert status == 200
+        assert json.loads(body)["body"] == "ok"
+
+
+@async_test
+async def test_head_has_length_but_no_body(server_cls):
+    async with serving(server_cls) as (srv, connect):
+        reader, writer = await connect()
+        writer.write(b"HEAD /h HTTP/1.1\r\nHost: t\r\nConnection: close\r\n\r\n")
+        await writer.drain()
+        data = await asyncio.wait_for(reader.read(), timeout=5)
+        head, _, rest = data.partition(b"\r\n\r\n")
+        assert b"HTTP/1.1 200" in head
+        assert b"Content-Length:" in head or b"content-length:" in head
+        assert rest == b""  # no body after the head
+
+
+@async_test
+async def test_streaming_response(server_cls):
+    async with serving(server_cls) as (srv, connect):
+        reader, writer = await connect()
+        writer.write(b"GET /stream HTTP/1.1\r\nHost: t\r\n\r\n")
+        await writer.drain()
+        status, headers, body = await _read_response(reader)
+        assert status == 200
+        assert headers.get("transfer-encoding") == "chunked"
+        assert body == b"alphabeta"
+
+
+@async_test
+async def test_stream_abort_truncates(server_cls):
+    """Mid-stream handler failure must NOT produce a well-terminated
+    chunked body — the client has to be able to detect truncation."""
+    async with serving(server_cls) as (srv, connect):
+        data = await _talk(connect, b"GET /boom-stream HTTP/1.1\r\nHost: t\r\n\r\n")
+        assert b"partial" in data
+        assert not data.endswith(b"0\r\n\r\n")
+
+
+@async_test
+async def test_unhandled_dispatch_error_returns_500(server_cls):
+    async with serving(server_cls) as (srv, connect):
+        data = await _talk(
+            connect, b"GET /boom HTTP/1.1\r\nHost: t\r\nConnection: close\r\n\r\n"
+        )
+        assert b"HTTP/1.1 500" in data
+        assert b"internal error" in data
+
+
+@pytest.mark.parametrize(
+    "raw,expect_status",
+    [
+        (b"BROKEN-LINE\r\n\r\n", b"400"),
+        (b"GET /x SPDY/3\r\n\r\n", b"505"),
+        (b"GET / HTTP/1.1\r\nBad-Header-Without-Colon\r\n\r\n", b"400"),
+        (b"POST / HTTP/1.1\r\nContent-Length: nope\r\n\r\n", b"400"),
+        (
+            b"POST / HTTP/1.1\r\nContent-Length: 999999999999\r\n\r\n",
+            b"413",
+        ),
+        (
+            b"POST / HTTP/1.1\r\nTransfer-Encoding: chunked\r\n\r\nzz\r\n",
+            b"400",
+        ),
+    ],
+)
+@async_test
+async def test_protocol_errors(server_cls, raw, expect_status):
+    async with serving(server_cls) as (srv, connect):
+        data = await _talk(connect, raw)
+        assert data.split(b" ")[1].startswith(expect_status), data[:100]
+
+
+@async_test
+async def test_header_cap_431(server_cls):
+    async with serving(server_cls) as (srv, connect):
+        big = b"GET / HTTP/1.1\r\nHost: t\r\nX-Fill: " + b"a" * (70 * 1024) + b"\r\n\r\n"
+        data = await _talk(connect, big)
+        assert b"431" in data.split(b"\r\n")[0]
+
+
+@async_test
+async def test_http10_closes_connection(server_cls):
+    async with serving(server_cls) as (srv, connect):
+        data = await _talk(connect, b"GET /x HTTP/1.0\r\nHost: t\r\n\r\n")
+        # read() returned because the server closed the connection
+        assert b"HTTP/1.1 200" in data
+
+
+# ---- codec unit tests ----------------------------------------------------
+
+
+@needs_codec
+def test_codec_parse_basic():
+    r = codec.parse(
+        b"PoSt /p%20q?a=1 HTTP/1.1\r\nHost: h\r\n"
+        b"Content-Length: 7\r\nX-Mixed-CASE:  v  \r\n\r\nrest"
+    )
+    end, method, target, minor, headers, clen, flags = r
+    assert method == "POST"  # method uppercased, server.py parity
+    assert target == "/p%20q?a=1"
+    assert minor == 1
+    assert headers["x-mixed-case"] == "v"
+    assert clen == 7
+    assert flags == 0
+
+
+@needs_codec
+def test_codec_parse_incomplete_and_offset():
+    assert codec.parse(b"GET / HTTP/1.1\r\nHost: h\r\n") is None
+    buf = b"JUNK" + b"GET /o HTTP/1.1\r\n\r\n"
+    end, method, target, *_ = codec.parse(buf, 4)
+    assert target == "/o"
+    assert end == len(buf)
+
+
+@needs_codec
+def test_codec_flags():
+    *_, flags = codec.parse(
+        b"POST / HTTP/1.1\r\nTransfer-Encoding: Chunked\r\n"
+        b"Connection: Close\r\nExpect: 100-Continue\r\n\r\n"
+    )
+    assert flags & codec.F_CHUNKED
+    assert flags & codec.F_CLOSE
+    assert flags & codec.F_EXPECT_CONTINUE
+    *_, kflags = codec.parse(b"GET / HTTP/1.0\r\nConnection: Keep-Alive\r\n\r\n")
+    assert kflags & codec.F_KEEPALIVE
+
+
+@needs_codec
+def test_codec_build_head_suppresses_duplicates():
+    out = codec.build_head(200, [("Content-Length", "5")], 99, 0, 0)
+    assert out.count(b"Content-Length") == 1
+    out = codec.build_head(200, [("Transfer-Encoding", "chunked")], -1, 0, 1)
+    assert out.count(b"Transfer-Encoding") == 1
+    out = codec.build_head(204, [], -1, 1, 0)
+    assert b"Connection: close" in out and b"204 No Content" in out
+
+
+@needs_codec
+def test_codec_python_parser_parity_fuzz():
+    """The codec and the pure-Python parser must accept/reject the same
+    inputs with the same parse results (differential fuzz, seeded)."""
+    import random
+
+    from gofr_tpu.http.server import HTTPProtocolError, _read_headers
+
+    rnd = random.Random(0xC0DEC)
+    methods = ["GET", "POST", "put", "DELETE", "OPTIONS"]
+    targets = ["/", "/a/b?x=1&y=2", "/%E2%82%AC", "/" + "p" * 100]
+    header_pool = [
+        ("Host", "example.com"),
+        ("X-Empty", ""),
+        ("Content-Length", "0"),
+        ("Connection", "close"),
+        ("Connection", "keep-alive"),
+        ("Accept", "a, b;q=0.5"),
+        ("X-Ws", "  padded  "),
+        ("X-Colons", "a:b:c"),
+    ]
+
+    async def py_parse(raw):
+        reader = asyncio.StreamReader()
+        reader.feed_data(raw)
+        reader.feed_eof()
+        return await _read_headers(reader)
+
+    loop = asyncio.new_event_loop()
+    try:
+        for _ in range(200):
+            method = rnd.choice(methods)
+            target = rnd.choice(targets)
+            hdrs = rnd.sample(header_pool, rnd.randint(0, 5))
+            raw = f"{method} {target} HTTP/1.1\r\n".encode()
+            for k, v in hdrs:
+                raw += f"{k}: {v}\r\n".encode()
+            raw += b"\r\n"
+
+            c = codec.parse(raw)
+            assert c is not None
+            end, cm, ct, minor, cheaders, clen, flags = c
+            pm, pt, pv, pheaders = loop.run_until_complete(py_parse(raw))
+            assert (cm, ct) == (pm, pt)
+            assert cheaders == pheaders
+            assert end == len(raw)
+    finally:
+        loop.close()
+
+
+@needs_codec
+def test_codec_chunked_roundtrip_fuzz():
+    import random
+
+    rnd = random.Random(7)
+    for _ in range(50):
+        parts = [
+            bytes(rnd.getrandbits(8) for _ in range(rnd.randint(1, 300)))
+            for _ in range(rnd.randint(0, 8))
+        ]
+        raw = b"".join(f"{len(p):x}\r\n".encode() + p + b"\r\n" for p in parts)
+        raw += b"0\r\n\r\n"
+        tail = b"NEXT"
+        got = codec.parse_chunked(raw + tail)
+        assert got is not None
+        body, end = got
+        assert body == b"".join(parts)
+        assert end == len(raw)
+        # every strict prefix is incomplete, never an error
+        for cut in sorted(rnd.sample(range(len(raw)), min(10, len(raw)))):
+            pre = codec.parse_chunked(raw[:cut])
+            if pre is not None:
+                body_pre, end_pre = pre
+                assert end_pre <= cut
