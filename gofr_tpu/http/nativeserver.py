@@ -30,6 +30,7 @@ from .responder import Response
 from .server import _status_line  # shared status-reason table (server.py)
 
 MAX_HEADER_BYTES = 64 * 1024
+MAX_BODY_BYTES = 100 * 1024 * 1024  # server.py parity
 READ_HEADER_TIMEOUT = 5.0  # httpServer.go:37
 KEEPALIVE_IDLE_TIMEOUT = 75.0
 # receive-side high-water mark: while a request is processing, a client
@@ -41,13 +42,29 @@ RECV_HIGH_WATER = 256 * 1024
 _ERR_HEAD = b"Content-Type: application/json\r\nConnection: close\r\n"
 
 
+def _py_serialize(resp: Response, body: bytes, close: bool) -> bytes:
+    """Tolerant fallback serializer with server.py's f-string semantics,
+    used when the strict C serializer rejects exotic header values."""
+    head = [_status_line(resp.status)]
+    seen = set()
+    for k, v in resp.headers:
+        seen.add(str(k).lower())
+        head.append(f"{k}: {v}\r\n".encode("latin-1"))
+    if close:
+        head.append(b"Connection: close\r\n")
+    if "content-length" not in seen:
+        head.append(f"Content-Length: {len(resp.body)}\r\n".encode())
+    head.append(b"\r\n")
+    return b"".join(head) + body
+
+
 class _HTTPProtocol(asyncio.Protocol):
     """One connection: buffer -> native parse -> dispatch -> native head."""
 
     __slots__ = (
         "server", "codec", "transport", "buf", "head", "remote",
         "processing", "closed", "timer", "paused_reading", "can_write",
-        "_loop",
+        "chunk_parts", "chunk_len", "_loop",
     )
 
     def __init__(self, server: "NativeHTTPServer"):
@@ -60,6 +77,8 @@ class _HTTPProtocol(asyncio.Protocol):
         self.processing = False
         self.closed = False
         self.paused_reading = False
+        self.chunk_parts: list[bytes] | None = None  # incremental chunked body
+        self.chunk_len = 0
         self.timer: asyncio.TimerHandle | None = None
         self.can_write: asyncio.Event | None = None  # created lazily (streams)
         self._loop = server._loop
@@ -130,14 +149,30 @@ class _HTTPProtocol(asyncio.Protocol):
                 if self.timer is not None:
                     self.timer.cancel()
                     self.timer = None
+                if parsed[6] & self.codec.F_CHUNKED:
+                    self.chunk_parts = []
+                    self.chunk_len = 0
                 if parsed[6] & self.codec.F_EXPECT_CONTINUE:
                     self.transport.write(b"HTTP/1.1 100 Continue\r\n\r\n")
             end, method, target, minor, headers, clen, flags = self.head
             if flags & self.codec.F_CHUNKED:
-                done = self.codec.parse_chunked(self.buf, end)
-                if done is None:
+                # incremental: consume complete chunks NOW and drop their
+                # encoded bytes from the buffer, so a large upload arriving
+                # in many segments is parsed once (O(n)), not re-scanned
+                # from scratch per data_received
+                data, new_off, done = self.codec.parse_chunked_step(self.buf, end)
+                if data:
+                    self.chunk_parts.append(data)
+                    self.chunk_len += len(data)
+                    if self.chunk_len > MAX_BODY_BYTES:
+                        raise ValueError(413, "body too large")
+                if new_off > end:
+                    del self.buf[end:new_off]
+                if not done:
                     return
-                body, consumed = done
+                body = b"".join(self.chunk_parts)
+                self.chunk_parts = None
+                consumed = end
             elif clen > 0:
                 if len(self.buf) - end < clen:
                     return
@@ -191,27 +226,41 @@ class _HTTPProtocol(asyncio.Protocol):
                 )
             if self.closed or self.transport is None:
                 return
-            if resp.stream is not None and method != "HEAD":
-                ok = await self._write_stream(resp, close)
-                if not ok:
-                    return
-            else:
-                body = b"" if method == "HEAD" else resp.body
-                # HEAD advertises the real entity length (server.py parity)
-                self.transport.write(
-                    self.codec.build_head(
-                        resp.status, resp.headers, len(resp.body),
-                        1 if close else 0, 0,
-                        body if body else None,
-                    )
-                )
-                # drain: a pipelining client that reads slowly must not
-                # grow the transport buffer unbounded (server.py awaits
-                # writer.drain() after every response)
-                if self.can_write is not None and not self.can_write.is_set():
-                    await self.can_write.wait()
-                    if self.closed:
+            try:
+                if resp.stream is not None and method != "HEAD":
+                    ok = await self._write_stream(resp, close)
+                    if not ok:
                         return
+                else:
+                    body = b"" if method == "HEAD" else resp.body
+                    try:
+                        # HEAD advertises the real entity length (server.py
+                        # parity)
+                        out = self.codec.build_head(
+                            resp.status, resp.headers, len(resp.body),
+                            1 if close else 0, 0,
+                            body if body else None,
+                        )
+                    except Exception:
+                        # the C serializer is strict (2-tuples of str); the
+                        # streams server stringifies anything — match it so
+                        # the same handler works under either server
+                        out = _py_serialize(resp, body, close)
+                    self.transport.write(out)
+                    # drain: a pipelining client that reads slowly must not
+                    # grow the transport buffer unbounded (server.py awaits
+                    # writer.drain() after every response)
+                    if self.can_write is not None and not self.can_write.is_set():
+                        await self.can_write.wait()
+                        if self.closed:
+                            return
+            except Exception as e:  # noqa: BLE001 - never leave a hung conn
+                if self.server.logger:
+                    self.server.logger.error(f"response write failed: {e!r}")
+                if self.transport is not None:
+                    self.transport.abort()
+                self.closed = True
+                return
             if close:
                 self.transport.close()
                 self.closed = True

@@ -52,7 +52,9 @@ def _build(src: str, modname: str) -> str | None:
             pass
     include = sysconfig.get_paths()["include"]
     os.makedirs(_BUILD_DIR, exist_ok=True)
-    tmp_out = out_path + ".tmp"
+    # per-process tmp name: two processes building concurrently must not
+    # interleave writes and os.replace a half-written .so into the cache
+    tmp_out = out_path + f".tmp.{os.getpid()}"
     cmd = [
         "g++", "-O2", "-std=c++17", "-shared", "-fPIC",
         "-fvisibility=hidden", f"-I{include}", src_path, "-o", tmp_out,

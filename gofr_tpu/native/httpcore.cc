@@ -264,6 +264,10 @@ PyObject *parse_chunked(PyObject *, PyObject *args) {
     if (badsize || !any) {
       if (spans != static_spans) PyMem_Free(spans);
       PyBuffer_Release(&view);
+      // valid hex that merely exceeds the cap is an oversized body (413,
+      // server.py parity); non-hex garbage is a framing error (400)
+      if (badsize && any && size > MAX_BODY)
+        return http_error(413, "body too large");
       return http_error(400, "bad chunk size");
     }
     p = (nl - buf) + 2;
@@ -327,6 +331,117 @@ PyObject *parse_chunked(PyObject *, PyObject *args) {
   if (spans != static_spans) PyMem_Free(spans);
   PyBuffer_Release(&view);
   if (!result && !PyErr_Occurred()) Py_RETURN_NONE;
+  return result;
+}
+
+// parse_chunked_step(buffer, offset) -> (data bytes, new_offset, done)
+//
+// Incremental sibling of parse_chunked: consumes every COMPLETE chunk
+// available from `offset` and returns their concatenated payload plus the
+// resume offset. done=1 once the terminating 0-chunk AND its trailers are
+// fully present (new_offset then points past the body). The protocol
+// server keeps (offset, collected parts) across data_received calls so a
+// large chunked upload is parsed once, not re-scanned per TCP segment
+// (O(n) total instead of O(n^2)).
+PyObject *parse_chunked_step(PyObject *, PyObject *args) {
+  Py_buffer view;
+  Py_ssize_t offset = 0;
+  if (!PyArg_ParseTuple(args, "y*|n", &view, &offset)) return nullptr;
+  const char *buf = static_cast<const char *>(view.buf);
+  const Py_ssize_t len = view.len;
+
+  Py_ssize_t p = offset;
+  Py_ssize_t total = 0;
+  int done = 0;
+  Py_ssize_t static_spans[64][2];
+  Py_ssize_t (*spans)[2] = static_spans;
+  Py_ssize_t nspans = 0, cap_spans = 64;
+
+  for (;;) {
+    const Py_ssize_t chunk_start = p;
+    const char *nl = static_cast<const char *>(
+        memmem(buf + p, static_cast<size_t>(len - p), "\r\n", 2));
+    if (!nl) break;  // size line incomplete -> resume at chunk_start
+    Py_ssize_t q = p;
+    Py_ssize_t size = 0;
+    bool any = false, badsize = false;
+    for (; buf + q < nl; ++q) {
+      char c = buf[q];
+      int d;
+      if (c >= '0' && c <= '9') d = c - '0';
+      else if (c >= 'a' && c <= 'f') d = c - 'a' + 10;
+      else if (c >= 'A' && c <= 'F') d = c - 'A' + 10;
+      else if (c == ';') break;
+      else { badsize = true; break; }
+      size = size * 16 + d;
+      any = true;
+      if (size > MAX_BODY) { badsize = true; break; }
+    }
+    if (badsize || !any) {
+      if (spans != static_spans) PyMem_Free(spans);
+      PyBuffer_Release(&view);
+      if (badsize && any && size > MAX_BODY)
+        return http_error(413, "body too large");
+      return http_error(400, "bad chunk size");
+    }
+    p = (nl - buf) + 2;
+    if (size == 0) {
+      // trailers must be fully present to finish; else resume at the
+      // 0-chunk so the next call re-examines it with more data
+      bool trailers_done = false;
+      Py_ssize_t tp = p;
+      for (;;) {
+        const char *t = static_cast<const char *>(
+            memmem(buf + tp, static_cast<size_t>(len - tp), "\r\n", 2));
+        if (!t) break;
+        Py_ssize_t tl = t - (buf + tp);
+        tp = (t - buf) + 2;
+        if (tl == 0) { trailers_done = true; break; }
+      }
+      if (trailers_done) { p = tp; done = 1; }
+      else p = chunk_start;
+      break;
+    }
+    if (p + size + 2 > len) { p = chunk_start; break; }  // data incomplete
+    if (nspans == cap_spans) {
+      Py_ssize_t newcap = cap_spans * 2;
+      Py_ssize_t (*ns)[2] = static_cast<Py_ssize_t (*)[2]>(
+          PyMem_Malloc(sizeof(Py_ssize_t) * 2 * newcap));
+      if (!ns) {
+        if (spans != static_spans) PyMem_Free(spans);
+        PyBuffer_Release(&view);
+        return PyErr_NoMemory();
+      }
+      memcpy(ns, spans, sizeof(Py_ssize_t) * 2 * nspans);
+      if (spans != static_spans) PyMem_Free(spans);
+      spans = ns;
+      cap_spans = newcap;
+    }
+    spans[nspans][0] = p;
+    spans[nspans][1] = size;
+    ++nspans;
+    total += size;
+    p += size;
+    if (buf[p] != '\r' || buf[p + 1] != '\n') {
+      if (spans != static_spans) PyMem_Free(spans);
+      PyBuffer_Release(&view);
+      return http_error(400, "bad chunk framing");
+    }
+    p += 2;
+  }
+
+  PyObject *data = PyBytes_FromStringAndSize(nullptr, total);
+  PyObject *result = nullptr;
+  if (data) {
+    char *dst = PyBytes_AS_STRING(data);
+    for (Py_ssize_t i = 0; i < nspans; ++i) {
+      memcpy(dst, buf + spans[i][0], static_cast<size_t>(spans[i][1]));
+      dst += spans[i][1];
+    }
+    result = Py_BuildValue("(Nni)", data, p, done);
+  }
+  if (spans != static_spans) PyMem_Free(spans);
+  PyBuffer_Release(&view);
   return result;
 }
 
@@ -442,6 +557,8 @@ PyMethodDef methods[] = {
      "content_length, flags)"},
     {"parse_chunked", parse_chunked, METH_VARARGS,
      "parse_chunked(buf, offset=0) -> None | (body, end)"},
+    {"parse_chunked_step", parse_chunked_step, METH_VARARGS,
+     "parse_chunked_step(buf, offset=0) -> (data, new_offset, done)"},
     {"build_head", build_head, METH_VARARGS,
      "build_head(status, headers, content_length, close, chunked, body=None) "
      "-> bytes"},

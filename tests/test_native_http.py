@@ -412,3 +412,114 @@ def test_codec_chunked_roundtrip_fuzz():
             if pre is not None:
                 body_pre, end_pre = pre
                 assert end_pre <= cut
+
+
+@pytest.mark.parametrize(
+    "raw",
+    [  # oversized bodies must be 413 on BOTH servers (not 400): a single
+       # huge chunk and an over-cap content-length
+        b"POST / HTTP/1.1\r\nTransfer-Encoding: chunked\r\n\r\n10000000\r\n",
+        b"POST / HTTP/1.1\r\nContent-Length: 268435456\r\n\r\n",
+    ],
+)
+@async_test
+async def test_oversized_body_is_413(server_cls, raw):
+    async with serving(server_cls) as (srv, connect):
+        data = await _talk(connect, raw)
+        assert data.split(b" ")[1] == b"413", data[:80]
+
+
+@async_test
+async def test_exotic_header_types_match_python_server(server_cls):
+    """A handler returning list-headers / non-str values must serve
+    identically under both servers (the streams server stringifies;
+    the native server falls back to the tolerant serializer)."""
+
+    async def dispatch(req):
+        return Response(200, [["X-List", 7]], b"ok")  # type: ignore[list-item]
+
+    srv = server_cls(dispatch, port=0, host="127.0.0.1")
+    await srv.start()
+    try:
+        reader, writer = await asyncio.open_connection("127.0.0.1", srv.port)
+        writer.write(b"GET / HTTP/1.1\r\nHost: t\r\nConnection: close\r\n\r\n")
+        await writer.drain()
+        data = await asyncio.wait_for(reader.read(), timeout=5)
+        assert b"200" in data.split(b"\r\n")[0]
+        assert b"X-List: 7" in data
+        assert data.endswith(b"ok")
+        writer.transport.abort()
+    finally:
+        await asyncio.wait_for(srv.shutdown(), timeout=10)
+
+
+@async_test
+async def test_large_chunked_upload_incremental(server_cls):
+    """1 MB chunked body split into many small writes — exercises the
+    native server's incremental chunked consumption (O(n), buffer
+    trimmed as chunks complete)."""
+    payload = bytes(range(256)) * 4096  # 1 MiB
+    chunks = [payload[i : i + 8192] for i in range(0, len(payload), 8192)]
+    wire = b"".join(f"{len(c):x}\r\n".encode() + c + b"\r\n" for c in chunks)
+    wire += b"0\r\n\r\n"
+
+    async with serving(server_cls) as (srv, connect):
+        reader, writer = await connect()
+        writer.write(
+            b"POST /big HTTP/1.1\r\nHost: t\r\nTransfer-Encoding: chunked\r\n\r\n"
+        )
+        for i in range(0, len(wire), 16384):
+            writer.write(wire[i : i + 16384])
+            await writer.drain()
+        status, _, body = await _read_response(reader)
+        assert status == 200
+        got = json.loads(body)
+        assert len(got["body"]) == len(payload)
+        assert got["body"] == payload.decode("latin-1")
+
+
+@needs_codec
+def test_codec_parse_chunked_step_incremental():
+    parts = [b"abc", b"defgh", b"Z" * 100]
+    wire = b"".join(f"{len(p):x}\r\n".encode() + p + b"\r\n" for p in parts)
+    wire += b"0\r\nT: v\r\n\r\n"
+    # feed byte by byte, collecting via the step API exactly as the server
+    # does: parse from a fixed offset, delete consumed, repeat
+    buf = bytearray()
+    out = []
+    done = 0
+    for i in range(len(wire)):
+        buf.append(wire[i])
+        data, new_off, done = codec.parse_chunked_step(buf, 0)
+        if data:
+            out.append(data)
+        del buf[:new_off]
+        if done:
+            assert i == len(wire) - 1  # completes exactly at the last byte
+    assert done == 1
+    assert b"".join(out) == b"".join(parts)
+    assert bytes(buf) == b""
+
+
+@needs_codec
+def test_codec_parse_chunked_step_matches_oneshot():
+    import random
+
+    rnd = random.Random(11)
+    for _ in range(30):
+        parts = [
+            bytes(rnd.getrandbits(8) for _ in range(rnd.randint(1, 200)))
+            for _ in range(rnd.randint(1, 6))
+        ]
+        wire = b"".join(f"{len(p):x}\r\n".encode() + p + b"\r\n" for p in parts)
+        wire += b"0\r\n\r\n"
+        body_ref, end_ref = codec.parse_chunked(wire)
+        collected = []
+        off = 0
+        done = 0
+        while not done:
+            data, off, done = codec.parse_chunked_step(wire, off)
+            if data:
+                collected.append(data)
+        assert b"".join(collected) == body_ref
+        assert off == end_ref
