@@ -60,16 +60,36 @@ def _raw_probes(eng, cfg, args, S: int, B: int) -> dict:
         eng.params, jnp.zeros((B,), jnp.int32), cache, eng._active, eng._temps, rng
     )
     _ = np.asarray(last)  # compile + sync
-    totals = {}
+    # 3 trials per run length, delta of the MIN-ENVELOPES: each min
+    # approximates a stall-free run, so a transient slowdown in either
+    # window is discarded instead of biasing the delta (min over paired
+    # deltas would preferentially select trials whose SHORT window caught
+    # a stall, inflating the ceiling; observed engine_vs_ceiling 1.17 the
+    # other way from a single-shot probe)
+    times = {}
     for n in (2, 8):
-        t0 = time.perf_counter()
-        for _i in range(n):
-            toks, last, cache, rng = eng._chunk_ops[K](
-                eng.params, last, cache, eng._active, eng._temps, rng
-            )
-        _ = np.asarray(last)
-        totals[n] = time.perf_counter() - t0
-    raw_step_s = (totals[8] - totals[2]) / 6 / K
+        ts = []
+        for _t in range(3):
+            t0 = time.perf_counter()
+            for _i in range(n):
+                toks, last, cache, rng = eng._chunk_ops[K](
+                    eng.params, last, cache, eng._active, eng._temps, rng
+                )
+            _ = np.asarray(last)
+            ts.append(time.perf_counter() - t0)
+        times[n] = ts
+    # a stall can still make an envelope delta non-positive; clamp to a
+    # floor of 10% of the per-chunk short-window cost so downstream
+    # ratios stay finite and visibly wrong rather than negative
+    floor = min(times[2]) / 2 / K * 0.1
+    # PEAK capability: min-envelope delta (stall windows discarded) —
+    # matches the chip's fast windows and is stable across sessions.
+    raw_step_s = max((min(times[8]) - min(times[2])) / 6 / K, floor)
+    # SUSTAINED estimate: mean-envelope delta over the spaced trials —
+    # includes the throttled/stalled windows a long-running engine
+    # actually lives through, so it is the fair ceiling denominator.
+    raw_step_sust_s = max(
+        (sum(times[8]) - sum(times[2])) / 3 / 6 / K, raw_step_s)
     raw_tok_s = B / raw_step_s
     params_bytes = sum(x.size * x.dtype.itemsize for x in jax.tree.leaves(eng.params))
     # decode streams all weights + the live KV prefix + chunk buffers
@@ -82,14 +102,20 @@ def _raw_probes(eng, cfg, args, S: int, B: int) -> dict:
     pack = jnp.zeros((nb, S + 2), jnp.int32).at[:, -2].set(S)
     first, pc, _ = eng._prefill_op(eng.params, pack, rng)
     _ = np.asarray(first)
-    ptotals = {}
+    ptimes = {}
     for n in (1, 5):
-        t0 = time.perf_counter()
-        for _i in range(n):
-            first, pc, _ = eng._prefill_op(eng.params, pack, rng)
-        _ = np.asarray(first)
-        ptotals[n] = time.perf_counter() - t0
-    prefill_s = (ptotals[5] - ptotals[1]) / 4
+        ts = []
+        for _t in range(3):
+            t0 = time.perf_counter()
+            for _i in range(n):
+                first, pc, _ = eng._prefill_op(eng.params, pack, rng)
+            _ = np.asarray(first)
+            ts.append(time.perf_counter() - t0)
+        ptimes[n] = ts
+    pfloor = min(ptimes[1]) * 0.1
+    prefill_s = max((min(ptimes[5]) - min(ptimes[1])) / 4, pfloor)
+    prefill_sust_s = max(
+        (sum(ptimes[5]) - sum(ptimes[1])) / 3 / 4, prefill_s)
     # FLOP count from the architecture (weights may be int8 QTensors)
     embed_params = cfg.vocab_size * cfg.d_model
     layer_params = (
@@ -101,9 +127,11 @@ def _raw_probes(eng, cfg, args, S: int, B: int) -> dict:
     mfu = prefill_flops / prefill_s / V5E_PEAK_BF16
     return {
         "decode_step_ms": round(raw_step_s * 1e3, 3),
+        "decode_step_sustained_ms": round(raw_step_sust_s * 1e3, 3),
         "raw_decode_tok_s": round(raw_tok_s, 0),
         "decode_hbm_bw_pct": round(bw_util * 100, 1),
         f"prefill_ms_b{nb}": round(prefill_s * 1e3, 1),
+        f"prefill_sustained_ms_b{nb}": round(prefill_sust_s * 1e3, 1),
         # % of the 197 TF/s bf16 NOMINAL figure; the prefill path runs
         # int8 (W8A8) where the MXU's nominal is 2x, so >100 is expected —
         # this is a utilization index, not an MFU claim (VERDICT r3 weak #6)
@@ -365,18 +393,34 @@ def bench_serving(args) -> dict:
 
     # serial device roofline for THIS workload: every request costs one
     # share of an admission prefill wave plus new_tokens decode-step
-    # shares; prefill and decode serialize on one chip.
-    per_req_s = (
-        raw[f"prefill_ms_b{eng.admit_cap}"] / eng.admit_cap
-        + raw["decode_step_ms"] * args.new_tokens / args.batch
-    ) / 1e3
-    ceiling_qps = 1.0 / per_req_s
+    # shares; prefill and decode serialize on one chip. PEAK uses the
+    # min-envelope probes (the chip's fast windows); the engine-vs-ceiling
+    # ratio uses the SUSTAINED probes, because a long engine run lives
+    # through the same throttled/stalled windows the sustained estimate
+    # includes — dividing a sustained engine rate by a peak ceiling
+    # conflates engine efficiency with chip-window luck (observed 0.70 and
+    # 1.17 for the same code across sessions with single-shot probes).
+    def _ceiling(prefill_ms, decode_ms):
+        per_req_s = (
+            prefill_ms / eng.admit_cap + decode_ms * args.new_tokens / args.batch
+        ) / 1e3
+        return 1.0 / per_req_s
+
+    ceiling_qps = _ceiling(
+        raw[f"prefill_ms_b{eng.admit_cap}"], raw["decode_step_ms"]
+    )
+    ceiling_sust_qps = _ceiling(
+        raw[f"prefill_sustained_ms_b{eng.admit_cap}"],
+        raw["decode_step_sustained_ms"],
+    )
 
     detail = {
         **head,
         "engine_tok_s": round(eng_tok_s, 0),
         "device_ceiling_qps": round(ceiling_qps, 0),
-        "engine_vs_ceiling": round(qps / ceiling_qps, 3),
+        "device_ceiling_sustained_qps": round(ceiling_sust_qps, 0),
+        "engine_vs_ceiling": round(qps / ceiling_sust_qps, 3),
+        "engine_vs_peak_ceiling": round(qps / ceiling_qps, 3),
         "engine_vs_raw": round(eng_tok_s / raw["raw_decode_tok_s"], 3),
         **raw,
         "latency_vs_load": lvl,
