@@ -16,9 +16,19 @@ from .sharding import (
     shard_params,
     with_shardings,
 )
+from .pipeline import (
+    make_pp_train_step,
+    pipeline_layers,
+    pp_lm_loss,
+    pp_param_shardings,
+)
 from .train import lm_loss, make_train_step, place_batch
 
 __all__ = [
+    "pipeline_layers",
+    "pp_lm_loss",
+    "pp_param_shardings",
+    "make_pp_train_step",
     "make_mesh",
     "mesh_shape_for",
     "param_specs",

@@ -224,3 +224,92 @@ class TestDPServing:
             assert rt.llm("tiny") is eng
         finally:
             rt.close()
+
+
+class TestPipelineParallel:
+    """GPipe-style depth sharding (parallel/pipeline.py): the layer stack
+    split over a `stage` mesh axis, microbatches streamed via ppermute.
+    SURVEY.md §2.8's one stretch row."""
+
+    def _setup(self, n_stages=4, n_layers=4, n_micro=4, b=8, s=16):
+        import dataclasses
+
+        import numpy as np
+
+        from jax.sharding import Mesh
+
+        from gofr_tpu.parallel import (
+            make_pp_train_step,
+            pipeline_layers,
+            pp_lm_loss,
+        )
+
+        cfg = dataclasses.replace(TransformerConfig.tiny(), n_layers=n_layers)
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        mesh = Mesh(
+            np.array(jax.devices()[:n_stages]).reshape(n_stages), ("stage",)
+        )
+        rng = np.random.default_rng(0)
+        tokens = jnp.asarray(rng.integers(0, cfg.vocab_size, (b, s)), jnp.int32)
+        mask = jnp.ones((b, s), bool)
+        shard_fn, init_opt, step_fn = make_pp_train_step(
+            cfg, mesh, n_micro=n_micro
+        )
+        pp_fn = pipeline_layers(cfg, mesh)
+        return cfg, params, mesh, tokens, mask, shard_fn, init_opt, step_fn, pp_fn, pp_lm_loss
+
+    def test_loss_matches_single_device(self):
+        (cfg, params, mesh, tokens, mask,
+         shard_fn, _io, _st, pp_fn, pp_loss) = self._setup()
+        ref = lm_loss(params, cfg, tokens, mask)
+        got = pp_loss(shard_fn(params), cfg, tokens, mask, pp_fn, 4)
+        assert abs(float(ref) - float(got)) < 1e-5
+
+    def test_grads_match_single_device(self):
+        (cfg, params, mesh, tokens, mask,
+         shard_fn, _io, _st, pp_fn, pp_loss) = self._setup()
+        g_ref = jax.grad(lm_loss)(params, cfg, tokens, mask)
+        g_pp = jax.grad(pp_loss)(shard_fn(params), cfg, tokens, mask, pp_fn, 4)
+        err = max(
+            jax.tree.leaves(
+                jax.tree.map(
+                    lambda a, b: float(jnp.max(jnp.abs(a - b))), g_ref, g_pp
+                )
+            )
+        )
+        assert err < 1e-5, f"max grad err {err}"
+
+    def test_train_step_decreases_loss(self):
+        (cfg, params, mesh, tokens, mask,
+         shard_fn, init_opt, step_fn, _pp, _pl) = self._setup()
+        p = shard_fn(params)
+        o = init_opt(p)
+        losses = []
+        for _ in range(4):
+            p, o, loss = step_fn(p, o, tokens, mask)
+            losses.append(float(loss))
+        assert losses[-1] < losses[0]
+
+    def test_eight_stages(self):
+        """One layer per stage across the whole 8-device mesh."""
+        (cfg, params, mesh, tokens, mask,
+         shard_fn, _io, _st, pp_fn, pp_loss) = self._setup(
+            n_stages=8, n_layers=8, n_micro=2, b=4
+        )
+        ref = lm_loss(params, cfg, tokens, mask)
+        got = pp_loss(shard_fn(params), cfg, tokens, mask, pp_fn, 2)
+        assert abs(float(ref) - float(got)) < 1e-5
+
+    def test_indivisible_layers_raise(self):
+        import dataclasses
+
+        import numpy as np
+
+        from jax.sharding import Mesh
+
+        from gofr_tpu.parallel import make_pp_train_step
+
+        cfg = dataclasses.replace(TransformerConfig.tiny(), n_layers=3)
+        mesh = Mesh(np.array(jax.devices()[:4]).reshape(4), ("stage",))
+        with pytest.raises(ValueError):
+            make_pp_train_step(cfg, mesh, n_micro=2)
