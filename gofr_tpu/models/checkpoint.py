@@ -30,7 +30,9 @@ import numpy as np
 __all__ = [
     "load_safetensors_dir",
     "gemma_params_from_hf",
+    "llama_params_from_hf",
     "load_gemma_checkpoint",
+    "load_llama_checkpoint",
     "save_orbax",
     "load_orbax",
 ]
@@ -74,6 +76,27 @@ def gemma_params_from_hf(tensors: dict[str, np.ndarray], cfg) -> dict:
     """Map an HF-layout Gemma checkpoint (model.layers.N.* naming) onto the
     framework pytree. Works for any TransformerConfig whose dims match the
     checkpoint (gemma_2b / gemma_7b / tiny test checkpoints)."""
+    return _params_from_hf(tensors, cfg, norm_offset=0.0, allow_untied=False)
+
+
+def llama_params_from_hf(tensors: dict[str, np.ndarray], cfg) -> dict:
+    """Map an HF-layout Llama checkpoint onto the framework pytree.
+
+    Two deltas vs Gemma, both absorbed at load time so the model code is
+    shared: (1) Llama's RMSNorm applies `x * w` while the kernel computes
+    `x * (1 + scale)` — store w - 1, which is exact; (2) an untied
+    `lm_head.weight` becomes an `unembed` leaf in embed's [vocab, d]
+    layout (absent = tied, as in Llama-3.2-1B/3B). HF rope (rotate_half)
+    matches ops/rope.py's split-halves convention, so projections load
+    unpermuted. Use with TransformerConfig.llama3_8b()-style configs
+    (act="silu", scale_embed=False)."""
+    return _params_from_hf(tensors, cfg, norm_offset=-1.0, allow_untied=True)
+
+
+def _params_from_hf(
+    tensors: dict[str, np.ndarray], cfg, norm_offset: float,
+    allow_untied: bool = False,
+) -> dict:
     import jax.numpy as jnp
 
     d, hd, hq, hkv, L = cfg.d_model, cfg.head_dim, cfg.n_heads, cfg.n_kv_heads, cfg.n_layers
@@ -96,16 +119,37 @@ def gemma_params_from_hf(tensors: dict[str, np.ndarray], cfg) -> dict:
         w_gate.append(t(_get(tensors, p + "mlp.gate_proj.weight")))  # [d, ff]
         w_up.append(t(_get(tensors, p + "mlp.up_proj.weight")))
         w_down.append(t(_get(tensors, p + "mlp.down_proj.weight")))  # [ff, d]
-        attn_n.append(np.asarray(_get(tensors, p + "input_layernorm.weight")))
-        mlp_n.append(np.asarray(_get(tensors, p + "post_attention_layernorm.weight")))
+        attn_n.append(
+            np.asarray(_get(tensors, p + "input_layernorm.weight"), np.float32)
+            + norm_offset
+        )
+        mlp_n.append(
+            np.asarray(
+                _get(tensors, p + "post_attention_layernorm.weight"), np.float32
+            )
+            + norm_offset
+        )
 
     embed = np.asarray(_get(tensors, "model.embed_tokens.weight"))
-    final_norm = np.asarray(_get(tensors, "model.norm.weight"))
+    final_norm = (
+        np.asarray(_get(tensors, "model.norm.weight"), np.float32) + norm_offset
+    )
 
     def stack(xs):
         return jnp.asarray(np.stack(xs), dt)
 
+    out_extra = {}
+    if allow_untied and "lm_head.weight" in tensors:
+        # Untied head, embed layout [vocab, d]. torch state_dicts of TIED
+        # models still materialize lm_head.weight (an alias of the
+        # embedding) — a value-equal head would only duplicate the vocab
+        # table in HBM, so keep the tied path for it.
+        head = tensors["lm_head.weight"]
+        if head.shape != embed.shape or not np.array_equal(head, embed):
+            out_extra["unembed"] = jnp.asarray(head, dt)
+
     return {
+        **out_extra,
         "embed": jnp.asarray(embed, dt),
         "final_norm": jnp.asarray(final_norm, dt),
         "layers": {
@@ -121,15 +165,26 @@ def gemma_params_from_hf(tensors: dict[str, np.ndarray], cfg) -> dict:
     }
 
 
+def _is_orbax_dir(path: str) -> bool:
+    return os.path.isdir(path) and (
+        os.path.exists(os.path.join(path, "_CHECKPOINT_METADATA"))
+        or os.path.exists(os.path.join(path, "_METADATA"))
+    )
+
+
 def load_gemma_checkpoint(path: str, cfg) -> dict:
     """Checkpoint dir/file → params pytree. Accepts an HF safetensors
     checkpoint or an orbax directory (detected by its checkpoint metadata)."""
-    if os.path.isdir(path) and (
-        os.path.exists(os.path.join(path, "_CHECKPOINT_METADATA"))
-        or os.path.exists(os.path.join(path, "_METADATA"))
-    ):
+    if _is_orbax_dir(path):
         return load_orbax(path)
     return gemma_params_from_hf(load_safetensors_dir(path), cfg)
+
+
+def load_llama_checkpoint(path: str, cfg) -> dict:
+    """Llama analogue of load_gemma_checkpoint."""
+    if _is_orbax_dir(path):
+        return load_orbax(path)
+    return llama_params_from_hf(load_safetensors_dir(path), cfg)
 
 
 def save_orbax(params: Any, path: str) -> None:

@@ -113,11 +113,14 @@ def quantize_params(params: dict, dtype=jnp.bfloat16) -> dict:
         k: (quantize(v, dtype) if k in _QUANT_KEYS else v)
         for k, v in params["layers"].items()
     }
-    return {
+    out = {
         "embed": quantize(params["embed"], dtype),
         "final_norm": params["final_norm"],
         "layers": layers,
     }
+    if "unembed" in params:  # untied lm_head (Llama): same [vocab, d] layout
+        out["unembed"] = quantize(params["unembed"], dtype)
+    return out
 
 
 def init_params_quantized(rng, cfg, dtype=jnp.bfloat16) -> dict:
@@ -177,8 +180,11 @@ def quantize_param_specs(specs: dict) -> dict:
     layers = {
         k: (qspec(v) if k in _QUANT_KEYS else v) for k, v in specs["layers"].items()
     }
-    return {
+    out = {
         "embed": qspec(specs["embed"]),
         "final_norm": specs["final_norm"],
         "layers": layers,
     }
+    if "unembed" in specs:
+        out["unembed"] = qspec(specs["unembed"])
+    return out

@@ -52,6 +52,8 @@ class TransformerConfig:
     norm_eps: float = 1e-6
     attn_logit_cap: float = 0.0  # gemma-2 style soft-capping; 0 disables
     final_logit_cap: float = 0.0
+    act: str = "gelu"  # MLP gate activation: "gelu" (Gemma) | "silu" (Llama)
+    scale_embed: bool = True  # multiply embeddings by sqrt(d_model) (Gemma)
     dtype: Any = jnp.bfloat16
 
     # ---- presets -------------------------------------------------------
@@ -63,6 +65,27 @@ class TransformerConfig:
     def gemma_7b() -> "TransformerConfig":
         return TransformerConfig(
             d_model=3072, n_layers=28, n_heads=16, n_kv_heads=16, d_ff=24_576
+        )
+
+    @staticmethod
+    def llama3_8b() -> "TransformerConfig":
+        """Llama-3-8B: SwiGLU MLP, GQA 32/8, untied lm_head (the loader
+        adds an `unembed` leaf), plain RMSNorm (the loader stores HF's
+        scale minus 1 so the shared (1+scale) kernel is exact), no
+        embedding scaling. rope theta 500k."""
+        return TransformerConfig(
+            vocab_size=128_256, d_model=4096, n_layers=32, n_heads=32,
+            n_kv_heads=8, head_dim=128, d_ff=14_336, rope_theta=500_000.0,
+            norm_eps=1e-5, act="silu", scale_embed=False,
+        )
+
+    @staticmethod
+    def tiny_llama(vocab_size: int = 512) -> "TransformerConfig":
+        """CI-sized Llama-style config (silu, no embed scale)."""
+        return TransformerConfig(
+            vocab_size=vocab_size, d_model=64, n_layers=2, n_heads=4,
+            n_kv_heads=2, head_dim=16, d_ff=128, rope_theta=500_000.0,
+            norm_eps=1e-5, act="silu", scale_embed=False, dtype=jnp.float32,
         )
 
     @staticmethod
@@ -125,6 +148,18 @@ def init_params(rng: jax.Array, cfg: TransformerConfig) -> dict:
     }
 
 
+_ACTIVATIONS = {"gelu": jax.nn.gelu, "silu": jax.nn.silu}
+
+
+def _act_fn(cfg: TransformerConfig):
+    try:
+        return _ACTIVATIONS[cfg.act]
+    except KeyError:
+        raise ValueError(
+            f"unknown activation {cfg.act!r}; expected one of {sorted(_ACTIVATIONS)}"
+        ) from None
+
+
 def _layer_body(
     cfg: TransformerConfig,
     x: jnp.ndarray,  # [b, s, d]
@@ -178,7 +213,8 @@ def _layer_body(
     x = x + mm(attn.reshape(b, s, hq * hd), lp["wo"]).astype(x.dtype)
 
     h = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
-    x = x + mm(jax.nn.gelu(mm(h, lp["w_gate"])) * mm(h, lp["w_up"]), lp["w_down"])
+    act = _act_fn(cfg)
+    x = x + mm(act(mm(h, lp["w_gate"])) * mm(h, lp["w_up"]), lp["w_down"])
     return x, new_k, new_v
 
 
@@ -300,12 +336,17 @@ def _embed_tokens(params: dict, cfg: TransformerConfig, tokens: jnp.ndarray) -> 
         x = emb.q[tokens].astype(cfg.dtype) * emb.s.astype(cfg.dtype)
     else:
         x = emb[tokens].astype(cfg.dtype)
+    if not cfg.scale_embed:
+        return x
     return x * jnp.sqrt(jnp.asarray(cfg.d_model, jnp.float32)).astype(cfg.dtype)
 
 
 def _unembed(params: dict, cfg: TransformerConfig, x: jnp.ndarray) -> jnp.ndarray:
-    """Tied (possibly int8) unembed for [b, s, d] -> [b, s, vocab] f32."""
-    emb = params["embed"]
+    """(possibly int8) unembed for [b, s, d] -> [b, s, vocab] f32.
+    Tied by default; an `unembed` leaf ([vocab, d], Llama lm_head) wins
+    when present — same stored layout as embed so the int8 path is
+    identical."""
+    emb = params.get("unembed", params["embed"])
     if isinstance(emb, QTensor):
         # Fold the d-column scale into the activations, then one bf16 x
         # int8 dot (x*s) @ q.T — the big [vocab, d] stream stays int8.
@@ -393,7 +434,8 @@ def decode_chunk(
             x = x + qmm(attn.reshape(b, 1, hq * hd), lp["wo"]).astype(x.dtype)
             h = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
             x = x + qmm(
-                jax.nn.gelu(qmm(h, lp["w_gate"])) * qmm(h, lp["w_up"]), lp["w_down"]
+                _act_fn(cfg)(qmm(h, lp["w_gate"])) * qmm(h, lp["w_up"]),
+                lp["w_down"],
             )
             return x, (kb_l, vb_l)
 

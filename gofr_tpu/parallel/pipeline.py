@@ -147,12 +147,17 @@ def pp_lm_loss(
     return jnp.sum(nll * w) / jnp.maximum(jnp.sum(w), 1.0)
 
 
-def pp_param_shardings(cfg: TransformerConfig, mesh: Mesh, axis: str = "stage"):
+def pp_param_shardings(
+    cfg: TransformerConfig, mesh: Mesh, axis: str = "stage",
+    untied: bool = False,
+):
     """NamedSharding pytree: layer leaves stage-sharded on the leading
-    (layer) axis, embed/final_norm replicated."""
+    (layer) axis, embed/final_norm (and unembed, if untied) replicated."""
     staged = NamedSharding(mesh, P(axis))
     repl = NamedSharding(mesh, P())
+    extra = {"unembed": repl} if untied else {}
     return {
+        **extra,
         "embed": repl,
         "final_norm": repl,
         "layers": {
@@ -183,9 +188,11 @@ def make_pp_train_step(
         )
     opt = optimizer or optax.adamw(learning_rate)
     pp_fn = pipeline_layers(cfg, mesh, axis)
-    shardings = pp_param_shardings(cfg, mesh, axis)
 
     def shard_fn(params):
+        shardings = pp_param_shardings(
+            cfg, mesh, axis, untied="unembed" in params
+        )
         return jax.device_put(params, shardings)
 
     @jax.jit

@@ -26,12 +26,17 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..models.transformer import TransformerConfig
 
 
-def param_specs(cfg: TransformerConfig, mesh: Mesh, *, model_axis: str = "model") -> dict:
+def param_specs(
+    cfg: TransformerConfig, mesh: Mesh, *, model_axis: str = "model",
+    untied: bool = False,
+) -> dict:
     tp = mesh.shape.get(model_axis, 1)
     shard_kv = cfg.n_kv_heads % tp == 0 if tp > 1 else True
     m = model_axis if tp > 1 else None
     kv = m if shard_kv else None
+    extra = {"unembed": P(m, None)} if untied else {}
     return {
+        **extra,
         "embed": P(m, None),
         "final_norm": P(None),
         "layers": {

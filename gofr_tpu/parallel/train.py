@@ -52,9 +52,11 @@ def make_train_step(
     batch_spec(mesh) by the caller (parallel.shard_params or device_put).
     """
     opt = optimizer or optax.adamw(learning_rate)
-    specs = param_specs(cfg, mesh)
 
     def shard_fn(params):
+        # untied-ness (Llama unembed leaf) lives in the params pytree, not
+        # the config — build specs to match what was actually loaded
+        specs = param_specs(cfg, mesh, untied="unembed" in params)
         return shard_params(params, mesh, specs)
 
     @jax.jit

@@ -313,3 +313,38 @@ class TestPipelineParallel:
         mesh = Mesh(np.array(jax.devices()[:4]).reshape(4), ("stage",))
         with pytest.raises(ValueError):
             make_pp_train_step(cfg, mesh, n_micro=2)
+
+
+class TestUntiedSharding:
+    def test_train_step_shards_untied_params(self):
+        """An unembed leaf (untied Llama head) must shard without a pytree
+        mismatch in both train-step factories (specs derive untied-ness
+        from the params, not the config)."""
+        import dataclasses
+
+        import numpy as np
+
+        from jax.sharding import Mesh
+
+        from gofr_tpu.parallel import make_pp_train_step
+
+        cfg = TransformerConfig.tiny()
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        params = dict(
+            params,
+            unembed=jax.random.normal(
+                jax.random.PRNGKey(1), (cfg.vocab_size, cfg.d_model), jnp.float32
+            ),
+        )
+        mesh = make_mesh({"data": 2, "model": 4})
+        shard_fn, _io, _st = make_train_step(cfg, mesh)
+        sp = shard_fn(params)
+        assert "unembed" in sp
+
+        pcfg = dataclasses.replace(cfg, n_layers=4)
+        pparams = init_params(jax.random.PRNGKey(0), pcfg)
+        pparams = dict(pparams, unembed=params["unembed"])
+        pmesh = Mesh(np.array(jax.devices()[:4]).reshape(4), ("stage",))
+        pshard, _pi, _ps = make_pp_train_step(pcfg, pmesh, n_micro=2)
+        psp = pshard(pparams)
+        assert "unembed" in psp
