@@ -153,6 +153,12 @@ class LLMEngine:
 
         enable_compilation_cache(logger=logger)
 
+        if param_specs is not None and "unembed" in params and "unembed" not in param_specs:
+            # untied-head (Llama) params: untied-ness lives in the pytree,
+            # not the config, and callers routinely build specs with
+            # sharding.param_specs(cfg, mesh) defaults — patch in embed's
+            # spec (same [vocab, d] layout) instead of crashing shard_params
+            param_specs = {**param_specs, "unembed": param_specs["embed"]}
         if quantize:
             from .models.quant import is_quantized, quantize_param_specs, quantize_params
 

@@ -145,7 +145,13 @@ def _params_from_hf(
         # embedding) — a value-equal head would only duplicate the vocab
         # table in HBM, so keep the tied path for it.
         head = tensors["lm_head.weight"]
-        if head.shape != embed.shape or not np.array_equal(head, embed):
+        # cheap sample first: genuinely untied heads (the common case)
+        # differ immediately, so skip the full [vocab, d] compare and its
+        # ~0.5 GB boolean temp at 8B scale
+        sample_differs = head.shape == embed.shape and not np.array_equal(
+            head.reshape(-1)[:256], embed.reshape(-1)[:256]
+        )
+        if head.shape != embed.shape or sample_differs or not np.array_equal(head, embed):
             out_extra["unembed"] = jnp.asarray(head, dt)
 
     return {

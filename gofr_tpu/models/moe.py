@@ -1,0 +1,215 @@
+"""Mixture-of-Experts FFN with expert parallelism (EP).
+
+The reference framework has no ML execution (SURVEY §2.9); this module
+exists for the parallelism inventory's EP axis: experts shard over an
+`expert` mesh axis and GSPMD turns the dispatch/combine einsums into the
+all-to-all + local-FFN pattern — no hand-written collectives, same recipe
+as the TP/DP layers (annotate shardings, let XLA partition).
+
+Design — the GShard/Switch dense-dispatch formulation, which is the
+TPU-native one (static shapes, MXU-shaped einsums, no ragged gathers):
+
+- Router: logits = x @ w_router, softmax in f32, top-k (k small, over the
+  tiny E axis — cheap `lax.top_k`).
+- Capacity: each expert processes at most C = ceil(T/E · capacity_factor
+  · k) tokens per batch; overflow tokens are dropped for that expert
+  (their combine weight is 0) — deterministic, shape-static.
+- Dispatch/combine: one-hot [T, E, C] tensors; expert inputs are
+  `einsum('tec,td->ecd')`, experts run as a batched (vmapped over E) FFN,
+  outputs return via `einsum('tec,ecd->td')` scaled by the gate probs.
+- Aux load-balancing loss (Switch-style): E · Σ_e fraction_e · prob_e,
+  pushing the router toward uniform expert utilization.
+
+With `x` data-sharded over "data" and experts weight-sharded over
+"expert", XLA lowers dispatch to a reduce-scatter/all-to-all onto the
+owning expert shard and combine to the reverse — exactly the manual EP
+wiring, derived from annotations.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ..ops import apply_rope, multi_head_attention, rms_norm
+
+__all__ = [
+    "MoEConfig",
+    "moe_init",
+    "moe_ffn",
+    "moe_transformer_forward",
+    "moe_lm_loss",
+    "moe_param_specs",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEConfig:
+    vocab_size: int = 512
+    d_model: int = 64
+    n_layers: int = 2
+    n_heads: int = 4
+    head_dim: int = 16
+    d_ff: int = 128  # per-expert hidden
+    n_experts: int = 8
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    rope_theta: float = 10_000.0
+    norm_eps: float = 1e-6
+    aux_loss_weight: float = 1e-2
+    dtype: object = jnp.float32
+
+    @staticmethod
+    def tiny(n_experts: int = 8) -> "MoEConfig":
+        return MoEConfig(n_experts=n_experts)
+
+
+def moe_init(rng: jax.Array, cfg: MoEConfig) -> dict:
+    d, hd, hq, ff, E, L = (
+        cfg.d_model, cfg.head_dim, cfg.n_heads, cfg.d_ff, cfg.n_experts,
+        cfg.n_layers,
+    )
+    keys = jax.random.split(rng, 8)
+
+    def w(key, shape, fan_in):
+        return (
+            jax.random.normal(key, shape, jnp.float32) / math.sqrt(fan_in)
+        ).astype(cfg.dtype)
+
+    return {
+        "embed": w(keys[0], (cfg.vocab_size, d), d),
+        "final_norm": jnp.zeros((d,), cfg.dtype),
+        "layers": {
+            "attn_norm": jnp.zeros((L, d), cfg.dtype),
+            "wqkv": w(keys[1], (L, d, 3 * hq * hd), d),
+            "wo": w(keys[2], (L, hq * hd, d), hq * hd),
+            "mlp_norm": jnp.zeros((L, d), cfg.dtype),
+            "w_router": w(keys[3], (L, d, E), d),
+            # experts batched on a leading E axis — the EP shard axis
+            "w_gate": w(keys[4], (L, E, d, ff), d),
+            "w_up": w(keys[5], (L, E, d, ff), d),
+            "w_down": w(keys[6], (L, E, ff, d), ff),
+        },
+    }
+
+
+def moe_ffn(
+    x: jnp.ndarray,  # [T, d] token-major
+    w_router: jnp.ndarray,  # [d, E]
+    w_gate: jnp.ndarray,  # [E, d, ff]
+    w_up: jnp.ndarray,
+    w_down: jnp.ndarray,  # [E, ff, d]
+    cfg: MoEConfig,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Returns (y [T, d], aux_loss scalar)."""
+    T, d = x.shape
+    E, k = cfg.n_experts, cfg.top_k
+    C = max(1, math.ceil(T / E * cfg.capacity_factor * k))
+
+    logits = (x.astype(jnp.float32)) @ w_router.astype(jnp.float32)  # [T, E]
+    probs = jax.nn.softmax(logits, axis=-1)
+    top_p, top_e = jax.lax.top_k(probs, k)  # [T, k]
+
+    # position of each (token, slot) inside its expert's capacity buffer:
+    # flatten slots k-major so earlier tokens (and a token's higher-prob
+    # slot) claim capacity first — deterministic overflow dropping
+    onehot = jax.nn.one_hot(top_e, E, dtype=jnp.int32)  # [T, k, E]
+    flat = onehot.transpose(1, 0, 2).reshape(k * T, E)  # slot-major [kT, E]
+    pos_flat = jnp.cumsum(flat, axis=0) - 1  # [kT, E] position per expert
+    pos = pos_flat.reshape(k, T, E).transpose(1, 0, 2)  # [T, k, E]
+    slot_pos = jnp.sum(pos * onehot, axis=-1)  # [T, k]
+    keep = slot_pos < C  # overflow -> dropped
+
+    # dispatch [T, E, C] one-hot; combine carries the gate probability
+    disp = (
+        jax.nn.one_hot(top_e, E, dtype=jnp.float32)[..., None]
+        * jax.nn.one_hot(jnp.where(keep, slot_pos, C), C + 1, dtype=jnp.float32)[
+            :, :, None, :C
+        ]
+    )  # [T, k, E, C]
+    combine = jnp.sum(disp * top_p[..., None, None].astype(jnp.float32), axis=1)
+    dispatch = jnp.sum(disp, axis=1)  # [T, E, C]
+
+    xin = jnp.einsum("tec,td->ecd", dispatch, x.astype(jnp.float32))  # [E, C, d]
+
+    def expert(w_g, w_u, w_d, h):
+        a = jax.nn.gelu(h @ w_g.astype(jnp.float32)) * (h @ w_u.astype(jnp.float32))
+        return a @ w_d.astype(jnp.float32)
+
+    yout = jax.vmap(expert)(w_gate, w_up, w_down, xin)  # [E, C, d]
+    y = jnp.einsum("tec,ecd->td", combine, yout).astype(x.dtype)
+
+    # Switch aux loss: E * sum_e (fraction routed to e) * (mean prob of e)
+    frac = jnp.sum(jax.nn.one_hot(top_e[:, 0], E, dtype=jnp.float32), axis=0) / T
+    aux = E * jnp.sum(frac * jnp.mean(probs, axis=0))
+    return y, aux
+
+
+def moe_transformer_forward(
+    params: dict, cfg: MoEConfig, tokens: jnp.ndarray
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """[b, s] -> (logits [b, s, vocab] f32, total aux loss). Causal MHA +
+    MoE FFN per layer; layers scanned like models.transformer."""
+    b, s = tokens.shape
+    d, hq, hd = cfg.d_model, cfg.n_heads, cfg.head_dim
+    x = params["embed"][tokens].astype(cfg.dtype)
+    positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s))
+
+    def layer(carry, lp):
+        x, aux = carry
+        h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
+        qkv = (h @ lp["wqkv"]).reshape(b, s, 3, hq, hd)
+        q, k_, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        q = apply_rope(q, positions, cfg.rope_theta)
+        k_ = apply_rope(k_, positions, cfg.rope_theta)
+        attn = multi_head_attention(q, k_, v, causal=True)
+        x = x + (attn.reshape(b, s, hq * hd) @ lp["wo"]).astype(x.dtype)
+        h = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
+        y, a = moe_ffn(
+            h.reshape(b * s, d), lp["w_router"], lp["w_gate"], lp["w_up"],
+            lp["w_down"], cfg,
+        )
+        return (x + y.reshape(b, s, d), aux + a), None
+
+    (x, aux), _ = jax.lax.scan(layer, (x, jnp.float32(0.0)), params["layers"])
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    logits = (x @ params["embed"].T.astype(cfg.dtype)).astype(jnp.float32)
+    return logits, aux
+
+
+def moe_lm_loss(
+    params: dict, cfg: MoEConfig, tokens: jnp.ndarray, mask: jnp.ndarray
+) -> jnp.ndarray:
+    logits, aux = moe_transformer_forward(params, cfg, tokens)
+    targets = tokens[:, 1:]
+    logp = jax.nn.log_softmax(logits[:, :-1], axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    w = mask[:, 1:].astype(jnp.float32)
+    ce = jnp.sum(nll * w) / jnp.maximum(jnp.sum(w), 1.0)
+    return ce + cfg.aux_loss_weight * aux
+
+
+def moe_param_specs(cfg: MoEConfig, mesh, *, expert_axis: str = "expert") -> dict:
+    """PartitionSpec pytree for EP: expert-batched weights sharded on their
+    E axis, everything else replicated. Compose with a "data" axis on the
+    batch for DP x EP."""
+    from jax.sharding import PartitionSpec as P
+
+    e = expert_axis if mesh.shape.get(expert_axis, 1) > 1 else None
+    return {
+        "embed": P(None, None),
+        "final_norm": P(None),
+        "layers": {
+            "attn_norm": P(None, None),
+            "wqkv": P(None, None, None),
+            "wo": P(None, None, None),
+            "mlp_norm": P(None, None),
+            "w_router": P(None, None, None),
+            "w_gate": P(None, e, None, None),
+            "w_up": P(None, e, None, None),
+            "w_down": P(None, e, None, None),
+        },
+    }

@@ -348,3 +348,37 @@ class TestUntiedSharding:
         pshard, _pi, _ps = make_pp_train_step(pcfg, pmesh, n_micro=2)
         psp = pshard(pparams)
         assert "unembed" in psp
+
+    def test_llm_engine_tp_untied_params(self):
+        """TP serving of an untied-head (Llama) checkpoint with the stock
+        param_specs(cfg, mesh) — the engine patches in the unembed spec
+        rather than crashing shard_params (review r4)."""
+        from gofr_tpu.llm import LLMEngine
+
+        cfg = TransformerConfig.tiny()
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        params = dict(
+            params,
+            unembed=jax.random.normal(
+                jax.random.PRNGKey(2), (cfg.vocab_size, cfg.d_model), jnp.float32
+            )
+            * 0.02,
+        )
+        mesh = make_mesh({"data": 1, "model": 8})
+        eng = LLMEngine(
+            cfg, params, slots=2, max_seq_len=32, prefill_buckets=(8,),
+            decode_chunk=4, mesh=mesh, param_specs=param_specs(cfg, mesh),
+        )
+        try:
+            got = eng.generate([5, 9, 2], max_new_tokens=4)
+        finally:
+            eng.close()
+        eng1 = LLMEngine(
+            cfg, params, slots=2, max_seq_len=32, prefill_buckets=(8,),
+            decode_chunk=4,
+        )
+        try:
+            want = eng1.generate([5, 9, 2], max_new_tokens=4)
+        finally:
+            eng1.close()
+        assert got == want
