@@ -9,7 +9,9 @@ the checkpoint dir) for text in/out. Without GEMMA_CKPT the model is
 randomly initialized (this environment has no weight downloads) and the API
 still works on raw token ids — the serving path is identical.
 
-GEMMA_PRESET=tiny (default, CI/dev) | 2b | 7b chooses the architecture.
+GEMMA_PRESET=tiny (default, CI/dev) | 2b | 7b | llama3-8b | tiny-llama
+chooses the architecture; llama presets load via the Llama checkpoint
+mapping (untied lm_head, silu, plain RMSNorm absorbed at load).
 
 Drive it:
   unary:  json_unary(target, "Gemma", "Generate", {"prompt": "...", "max_new_tokens": 8})
@@ -37,14 +39,21 @@ def build_engine(app):
         "tiny": TransformerConfig.tiny,
         "2b": TransformerConfig.gemma_2b,
         "7b": TransformerConfig.gemma_7b,
+        "llama3-8b": TransformerConfig.llama3_8b,
+        "tiny-llama": TransformerConfig.tiny_llama,
     }[preset]()
+    is_llama = "llama" in preset
 
     ckpt = os.environ.get("GEMMA_CKPT", "")
     if ckpt:
-        from gofr_tpu.models.checkpoint import load_gemma_checkpoint
+        from gofr_tpu.models.checkpoint import (
+            load_gemma_checkpoint,
+            load_llama_checkpoint,
+        )
 
         app.logger.info(f"loading weights from {ckpt}")
-        params = load_gemma_checkpoint(ckpt, cfg)
+        loader = load_llama_checkpoint if is_llama else load_gemma_checkpoint
+        params = loader(ckpt, cfg)
     else:
         app.logger.warn("GEMMA_CKPT not set: serving randomly initialized weights")
         params = init_params(jax.random.PRNGKey(0), cfg)

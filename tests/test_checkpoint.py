@@ -202,3 +202,60 @@ class TestGrpcGemmaExample:
             assert len(out["tokens"]) <= 3 and isinstance(out["text"], str)
         finally:
             app.container.close()
+
+
+class TestLlamaExamplePreset:
+    def test_tiny_llama_preset_loads_untied_checkpoint(self, tmp_path, monkeypatch):
+        """GEMMA_PRESET=tiny-llama routes through load_llama_checkpoint:
+        plain-norm offsets applied, untied lm_head mapped, engine builds."""
+        import importlib.util
+
+        from safetensors.numpy import save_file
+
+        from gofr_tpu.models import init_params as ip
+
+        cfg = TransformerConfig.tiny_llama()
+        params = ip(jax.random.PRNGKey(7), cfg)
+        tensors = params_to_hf(params, cfg)
+        # llama checkpoints store raw norm scales (ours are zero-centered)
+        for k in list(tensors):
+            if k.endswith("layernorm.weight") or k == "model.norm.weight":
+                tensors[k] = tensors[k] + 1.0
+        rng = np.random.default_rng(0)
+        tensors["lm_head.weight"] = rng.normal(
+            0, 0.02, (cfg.vocab_size, cfg.d_model)
+        ).astype(np.float32)
+        ckpt_dir = tmp_path / "llama-ckpt"
+        ckpt_dir.mkdir()
+        save_file(tensors, str(ckpt_dir / "model.safetensors"))
+
+        ex = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "examples", "grpc-gemma", "main.py",
+        )
+        monkeypatch.chdir(os.path.dirname(ex))
+        monkeypatch.setenv("GEMMA_CKPT", str(ckpt_dir))
+        monkeypatch.setenv("GEMMA_PRESET", "tiny-llama")
+        monkeypatch.setenv("LOG_LEVEL", "ERROR")
+        spec = importlib.util.spec_from_file_location("example_grpc_llama_ckpt", ex)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+
+        import gofr_tpu
+        from gofr_tpu.config import new_mock_config
+
+        app = gofr_tpu.App(config=new_mock_config({"APP_NAME": "t", "LOG_LEVEL": "ERROR"}))
+        mod.build_engine(app)
+        try:
+            from gofr_tpu.context import Context
+
+            class Req:
+                context: dict = {}
+
+                def bind(self, target=None):
+                    return {"tokens": [5, 9, 2], "max_new_tokens": 3}
+
+            out = mod.generate(Context(Req(), app.container))
+            assert len(out["tokens"]) <= 3
+        finally:
+            app.container.close()
