@@ -170,6 +170,7 @@ def _layer_body(
     v_cache: jnp.ndarray | None,
     cache_length: jnp.ndarray | None,  # [b]
     decode: bool,
+    prefill_attn=None,  # optional (q, k, v) -> attn override (ring/SP path)
 ):
     b, s, d = x.shape
     hq, hkv, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
@@ -206,7 +207,10 @@ def _layer_body(
         # pad-position outputs are discarded (loss-masked / never read) and
         # pad K/V in the cache is masked by cache.length at decode. Keeping
         # the call dense is what lets the Pallas flash kernel engage.
-        attn = multi_head_attention(q, k, v, causal=True, logit_cap=cfg.attn_logit_cap)
+        if prefill_attn is not None:
+            attn = prefill_attn(q, k, v)
+        else:
+            attn = multi_head_attention(q, k, v, causal=True, logit_cap=cfg.attn_logit_cap)
         # Prefill fills the cache from position 0 (right-padded batches).
         new_k, new_v = k, v
 
@@ -228,6 +232,7 @@ def transformer_forward(
     kv_mask: jnp.ndarray | None = None,  # [b, s] True = real token (prefill)
     decode: bool = False,
     unembed_positions: jnp.ndarray | None = None,  # [b] -> logits only there
+    prefill_attn=None,  # optional attention override for the prefill path
 ) -> tuple[jnp.ndarray, KVCache | None]:
     """Returns (logits float32, updated cache or None).
 
@@ -260,6 +265,7 @@ def transformer_forward(
             x, nk, nv = _layer_body(
                 cfg, x, lp, positions,
                 k_cache=None, v_cache=None, cache_length=None, decode=False,
+                prefill_attn=prefill_attn,
             )
             return (x, None), (nk, nv)
 
@@ -295,6 +301,8 @@ def prefill(
     tokens: jnp.ndarray,  # [b, s] right-padded
     lengths: jnp.ndarray,  # [b]
     max_cache_len: int,
+    *,
+    prefill_attn=None,
 ) -> tuple[jnp.ndarray, KVCache]:
     """Process prompts, build the KV cache, return last-token logits [b, vocab]."""
     b, s = tokens.shape
@@ -303,7 +311,7 @@ def prefill(
     cache = init_cache(cfg, b, max_cache_len)
     logits, new_cache = transformer_forward(
         params, cfg, tokens, positions, cache=cache, kv_mask=kv_mask,
-        unembed_positions=lengths - 1,
+        unembed_positions=lengths - 1, prefill_attn=prefill_attn,
     )
     return logits[:, 0], new_cache
 

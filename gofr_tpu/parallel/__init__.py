@@ -8,7 +8,7 @@ shard_map + ppermute) makes long-context first-class.
 """
 
 from .mesh import make_mesh, mesh_shape_for
-from .ring import ring_attention
+from .ring import ring_attention, ring_prefill
 from .sharding import (
     batch_spec,
     mlp_param_specs,
@@ -37,6 +37,7 @@ __all__ = [
     "shard_params",
     "with_shardings",
     "ring_attention",
+    "ring_prefill",
     "make_train_step",
     "place_batch",
     "lm_loss",

@@ -105,3 +105,73 @@ def ring_attention(
     return shard_map(
         local, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
     )(q, k, v)
+
+
+@functools.lru_cache(maxsize=32)
+def _ring_prefill_fn(cfg, mesh: Mesh, axis: str, max_cache_len: int):
+    """One jitted executable per (cfg, mesh, axis, cache size) — a fresh
+    closure per call would miss jax's compile cache and re-trace the whole
+    model every prefill."""
+    from ..models.transformer import prefill as _prefill
+
+    reps = cfg.n_heads // cfg.n_kv_heads
+
+    def attn(q, k, v):
+        # GQA: expand K/V to q's head count (ring traffic is the cost here
+        # and KV is 1/reps of it; see ring_attention docstring)
+        if reps > 1:
+            k = jnp.repeat(k, reps, axis=2)
+            v = jnp.repeat(v, reps, axis=2)
+        return ring_attention(q, k, v, mesh=mesh, axis=axis, causal=True)
+
+    @jax.jit
+    def run(params, tokens, lengths):
+        return _prefill(
+            params, cfg, tokens, lengths, max_cache_len, prefill_attn=attn
+        )
+
+    return run
+
+
+def ring_prefill(
+    params: dict,
+    cfg,
+    tokens: jnp.ndarray,  # [b, s] right-padded, s sharded over `axis`
+    lengths: jnp.ndarray,  # [b]
+    *,
+    mesh: Mesh,
+    axis: str = "seq",
+    max_cache_len: int | None = None,
+):
+    """Long-context sequence-parallel prefill: the FULL transformer forward
+    with activations sharded over the sequence axis, attention via
+    ring_attention, everything else partitioned by GSPMD from the input
+    sharding. Per-device memory is O(s/N) activations + O(s/N) KV — the
+    path for prompts whose activations/KV exceed one chip's HBM.
+
+    Returns (last_logits [b, vocab], KVCache) with cache.k/v seq-sharded
+    on the cache length axis (reshard/gather to feed single-chip decode,
+    or keep sharded for SP decode). max_cache_len defaults to s — pass
+    s + decode headroom when the cache will feed decode_step (its
+    documented precondition is cache.length < max_len; a headroom-less
+    cache from a full-length prompt would silently clamp-overwrite the
+    last KV slot).
+
+    s must divide by mesh.shape[axis]. Gemma-2 attn logit soft-capping is
+    not supported on the ring path (cap folds into the online softmax
+    non-trivially); gemma_2b/llama presets have cap = 0.
+    """
+    from jax.sharding import NamedSharding
+
+    if getattr(cfg, "attn_logit_cap", 0.0):
+        raise NotImplementedError("ring_prefill: attn_logit_cap unsupported")
+    n = mesh.shape[axis]
+    b, s = tokens.shape
+    if s % n != 0:
+        raise ValueError(f"seq {s} not divisible by {axis}={n}")
+
+    seq_sharded = NamedSharding(mesh, P(None, axis))
+    tokens = jax.device_put(tokens, seq_sharded)
+    lengths = jax.device_put(lengths, NamedSharding(mesh, P(None)))
+    run = _ring_prefill_fn(cfg, mesh, axis, max_cache_len or s)
+    return run(params, tokens, lengths)
