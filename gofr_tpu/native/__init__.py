@@ -30,6 +30,8 @@ _BUILD_DIR = os.path.join(_HERE, "_build")
 
 _http_codec = None
 _http_codec_tried = False
+_data_core = None
+_data_core_tried = False
 
 
 def _ext_suffix() -> str:
@@ -86,6 +88,24 @@ def _import_from(path: str, modname: str):
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
     return mod
+
+
+def load_data_core():
+    """Return the `_gofr_data` extension (native batch gather for the
+    training data-loader), or None when disabled/unbuildable."""
+    global _data_core, _data_core_tried
+    if _data_core_tried:
+        return _data_core
+    _data_core_tried = True
+    if os.environ.get("GOFR_NATIVE", "1") == "0":
+        return None
+    try:
+        path = _build("datacore.cc", "_gofr_data")
+        if path:
+            _data_core = _import_from(path, "_gofr_data")
+    except Exception:  # noqa: BLE001 - native load must never break the app
+        _data_core = None
+    return _data_core
 
 
 def load_http_codec():
