@@ -163,31 +163,51 @@ class BatchIterator:
         self.epoch = 0
         self.step = 0
         self._perm: np.ndarray | None = None
-        if drop_remainder and len(self._epoch_perm()) < batch_size:
+        n_rank = (ds.n_windows - dp_rank + dp_size - 1) // dp_size
+        if drop_remainder and n_rank < batch_size:
             raise ValueError(
-                f"batch_size {batch_size} exceeds this rank's "
-                f"{len(self._perm)} windows/epoch (corpus too small for "
-                f"dp_size={dp_size} with drop_remainder)"
+                f"batch_size {batch_size} exceeds this rank's {n_rank} "
+                f"windows/epoch (corpus too small for dp_size={dp_size} "
+                f"with drop_remainder)"
             )
-        self._perm = None  # epoch-0 perm rebuilt lazily (cheap, keeps state simple)
 
     # -- checkpoint/resume ------------------------------------------------
     def state(self) -> dict:
+        """NOTE: when the iterator is wrapped in device_prefetch, the pump
+        thread has advanced it PAST what the consumer has seen — snapshot
+        with seek(consumed_batches) semantics instead (count batches in the
+        training loop and call seek on resume), or checkpoint before
+        wrapping."""
         return {"epoch": self.epoch, "step": self.step, "seed": self.seed,
                 "dp_rank": self.dp_rank, "dp_size": self.dp_size,
-                "batch_size": self.batch_size}
+                "batch_size": self.batch_size, "seq_len": self.ds.seq_len,
+                "n_windows": self.ds.n_windows}
 
     def restore(self, state: dict) -> "BatchIterator":
-        # position is step * batch_size within THIS rank's permutation —
-        # every one of these changes where the stream resumes
-        for key in ("seed", "dp_size", "dp_rank", "batch_size"):
-            if key in state and state[key] != getattr(self, key):
+        # position is step * batch_size within THIS rank's permutation of
+        # THIS window grid — every one of these changes what it replays
+        checks = {
+            "seed": self.seed, "dp_size": self.dp_size,
+            "dp_rank": self.dp_rank, "batch_size": self.batch_size,
+            "seq_len": self.ds.seq_len, "n_windows": self.ds.n_windows,
+        }
+        for key, val in checks.items():
+            if key in state and state[key] != val:
                 raise ValueError(
                     f"restore: {key} mismatch (checkpoint {state[key]}, "
-                    f"iterator {getattr(self, key)})"
+                    f"iterator {val})"
                 )
         self.epoch = int(state["epoch"])
         self.step = int(state["step"])
+        self._perm = None
+        return self
+
+    def seek(self, n_batches: int) -> "BatchIterator":
+        """Position the stream as if n_batches had been consumed from the
+        start — the prefetch-safe resume: the training loop checkpoints its
+        own consumed count, not the (look-ahead-advanced) iterator."""
+        spe = self.steps_per_epoch()
+        self.epoch, self.step = divmod(int(n_batches), spe)
         self._perm = None
         return self
 

@@ -193,17 +193,24 @@ def load_llama_checkpoint(path: str, cfg) -> dict:
     return llama_params_from_hf(load_safetensors_dir(path), cfg)
 
 
-def save_orbax(params: Any, path: str) -> None:
+def save_orbax(params: Any, path: str, *, overwrite: bool = False) -> None:
     """Save the native pytree with orbax (for fast reload of converted
-    checkpoints: convert from HF once, reload in native layout forever)."""
+    checkpoints: convert from HF once, reload in native layout forever).
+    overwrite=True replaces an existing checkpoint (periodic training
+    saves; orbax's force path deletes then writes)."""
     import orbax.checkpoint as ocp
 
     with ocp.StandardCheckpointer() as ckptr:
-        ckptr.save(os.path.abspath(path), params)
+        ckptr.save(os.path.abspath(path), params, force=overwrite)
 
 
-def load_orbax(path: str) -> Any:
+def load_orbax(path: str, target: Any = None) -> Any:
+    """Restore an orbax checkpoint. Pass `target` (a matching pytree of
+    arrays) when the saved tree contains non-dict nodes — optax opt-states
+    are NamedTuples, which a target-less restore flattens to plain dicts."""
     import orbax.checkpoint as ocp
 
     with ocp.StandardCheckpointer() as ckptr:
+        if target is not None:
+            return ckptr.restore(os.path.abspath(path), target)
         return ckptr.restore(os.path.abspath(path))

@@ -37,6 +37,10 @@ PyObject *gather_windows(PyObject *, PyObject *args) {
   if (itemsize != 2 && itemsize != 4) {
     PyErr_SetString(PyExc_ValueError, "itemsize must be 2 or 4");
     err = Py_None;
+  } else if (window <= 0) {
+    // a negative window would wrap the memcpy size to ~2^64 bytes
+    PyErr_SetString(PyExc_ValueError, "window must be positive");
+    err = Py_None;
   } else if (starts.len % Py_ssize_t(sizeof(long long)) != 0) {
     PyErr_SetString(PyExc_ValueError, "starts must be int64");
     err = Py_None;

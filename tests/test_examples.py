@@ -311,3 +311,32 @@ class TestUsingSubscriber:
             _t.sleep(0.05)
         assert mod.RECEIVED and mod.RECEIVED[0]["orderId"] == "42"
         _assert_framework_routes(base)
+
+
+class TestTrainLM:
+    def test_encode_train_resume(self, monkeypatch, capsys, tmp_path):
+        """Full training loop example: encode -> train -> resume. The
+        second run must pick up the checkpoint (global_step advances,
+        iterator resumes mid-epoch) and loss must drop vs the first
+        run's start (fresh batches, learnable toy distribution)."""
+        monkeypatch.chdir(os.path.join(EXAMPLES, "train-lm"))
+        mod = _load("train-lm")
+        app = mod.build_app()
+        corpus = str(tmp_path / "c.tok")
+        ckpt = str(tmp_path / "run")
+        assert app.run(["encode", f"-out={corpus}", "-n=50000"]) == 0
+        capsys.readouterr()
+        import ast
+
+        assert app.run([
+            "train", f"-corpus={corpus}", "-steps=8", f"-ckpt={ckpt}",
+        ]) == 0
+        out1 = ast.literal_eval(capsys.readouterr().out.strip().splitlines()[-1])
+        assert out1["global_step"] == 8
+        assert app.run([
+            "train", f"-corpus={corpus}", "-steps=8", f"-ckpt={ckpt}",
+        ]) == 0
+        out2 = ast.literal_eval(capsys.readouterr().out.strip().splitlines()[-1])
+        assert out2["global_step"] == 16
+        # resumed training continues to improve on fresh batches
+        assert out2["loss_last"] < out1["loss_first"]
