@@ -47,7 +47,12 @@ async def _read_headers(reader: asyncio.StreamReader) -> tuple[str, str, str, di
     if len(parts) != 3:
         raise HTTPProtocolError(400, "malformed request line")
     method, target, version = parts
-    if not version.startswith("HTTP/1."):
+    # bounds mirror the native codec exactly (tests/test_native_http.py
+    # fuzzes the two parsers against each other): non-empty method <= 31
+    # chars, non-empty target, version HTTP/1.<minor> with a minor digit
+    if not method or len(method) > 31 or not target:
+        raise HTTPProtocolError(400, "malformed request line")
+    if not version.startswith("HTTP/1.") or len(version) < 8:
         raise HTTPProtocolError(505, "http version not supported")
     headers: dict[str, str] = {}
     for line in lines[1:]:
@@ -56,7 +61,10 @@ async def _read_headers(reader: asyncio.StreamReader) -> tuple[str, str, str, di
         if ":" not in line:
             raise HTTPProtocolError(400, "malformed header")
         k, _, v = line.partition(":")
-        headers[k.strip().lower()] = v.strip()
+        k = k.strip()
+        if not k:  # RFC 9112: field names are non-empty tokens
+            raise HTTPProtocolError(400, "malformed header")
+        headers[k.lower()] = v.strip()
     return method.upper(), target, version, headers
 
 

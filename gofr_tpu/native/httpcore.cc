@@ -111,6 +111,20 @@ PyObject *parse(PyObject *, PyObject *args) {
       PyBuffer_Release(&view);
       return http_error(400, "malformed request line");
     }
+    // empty method/target reject BEFORE the version check — server.py
+    // validates in that order, and the status must match on requests that
+    // are invalid in multiple ways
+    char method_buf[32];
+    Py_ssize_t mlen = sp1 - p;
+    if (mlen <= 0 || mlen > 31 || sp2 - sp1 <= 1) {
+      PyBuffer_Release(&view);
+      return http_error(400, "malformed request line");
+    }
+    for (Py_ssize_t i = 0; i < mlen; ++i) {
+      char c = p[i];
+      method_buf[i] = (c >= 'a' && c <= 'z') ? char(c - 32) : c;
+    }
+
     // version: HTTP/1.<minor>
     const char *v = sp2 + 1;
     const Py_ssize_t vlen = line_end - v;
@@ -120,19 +134,6 @@ PyObject *parse(PyObject *, PyObject *args) {
     }
     int minor = 1;
     if (v[7] == '0' && vlen == 8) minor = 0;
-
-    // method uppercased (server.py: method.upper())
-    char method_buf[32];
-    Py_ssize_t mlen = sp1 - p;
-    if (mlen <= 0 || mlen > 31) {
-      PyBuffer_Release(&view);
-      return http_error(400, "malformed request line");
-    }
-    for (Py_ssize_t i = 0; i < mlen; ++i) {
-      char c = p[i];
-      method_buf[i] = (c >= 'a' && c <= 'z') ? char(c - 32) : c;
-    }
-
     PyObject *method = PyUnicode_DecodeLatin1(method_buf, mlen, nullptr);
     PyObject *target = PyUnicode_DecodeLatin1(sp1 + 1, sp2 - sp1 - 1, nullptr);
     PyObject *headers = PyDict_New();

@@ -253,6 +253,11 @@ async def test_unhandled_dispatch_error_returns_500(server_cls):
     [
         (b"BROKEN-LINE\r\n\r\n", b"400"),
         (b"GET /x SPDY/3\r\n\r\n", b"505"),
+        (b"GET  HTTP/1.1\r\n\r\n", b"400"),  # empty target
+        (b" / HTTP/1.1\r\n\r\n", b"400"),  # empty method
+        (b"A" * 32 + b" / HTTP/1.1\r\n\r\n", b"400"),  # method too long
+        (b"GET / HTTP/1.\r\n\r\n", b"505"),  # no minor digit
+        (b"GET / HTTP/1.1\r\n : v\r\n\r\n", b"400"),  # empty header name
         (b"GET / HTTP/1.1\r\nBad-Header-Without-Colon\r\n\r\n", b"400"),
         (b"POST / HTTP/1.1\r\nContent-Length: nope\r\n\r\n", b"400"),
         (
