@@ -1,0 +1,92 @@
+"""examples/kafka-batch-inference: BASELINE config 4 — pub/sub-driven
+async batch inference on the TPU.
+
+Requests arrive as topic messages (each carrying a microbatch of inputs,
+the way production Kafka pipelines batch records); the subscriber handler
+fans the rows into the dynamic batcher with one infer_async per row —
+they coalesce into a single device execution, together with any rows from
+other in-flight messages or HTTP traffic — and publishes predictions to a
+results topic. Commit-on-success gives at-least-once processing.
+
+PUBSUB_BACKEND picks the transport (MEMORY here; KAFKA against a real
+broker — the from-scratch wire client in datasource/pubsub/kafka.py).
+
+Drive it:
+  POST /enqueue  {"id": "a1", "xs": [[...16 floats], ...]}
+  GET  /results  -> {"a1": [3, 0, ...], ...}
+"""
+
+import asyncio
+import json
+import sys
+
+sys.path.insert(0, "../..")
+
+import numpy as np
+
+import gofr_tpu
+
+RESULTS: dict = {}
+
+
+def _register_model(app):
+    import jax
+
+    from gofr_tpu.models import MLPConfig, mlp_forward, mlp_init
+
+    mcfg = MLPConfig(in_dim=16, hidden=(32,), out_dim=4, dtype=jax.numpy.float32)
+    params = mlp_init(jax.random.PRNGKey(0), mcfg)
+    app.container.tpu().register_model(
+        "mnist", lambda p, x: mlp_forward(p, x), params,
+        example_args=(np.zeros(16, np.float32),),
+    )
+    return mcfg, params
+
+
+async def on_request(ctx):
+    """One message = one microbatch. Per-row batcher submits coalesce into
+    a single XLA execution (plus whatever else is in flight)."""
+    body = ctx.bind()
+    xs = [np.asarray(x, np.float32) for x in body["xs"]]
+    outs = await asyncio.gather(
+        *[ctx.tpu().infer_async("mnist", x) for x in xs]
+    )
+    preds = [int(np.argmax(o)) for o in outs]
+    await ctx.get_publisher().publish(
+        "inference-results", json.dumps({"id": body["id"], "preds": preds}).encode()
+    )
+    return None  # success -> commit
+
+
+def on_result(ctx):
+    body = ctx.bind()
+    RESULTS[body["id"]] = body["preds"]
+    return None
+
+
+async def enqueue(ctx):
+    body = ctx.bind()
+    await ctx.get_publisher().publish("inference-requests", ctx.request.body)
+    return {"queued": body["id"], "rows": len(body["xs"])}
+
+
+def results(ctx):
+    return RESULTS
+
+
+def build_app():
+    app = gofr_tpu.new()
+    _register_model(app)
+    app.subscribe("inference-requests", on_request)
+    app.subscribe("inference-results", on_result)
+    app.post("/enqueue", enqueue)
+    app.get("/results", results)
+    return app
+
+
+def main():
+    build_app().run()
+
+
+if __name__ == "__main__":
+    main()

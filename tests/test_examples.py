@@ -340,3 +340,50 @@ class TestTrainLM:
         assert out2["global_step"] == 16
         # resumed training continues to improve on fresh batches
         assert out2["loss_last"] < out1["loss_first"]
+
+
+class TestKafkaBatchInference:
+    def test_pubsub_microbatch_inference_roundtrip(self, monkeypatch):
+        """BASELINE config 4 end-to-end: enqueue microbatches over HTTP ->
+        topic -> subscriber fans rows into the dynamic batcher -> results
+        topic -> predictions match the model run directly."""
+        import time
+        import urllib.request
+
+        import numpy as np
+
+        monkeypatch.chdir(os.path.join(EXAMPLES, "kafka-batch-inference"))
+        monkeypatch.setenv("HTTP_PORT", "0")
+        monkeypatch.setenv("METRICS_PORT", "0")
+        monkeypatch.setenv("LOG_LEVEL", "ERROR")
+        mod = _load("kafka-batch-inference")
+        app = mod.build_app()
+        app.run_in_background()
+        try:
+            base = f"http://127.0.0.1:{app.http_server.port}"
+            rng = np.random.default_rng(0)
+            want = {}
+            for i in range(6):
+                xs = rng.normal(size=(4, 16)).astype(np.float32)
+                payload = {"id": f"job-{i}", "xs": xs.tolist()}
+                req = urllib.request.Request(
+                    base + "/enqueue", method="POST",
+                    data=json.dumps(payload).encode(),
+                    headers={"Content-Type": "application/json"},
+                )
+                with urllib.request.urlopen(req, timeout=10) as r:
+                    assert r.status == 201  # POST -> Created (responder)
+                m = app.container.tpu().model("mnist")
+                logits = np.asarray(m.jitted(m.params, xs))
+                want[f"job-{i}"] = np.argmax(logits, axis=-1).tolist()
+
+            deadline = time.time() + 20
+            got = {}
+            while time.time() < deadline and len(got) < 6:
+                with urllib.request.urlopen(base + "/results", timeout=10) as r:
+                    got = json.loads(r.read())["data"]
+                time.sleep(0.1)
+            assert got == want
+            _assert_framework_routes(base)
+        finally:
+            app.shutdown()
