@@ -3,4 +3,4 @@
 Parity: reference pkg/gofr/version/version.go:3.
 """
 
-FRAMEWORK = "0.1.0-dev"
+FRAMEWORK = "0.4.0"
