@@ -251,17 +251,20 @@ class TPURuntime:
         self._models: dict[str, _Model] = {}
         self._lock = threading.Lock()
         if metrics is not None:
-            # Idempotent (Manager._register returns the existing instrument):
-            # normally done by the Container, repeated here so a standalone
-            # runtime still records its stats.
+            # Normally done by the Container; repeated here so a standalone
+            # runtime still records its stats. Silent existence guard: the
+            # already-registered WARN is parity behavior for USER double
+            # registration and must not fire on this intentional path.
             from ...metrics import TPU_BUCKETS
 
-            metrics.new_histogram("app_tpu_stats", "tpu execute time s", TPU_BUCKETS)
-            metrics.new_histogram(
-                "app_tpu_batch_size", "dynamic batch sizes",
-                (1, 2, 4, 8, 16, 32, 64, 128, 256),
-            )
-            metrics.new_histogram("app_tpu_queue_wait", "batch queue wait s", TPU_BUCKETS)
+            for name, desc, buckets in (
+                ("app_tpu_stats", "tpu execute time s", TPU_BUCKETS),
+                ("app_tpu_batch_size", "dynamic batch sizes",
+                 (1, 2, 4, 8, 16, 32, 64, 128, 256)),
+                ("app_tpu_queue_wait", "batch queue wait s", TPU_BUCKETS),
+            ):
+                if not metrics.has(name):
+                    metrics.new_histogram(name, desc, buckets)
         self.devices = jax.devices()
         self.platform = self.devices[0].platform if self.devices else "none"
         if logger is not None:

@@ -148,6 +148,13 @@ class Manager:
             self._instruments[name] = inst
             return inst
 
+    def has(self, name: str) -> bool:
+        """Silent existence check — for idempotent framework registration
+        paths (the WARN in _register is for USER double registration, the
+        ERROR in _get for using an unregistered metric)."""
+        with self._lock:
+            return name in self._instruments
+
     def new_counter(self, name: str, description: str = "") -> Counter:
         return self._register(name, Counter(name, description))
 
