@@ -27,7 +27,7 @@ from ..logging import Logger
 from ..native import load_http_codec
 from .request import Request
 from .responder import Response
-from .server import _status_line  # shared status-reason table (server.py)
+from .server import _clean_header, _status_line  # shared with server.py
 
 MAX_HEADER_BYTES = 64 * 1024
 MAX_BODY_BYTES = 100 * 1024 * 1024  # server.py parity
@@ -42,17 +42,24 @@ RECV_HIGH_WATER = 256 * 1024
 _ERR_HEAD = b"Content-Type: application/json\r\nConnection: close\r\n"
 
 
-def _py_serialize(resp: Response, body: bytes, close: bool) -> bytes:
+def _py_serialize(
+    resp: Response, body: bytes, close: bool, chunked: bool = False
+) -> bytes:
     """Tolerant fallback serializer with server.py's f-string semantics,
-    used when the strict C serializer rejects exotic header values."""
+    used when the strict C serializer rejects exotic header values.
+    chunked=True emits a streaming head (Transfer-Encoding, no body)."""
     head = [_status_line(resp.status)]
     seen = set()
     for k, v in resp.headers:
-        seen.add(str(k).lower())
-        head.append(f"{k}: {v}\r\n".encode("latin-1"))
+        ck = _clean_header(k)
+        seen.add(ck.lower())
+        head.append(f"{ck}: {_clean_header(v)}\r\n".encode("latin-1"))
     if close:
         head.append(b"Connection: close\r\n")
-    if "content-length" not in seen:
+    if chunked:
+        if "transfer-encoding" not in seen:
+            head.append(b"Transfer-Encoding: chunked\r\n")
+    elif "content-length" not in seen:
         head.append(f"Content-Length: {len(resp.body)}\r\n".encode())
     head.append(b"\r\n")
     return b"".join(head) + body
@@ -278,9 +285,16 @@ class _HTTPProtocol(asyncio.Protocol):
         """Chunked streaming response with transport flow control.
         Returns False when the connection is dead (caller stops serving)."""
         assert self.transport is not None
-        self.transport.write(
-            self.codec.build_head(resp.status, resp.headers, -1, 1 if close else 0, 1)
-        )
+        try:
+            head = self.codec.build_head(
+                resp.status, resp.headers, -1, 1 if close else 0, 1
+            )
+        except Exception:
+            # strict C serializer rejected a header (exotic type or CR/LF
+            # taint) — sanitize and serialize in Python like the non-stream
+            # fallback, so both servers serve the stream instead of aborting
+            head = _py_serialize(resp, b"", close, chunked=True)
+        self.transport.write(head)
         try:
             async for chunk in resp.stream:
                 if not chunk:

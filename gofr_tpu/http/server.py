@@ -31,6 +31,15 @@ class HTTPProtocolError(Exception):
         self.message = message
 
 
+def _clean_header(s: object) -> str:
+    """Strip CR/LF/NUL so a handler echoing untrusted input into a response
+    header cannot split the response (Go's net/http sanitizes these too)."""
+    s = str(s)
+    if "\r" in s or "\n" in s or "\x00" in s:
+        return s.replace("\r", "").replace("\n", "").replace("\x00", "")
+    return s
+
+
 async def _read_headers(reader: asyncio.StreamReader) -> tuple[str, str, str, dict[str, str]] | None:
     """Read request line + headers. Returns None on clean EOF between requests."""
     try:
@@ -42,6 +51,11 @@ async def _read_headers(reader: asyncio.StreamReader) -> tuple[str, str, str, di
     except asyncio.LimitOverrunError as e:
         raise HTTPProtocolError(431, "headers too large") from e
     lines = block.decode("latin-1").split("\r\n")
+    # a CR surviving the CRLF split is a bare CR (RFC 9112 2.2) — parsers
+    # that treat it as a terminator would frame this head differently
+    for line in lines:
+        if "\r" in line:
+            raise HTTPProtocolError(400, "bare CR in header")
     request_line = lines[0]
     parts = request_line.split(" ")
     if len(parts) != 3:
@@ -58,13 +72,45 @@ async def _read_headers(reader: asyncio.StreamReader) -> tuple[str, str, str, di
     for line in lines[1:]:
         if not line:
             continue
+        # obs-fold (RFC 7230 3.2.4): a continuation line would otherwise
+        # parse as a fresh header and desync against proxies that unfold
+        if line[0] in " \t":
+            raise HTTPProtocolError(400, "obsolete line folding")
         if ":" not in line:
             raise HTTPProtocolError(400, "malformed header")
         k, _, v = line.partition(":")
         k = k.strip()
         if not k:  # RFC 9112: field names are non-empty tokens
             raise HTTPProtocolError(400, "malformed header")
-        headers[k.lower()] = v.strip()
+        k = k.lower()
+        v = v.strip()
+        # duplicate Content-Length with a different value is a smuggling
+        # vector (proxies disagree on which wins) -> hard 400. Compare
+        # PARSED values, clamped at the cap, mirroring the native codec
+        # ("5" vs "05" is not a conflict; two oversized values both mean
+        # "too large" and 413 later).
+        if k == "content-length":
+            # digits only, validated per-line like the native codec ('+5',
+            # '5_0' etc. must not frame a body a strict peer rejects)
+            if not (v.isascii() and v.isdigit()):
+                raise HTTPProtocolError(400, "bad content-length")
+            if k in headers and headers[k] != v:
+                a = min(int(headers[k]), MAX_BODY_BYTES + 1)
+                b = min(int(v), MAX_BODY_BYTES + 1)
+                if a != b:
+                    raise HTTPProtocolError(400, "conflicting content-length")
+        # the FINAL transfer coding must be chunked or the body length is
+        # undefined (RFC 7230 3.3.3); checked per-line like the native
+        # codec so a smuggled first line can't hide behind dict last-wins
+        if k == "transfer-encoding":
+            last = v.rsplit(",", 1)[-1].strip()
+            if last.lower() != "chunked":
+                raise HTTPProtocolError(400, "unsupported transfer-encoding")
+        headers[k] = v
+    # Transfer-Encoding and Content-Length together is the canonical
+    # request-smuggling ambiguity -> reject
+    if "transfer-encoding" in headers and "content-length" in headers:
+        raise HTTPProtocolError(400, "content-length with transfer-encoding")
     return method.upper(), target, version, headers
 
 
@@ -75,10 +121,15 @@ async def _read_body(reader: asyncio.StreamReader, headers: dict[str, str]) -> b
         total = 0
         while True:
             size_line = await reader.readline()
-            try:
-                size = int(size_line.strip().split(b";")[0], 16)
-            except ValueError as e:
-                raise HTTPProtocolError(400, "bad chunk size") from e
+            hexpart = size_line.strip().split(b";")[0]
+            # strict hex only — int(x, 16) also accepts '0x10', '1_0' and
+            # '-5' (negative would crash readexactly), none of which the
+            # native codec or an RFC-strict peer frames the same way
+            if not hexpart or any(
+                c not in b"0123456789abcdefABCDEF" for c in hexpart
+            ):
+                raise HTTPProtocolError(400, "bad chunk size")
+            size = int(hexpart, 16)
             if size == 0:
                 # trailers until blank line
                 while (await reader.readline()).strip():
@@ -97,6 +148,8 @@ async def _read_body(reader: asyncio.StreamReader, headers: dict[str, str]) -> b
         n = int(cl)
     except ValueError as e:
         raise HTTPProtocolError(400, "bad content-length") from e
+    if n < 0:
+        raise HTTPProtocolError(400, "bad content-length")
     if n > MAX_BODY_BYTES:
         raise HTTPProtocolError(413, "body too large")
     if n == 0:
@@ -216,9 +269,13 @@ class AsyncHTTPServer:
         self, writer: asyncio.StreamWriter, resp: Response, method: str, close: bool
     ) -> None:
         head = [_status_line(resp.status)]
-        seen = {k.lower() for k, _ in resp.headers}
+        # 'seen' must reflect the names as EMITTED (post-sanitization) or a
+        # CR/LF-bearing name could coexist with the auto-added framing line
+        seen = set()
         for k, v in resp.headers:
-            head.append(f"{k}: {v}\r\n".encode("latin-1"))
+            ck = _clean_header(k)
+            seen.add(ck.lower())
+            head.append(f"{ck}: {_clean_header(v)}\r\n".encode("latin-1"))
         if close:
             head.append(b"Connection: close\r\n")
         if resp.stream is not None and method != "HEAD":

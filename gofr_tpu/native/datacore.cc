@@ -44,15 +44,26 @@ PyObject *gather_windows(PyObject *, PyObject *args) {
   } else if (starts.len % Py_ssize_t(sizeof(long long)) != 0) {
     PyErr_SetString(PyExc_ValueError, "starts must be int64");
     err = Py_None;
+  } else if (window > PY_SSIZE_T_MAX / itemsize) {
+    PyErr_SetString(PyExc_ValueError, "window too large");
+    err = Py_None;
   } else if ((row_bytes = window * itemsize,
               src_elems = src.len / itemsize,
-              out.len < n * row_bytes)) {
+              row_bytes > 0 && n > PY_SSIZE_T_MAX / row_bytes)) {
+    // n * row_bytes below must not wrap
+    PyErr_SetString(PyExc_ValueError, "batch too large");
+    err = Py_None;
+  } else if (out.len < n * row_bytes) {
     PyErr_SetString(PyExc_ValueError, "out buffer too small");
     err = Py_None;
+  } else if (window > src_elems) {
+    PyErr_SetString(PyExc_ValueError, "window exceeds source length");
+    err = Py_None;
   } else {
-    // bounds-check before dropping the GIL
+    // bounds-check before dropping the GIL; phrased as idx > limit (not
+    // idx + window > elems) so a hostile start offset cannot wrap int64
     for (Py_ssize_t i = 0; i < n; ++i) {
-      if (idx[i] < 0 || idx[i] + window > src_elems) {
+      if (idx[i] < 0 || idx[i] > (long long)(src_elems - window)) {
         PyErr_Format(PyExc_IndexError,
                      "window %zd at element %lld out of range (%zd elements)",
                      i, idx[i], src_elems);

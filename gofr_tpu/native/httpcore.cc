@@ -98,6 +98,12 @@ PyObject *parse(PyObject *, PyObject *args) {
     const char *line_end = static_cast<const char *>(
         memchr(p, '\r', static_cast<size_t>(head_end - p + 1)));
     if (!line_end) line_end = head_end;
+    // bare CR is not a line terminator (RFC 9112 2.2) — treating it as one
+    // while a peer parser doesn't is a request-smuggling differential
+    if (line_end[1] != '\n') {
+      PyBuffer_Release(&view);
+      return http_error(400, "bare CR in request line");
+    }
     const char *sp1 = static_cast<const char *>(
         memchr(p, ' ', static_cast<size_t>(line_end - p)));
     if (!sp1) {
@@ -147,6 +153,7 @@ PyObject *parse(PyObject *, PyObject *args) {
     Py_ssize_t content_length = -1;
     int flags = 0;
     bool bad = false;
+    bool saw_cl = false, saw_te = false;
     int bad_status = 400;
     const char *bad_msg = "malformed header";
     p = (line_end < head_end) ? line_end + 2 : head_end;
@@ -155,7 +162,13 @@ PyObject *parse(PyObject *, PyObject *args) {
       const char *eol = static_cast<const char *>(
           memchr(p, '\r', static_cast<size_t>(head_end - p + 1)));
       if (!eol) eol = head_end;
+      if (eol[1] != '\n') {  // bare CR inside a field line (RFC 9112 2.2)
+        bad = true; bad_msg = "bare CR in header"; break;
+      }
       if (eol == p) { p = eol + 2; continue; }  // empty line
+      // obs-fold (RFC 7230 3.2.4): a continuation line would otherwise
+      // parse as a fresh header and desync against proxies that unfold
+      if (is_ows(*p)) { bad = true; bad_msg = "obsolete line folding"; break; }
       const char *colon = static_cast<const char *>(
           memchr(p, ':', static_cast<size_t>(eol - p)));
       if (!colon) { bad = true; break; }
@@ -180,13 +193,27 @@ PyObject *parse(PyObject *, PyObject *args) {
           else cl = cl * 10 + (*q - '0');
         }
         if (bad) break;
-        // a numeric but oversized length is 413, not 400 (server.py parity)
-        content_length = overflow ? MAX_BODY + 1 : cl;
-      } else if (klen == 17 && memcmp(keybuf, "transfer-encoding", 17) == 0) {
-        // value contains "chunked" (case-insensitive)?
-        for (const char *q = vb; q + 7 <= ve; ++q) {
-          if (ieq(q, 7, "chunked")) { flags |= F_CHUNKED; break; }
+        Py_ssize_t parsed = overflow ? MAX_BODY + 1 : cl;
+        // duplicate Content-Length with a different value is a smuggling
+        // vector (proxies disagree on which wins) -> hard 400
+        if (saw_cl && parsed != content_length) {
+          bad = true; bad_msg = "conflicting content-length"; break;
         }
+        saw_cl = true;
+        // a numeric but oversized length is 413, not 400 (server.py parity)
+        content_length = parsed;
+      } else if (klen == 17 && memcmp(keybuf, "transfer-encoding", 17) == 0) {
+        // RFC 7230 3.3.3: the FINAL coding must be chunked; anything else
+        // (e.g. "gzip") would leave the body length undefined and lets a
+        // front proxy frame the stream differently than we do -> 400
+        saw_te = true;
+        const char *lb = vb, *le = ve;  // last comma-separated token
+        for (const char *q = ve; q > vb; --q) {
+          if (q[-1] == ',') { lb = q; break; }
+        }
+        strip_ows(lb, le);
+        if (le - lb == 7 && ieq(lb, 7, "chunked")) flags |= F_CHUNKED;
+        else { bad = true; bad_msg = "unsupported transfer-encoding"; break; }
       } else if (klen == 10 && memcmp(keybuf, "connection", 10) == 0) {
         if (ieq(vb, ve - vb, "close")) flags |= F_CLOSE;
         else if (ieq(vb, ve - vb, "keep-alive")) flags |= F_KEEPALIVE;
@@ -204,6 +231,12 @@ PyObject *parse(PyObject *, PyObject *args) {
       }
       Py_DECREF(key); Py_DECREF(val);
       p = eol + 2;
+    }
+    // Transfer-Encoding and Content-Length together is the canonical
+    // request-smuggling ambiguity (RFC 7230 3.3.3 says TE wins, but
+    // proxies differ) -> reject outright
+    if (!bad && saw_te && saw_cl) {
+      bad = true; bad_msg = "content-length with transfer-encoding";
     }
     if (bad) {
       Py_DECREF(method); Py_DECREF(target); Py_DECREF(headers);
@@ -422,6 +455,11 @@ PyObject *parse_chunked_step(PyObject *, PyObject *args) {
     spans[nspans][1] = size;
     ++nspans;
     total += size;
+    if (total > MAX_BODY) {
+      if (spans != static_spans) PyMem_Free(spans);
+      PyBuffer_Release(&view);
+      return http_error(413, "body too large");
+    }
     p += size;
     if (buf[p] != '\r' || buf[p + 1] != '\n') {
       if (spans != static_spans) PyMem_Free(spans);
@@ -499,11 +537,29 @@ PyObject *build_head(PyObject *, PyObject *args) {
     const char *k = PyUnicode_AsUTF8AndSize(PyTuple_GET_ITEM(item, 0), &kl);
     const char *v = PyUnicode_AsUTF8AndSize(PyTuple_GET_ITEM(item, 1), &vl);
     if (!k || !v) { Py_DECREF(seq); return nullptr; }
+    // CR/LF/NUL in a name or value would let a handler echoing untrusted
+    // input split the response (Go's net/http sanitizes these too)
+    for (Py_ssize_t j = 0; j < kl; ++j) {
+      char c = k[j];
+      if (c == '\r' || c == '\n' || c == '\0') {
+        Py_DECREF(seq);
+        PyErr_SetString(PyExc_ValueError, "invalid header name");
+        return nullptr;
+      }
+    }
+    for (Py_ssize_t j = 0; j < vl; ++j) {
+      char c = v[j];
+      if (c == '\r' || c == '\n' || c == '\0') {
+        Py_DECREF(seq);
+        PyErr_SetString(PyExc_ValueError, "invalid header value");
+        return nullptr;
+      }
+    }
     need += size_t(kl) + size_t(vl) + 4;
     if (kl == 14 && ieq(k, 14, "content-length")) has_cl = true;
     if (kl == 17 && ieq(k, 17, "transfer-encoding")) has_te = true;
   }
-  need += 32 /* content-length line */ + 32 /* te/conn lines */;
+  need += 48 /* content-length line */ + 48 /* te/conn lines */;
   const char *body_buf = nullptr;
   Py_ssize_t body_len = 0;
   if (body != Py_None) {
@@ -542,7 +598,9 @@ PyObject *build_head(PyObject *, PyObject *args) {
     memcpy(w, "Transfer-Encoding: chunked\r\n", 28); w += 28;
   }
   if (!chunked && !has_cl && content_length >= 0) {
-    w += snprintf(w, 32, "Content-Length: %zd\r\n", content_length);
+    // %zd of a 64-bit value can need 37 bytes incl. terminator; bound at
+    // 48 (reserved above) so snprintf can never truncate and over-advance
+    w += snprintf(w, 48, "Content-Length: %zd\r\n", content_length);
   }
   *w++ = '\r'; *w++ = '\n';
   if (body_buf && body_len) {

@@ -52,6 +52,22 @@ async def echo_dispatch(req: Request) -> Response:
         return Response(200, [], stream=gen())
     if req.path == "/boom":
         raise RuntimeError("handler exploded")
+    if req.path == "/echo-header":
+        # reflects untrusted input into a response header — the serializers
+        # must strip CR/LF so this cannot split the response. The taint is
+        # injected handler-side (a client can't put raw CRLF in a header:
+        # the request parser rejects it).
+        val = req.header("x-probe") or ""
+        if "taint" in req.query:
+            val += "\r\nSet-Cookie: pwn=1"
+        return Response(200, [("X-Echo", val)], b"ok")
+    if req.path == "/evil-stream":
+        async def gen():
+            yield b"alpha"
+            yield b"beta"
+        return Response(
+            200, [("X-Echo", "a\r\nSet-Cookie: pwn=1")], stream=gen()
+        )
     payload = {
         "method": req.method,
         "path": req.path,
@@ -268,6 +284,43 @@ async def test_unhandled_dispatch_error_returns_500(server_cls):
             b"POST / HTTP/1.1\r\nTransfer-Encoding: chunked\r\n\r\nzz\r\n",
             b"400",
         ),
+        # request-smuggling surfaces (ADVICE r4): both parsers must reject
+        (
+            b"POST / HTTP/1.1\r\nContent-Length: 5\r\nContent-Length: 6\r\n\r\nhello",
+            b"400",
+        ),  # conflicting duplicate Content-Length
+        (
+            b"POST / HTTP/1.1\r\nContent-Length: 5\r\nTransfer-Encoding: chunked\r\n\r\n0\r\n\r\n",
+            b"400",
+        ),  # CL + TE together
+        (
+            b"POST / HTTP/1.1\r\nTransfer-Encoding: gzip\r\n\r\n",
+            b"400",
+        ),  # TE without chunked as final coding
+        (
+            b"POST / HTTP/1.1\r\nTransfer-Encoding: chunked, gzip\r\n\r\n",
+            b"400",
+        ),  # chunked not final
+        (
+            b"GET / HTTP/1.1\r\nHost: t\r\n folded-continuation\r\n\r\n",
+            b"400",
+        ),  # obs-fold
+        (
+            b"GET / HTTP/1.1\r\nA: v\rX-Smuggle: x\r\n\r\n",
+            b"400",
+        ),  # bare CR is not a line terminator (RFC 9112 2.2)
+        (
+            b"POST / HTTP/1.1\r\nContent-Length: +5\r\n\r\nhello",
+            b"400",
+        ),  # CL must be digits only
+        (
+            b"POST / HTTP/1.1\r\nTransfer-Encoding: chunked\r\n\r\n-5\r\n\r\n",
+            b"400",
+        ),  # negative chunk size
+        (
+            b"POST / HTTP/1.1\r\nTransfer-Encoding: chunked\r\n\r\n0x10\r\n\r\n",
+            b"400",
+        ),  # 0x-prefixed chunk size
     ],
 )
 @async_test
@@ -275,6 +328,99 @@ async def test_protocol_errors(server_cls, raw, expect_status):
     async with serving(server_cls) as (srv, connect):
         data = await _talk(connect, raw)
         assert data.split(b" ")[1].startswith(expect_status), data[:100]
+
+
+@async_test
+async def test_response_splitting_neutralized(server_cls):
+    """A handler echoing CR/LF-bearing input into a response header must not
+    produce a second response head (ADVICE r4: response splitting) — driven
+    end-to-end over the wire against both servers."""
+    async with serving(server_cls) as (srv, connect):
+        # clean value round-trips
+        data = await _talk(
+            connect,
+            b"GET /echo-header HTTP/1.1\r\nHost: t\r\n"
+            b"X-Probe: clean-value\r\nConnection: close\r\n\r\n",
+        )
+        assert b"X-Echo: clean-value" in data
+
+        # handler-injected CRLF taint: stripped, single response, no
+        # Set-Cookie line anywhere in the head
+        data = await _talk(
+            connect,
+            b"GET /echo-header?taint=1 HTTP/1.1\r\nHost: t\r\n"
+            b"X-Probe: evil\r\nConnection: close\r\n\r\n",
+        )
+        assert data.startswith(b"HTTP/1.1 200")
+        head_lines = data.split(b"\r\n\r\n")[0].split(b"\r\n")
+        assert not any(l.startswith(b"Set-Cookie:") for l in head_lines)
+        assert sum(l.startswith(b"Content-Length:") for l in head_lines) == 1
+
+    # regression for the seen-set: a CR/LF-bearing header NAME must not
+    # yield a second conflicting Content-Length line
+    from gofr_tpu.http.nativeserver import _py_serialize
+
+    resp = Response(200, [("Content-Length\n", "999")], b"ok")
+    out = _py_serialize(resp, resp.body, False)
+    head_lines = out.split(b"\r\n\r\n")[0].split(b"\r\n")
+    cl_lines = [l for l in head_lines if l.lower().startswith(b"content-length:")]
+    assert cl_lines == [b"Content-Length: 999"]
+
+
+@async_test
+async def test_response_splitting_streaming_path(server_cls):
+    """Tainted headers on a STREAMING response must be sanitized and the
+    stream served — not the connection aborted (code-review finding: the
+    native server's _write_stream had no fallback when the strict C
+    serializer rejects a tainted header)."""
+    async with serving(server_cls) as (srv, connect):
+        data = await _talk(
+            connect,
+            b"GET /evil-stream HTTP/1.1\r\nHost: t\r\nConnection: close\r\n\r\n",
+        )
+        assert data.startswith(b"HTTP/1.1 200")
+        head = data.split(b"\r\n\r\n")[0]
+        assert not any(
+            l.startswith(b"Set-Cookie:") for l in head.split(b"\r\n")
+        )
+        assert b"Transfer-Encoding: chunked" in head
+        assert b"alpha" in data and b"beta" in data and data.endswith(b"0\r\n\r\n")
+
+
+@needs_codec
+def test_codec_smuggling_rejections():
+    """Unit-level coverage of the ADVICE r4 desync fixes."""
+    # same-value duplicate Content-Length stays accepted (lenient per RFC)
+    r = codec.parse(b"POST / HTTP/1.1\r\nContent-Length: 5\r\nContent-Length: 5\r\n\r\n")
+    assert r is not None and r[5] == 5
+    # gzip, chunked (chunked final) accepted and flagged chunked
+    r = codec.parse(b"POST / HTTP/1.1\r\nTransfer-Encoding: gzip, chunked\r\n\r\n")
+    assert r is not None and r[6] & codec.F_CHUNKED
+    with pytest.raises(ValueError) as ei:
+        codec.parse(b"POST / HTTP/1.1\r\nContent-Length: 5\r\nContent-Length: 6\r\n\r\n")
+    assert ei.value.args[0] == 400
+    with pytest.raises(ValueError) as ei:
+        codec.parse(b"POST / HTTP/1.1\r\nContent-Length: 5\r\nTransfer-Encoding: chunked\r\n\r\n")
+    assert ei.value.args[0] == 400
+    with pytest.raises(ValueError) as ei:
+        codec.parse(b"POST / HTTP/1.1\r\nTransfer-Encoding: identity\r\n\r\n")
+    assert ei.value.args[0] == 400
+    # build_head rejects CR/LF/NUL in names and values
+    for bad in ("a\rb", "a\nb", "a\x00b"):
+        with pytest.raises(ValueError):
+            codec.build_head(200, [("X-H", bad)], -1, 0, 0)
+        with pytest.raises(ValueError):
+            codec.build_head(200, [(bad, "v")], -1, 0, 0)
+
+
+@needs_codec
+def test_codec_chunked_step_enforces_body_cap():
+    """parse_chunked_step must 413 when accumulated chunks exceed MAX_BODY
+    even if each individual chunk is under the cap (ADVICE r4 low)."""
+    chunk = b"3c00000\r\n" + b"a" * 0x3C00000 + b"\r\n"  # 60 MiB
+    with pytest.raises(ValueError) as ei:
+        codec.parse_chunked_step(chunk * 2, 0)
+    assert ei.value.args[0] == 413
 
 
 @async_test
