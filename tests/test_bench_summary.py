@@ -1,0 +1,65 @@
+"""The driver records only the tail of bench.py's stdout; round 4's
+artifact clipped the headline fields out entirely (VERDICT r4 weak #1).
+These tests pin the contract: the FINAL printed line is a compact,
+self-contained JSON object carrying every adjudicated number, small
+enough to always survive a 2000-byte tail capture.
+"""
+
+import json
+
+import bench
+
+
+def _serving_result():
+    return {
+        "metric": "gemma2b_serving_qps_per_chip",
+        "value": 360.0,
+        "unit": "req/s (16-tok completions)",
+        "vs_baseline": 0.36,
+        "detail": {
+            "qps": 360.0,
+            "engine_vs_ceiling": 0.951,
+            "device_ceiling_sustained_qps": 379.0,
+            "device": "TPU v5e",
+            "slo_point": {"steady_qps": 294.8, "p99_over_p50": 1.6},
+            "short_prompt_8tok": {
+                "qps": 1069.0,
+                "latency_vs_load": [
+                    {"offered_qps": 25.0, "p50_ms": 93.0},
+                    {"offered_qps": 50.0, "p50_ms": 95.0},
+                ],
+            },
+            "subruns": {"greet_qps_cpu": 4050.0, "mlp_qps": 9100.0},
+            "latency_vs_load": [{"offered_qps": 50, "p50_ms": 400.0}],
+        },
+    }
+
+
+def test_summary_line_contains_all_headline_fields():
+    s = bench._summary_line(_serving_result())
+    assert s["metric"] == "gemma2b_serving_qps_per_chip"
+    assert s["value"] == 360.0
+    assert s["vs_baseline"] == 0.36
+    assert s["engine_vs_ceiling"] == 0.951
+    assert s["slo_steady_qps"] == 294.8
+    assert s["short_prompt_qps"] == 1069.0
+    assert s["short_prompt_lowload_p50_ms"] == 93.0
+    assert s["greet_qps"] == 4050.0
+    assert s["mlp_qps"] == 9100.0
+
+
+def test_summary_line_fits_tail_capture():
+    line = json.dumps(bench._summary_line(_serving_result()))
+    assert len(line) < 1500  # driver keeps a 2000-byte tail
+    # and it parses standalone as a {"metric": ...} object
+    assert json.loads(line)["metric"]
+
+
+def test_summary_line_minimal_result():
+    """mlp/greet results carry a flat detail; missing keys must not crash."""
+    s = bench._summary_line(
+        {"metric": "m", "value": 1, "unit": "u", "vs_baseline": 0.1,
+         "detail": {"p50_ms": 3.0, "device": "cpu"}}
+    )
+    assert s == {"metric": "m", "value": 1, "unit": "u", "vs_baseline": 0.1,
+                 "device": "cpu", "p50_ms": 3.0}
