@@ -403,6 +403,12 @@ class LLMEngine:
                     gap if self._ema_gap is None else 0.8 * self._ema_gap + 0.2 * gap
                 )
         self._admit_q.put(req)
+        # TOCTOU with _die()/close(): if the engine stopped between the
+        # _stop check above and this put, its one-shot drain may already
+        # have run and nothing will ever read the queue again — drain it
+        # ourselves so the request cannot hang until stream timeout
+        if self._stop:
+            self._drain_pending()
         self._kick.set()
         return req
 
@@ -445,6 +451,16 @@ class LLMEngine:
             + self._admitting
         )
 
+    def alive(self) -> bool:
+        """Health signal for the replica router: the engine accepts work
+        only while both its threads run and neither close() nor a terminal
+        thread failure (_die) has begun."""
+        return (
+            not self._stop
+            and self._thread.is_alive()
+            and self._collector.is_alive()
+        )
+
     def close(self) -> None:
         self._stop = True
         self._admit_q.put(None)
@@ -456,16 +472,26 @@ class LLMEngine:
             self._work_cv.notify_all()
         self._collector.join(timeout=15)
         self._abort_all()
-        for req in self._waiting:
-            req.out.put(None)
-        self._waiting = []
+        self._drain_pending()
+
+    def _drain_pending(self) -> None:
+        """End-of-stream every request still in the waiting list or the
+        admit queue (shared by close() and _die()): consumers see a
+        'cancelled' finish instead of blocking until stream timeout."""
+        with self._lock:
+            waiting, self._waiting = self._waiting, []
+        for r in waiting:
+            if r.finish_reason is None:
+                r.finish_reason = "cancelled"
+                r.out.put(None)
         while True:
             try:
-                req = self._admit_q.get_nowait()
+                r = self._admit_q.get_nowait()
             except queue.Empty:
                 break
-            if req is not None:
-                req.out.put(None)
+            if r is not None and r.finish_reason is None:
+                r.finish_reason = "cancelled"
+                r.out.put(None)
 
     # -- engine internals -------------------------------------------------
     def _warm(self) -> None:
@@ -596,7 +622,9 @@ class LLMEngine:
         """Decode steps still required to finish every current occupant,
         beyond what is already in flight — the dispatch gate. Bounds
         speculation by real demand (an upper bound under eos/cancel, which
-        the host cannot project)."""
+        the host cannot project). A fresh occupant's un-fetched prefill
+        entry is NOT extra demand: _inflight_steps counts the first token
+        that entry carries, so `remaining` already discounts it."""
         steps = self._inflight_steps()
         worst = 0
         for i, r in enumerate(self._slot_req):
@@ -855,27 +883,52 @@ class LLMEngine:
 
     def _schedule_loop(self) -> None:
         jnp = self._jnp
-        while not self._stop:
-            try:
-                did = self._admit()
-                if self._stop:
-                    break
-                with self._lock:
-                    depth = sum(1 for e in self._inflight if e[0] == "chunk")
-                    if self._processing is not None and self._processing[0] == "chunk":
-                        depth += 1
-                    needed = self._needed_steps()
-                    want = min(-(-needed // self.decode_chunk), self.lookahead - depth)
-                for _ in range(max(0, want)):
-                    needed = max(0, needed - self._dispatch(needed))
-                if not did and want <= 0:
-                    self._kick.wait(timeout=0.005)
-                    self._kick.clear()
-            except Exception as e:  # noqa: BLE001 — engine must not die silently
-                if self.logger is not None:
-                    self.logger.error(f"LLM engine step failed: {e!r}")
-                self._recover_all()
-                time.sleep(0.1)
+        try:
+            while not self._stop:
+                try:
+                    did = self._admit()
+                    if self._stop:
+                        break
+                    with self._lock:
+                        depth = sum(1 for e in self._inflight if e[0] == "chunk")
+                        if self._processing is not None and self._processing[0] == "chunk":
+                            depth += 1
+                        needed = self._needed_steps()
+                        want = min(-(-needed // self.decode_chunk), self.lookahead - depth)
+                    for _ in range(max(0, want)):
+                        needed = max(0, needed - self._dispatch(needed))
+                    if not did and want <= 0:
+                        self._kick.wait(timeout=0.005)
+                        self._kick.clear()
+                except Exception as e:  # noqa: BLE001 — engine must not die silently
+                    if self.logger is not None:
+                        self.logger.error(f"LLM engine step failed: {e!r}")
+                    self._recover_all()
+                    time.sleep(0.1)
+        finally:
+            # Anything that escapes the per-iteration handler (BaseException,
+            # a failure inside recovery itself) would otherwise leave a
+            # zombie engine: queued requests hang until stream timeout and
+            # the replica router keeps feeding it. Die loudly instead.
+            if not self._stop:
+                self._die("scheduler thread exited unexpectedly")
+
+    def _die(self, why: str) -> None:
+        """Terminal thread failure: mark the engine dead (alive() -> False,
+        submit() refuses), then end-of-stream every reachable request —
+        occupants, in-flight snapshots, the waiting list, and the admit
+        queue — so no consumer blocks until its stream timeout."""
+        self._stop = True
+        if self.logger is not None:
+            self.logger.error(f"LLM engine died: {why}")
+        try:
+            self._recover_all()
+        except Exception:  # noqa: BLE001 — draining must not re-raise
+            pass
+        self._drain_pending()
+        self._kick.set()
+        with self._work_cv:
+            self._work_cv.notify_all()
 
     def _recover_all(self) -> None:
         """Full-stop recovery: close every request reachable from in-flight
@@ -905,6 +958,13 @@ class LLMEngine:
             self._abort_all()
 
     def _collect_loop(self) -> None:
+        try:
+            self._collect_loop_inner()
+        finally:
+            if not self._stop:  # see _schedule_loop's finally
+                self._die("collector thread exited unexpectedly")
+
+    def _collect_loop_inner(self) -> None:
         while True:
             with self._work_cv:
                 while not self._inflight and not self._stop:
@@ -1086,13 +1146,28 @@ class ReplicatedLLMEngine:
 
     # -- routing -----------------------------------------------------------
     def _pick(self) -> "LLMEngine":
-        if self.router == "round_robin" or len(self.engines) == 1:
-            return self.engines[next(self._rr) % len(self.engines)]
-        return min(self.engines, key=lambda e: e.load())
+        """Route among LIVE replicas only. A replica whose scheduler or
+        collector thread died (LLMEngine._die) ends its own queued
+        requests; the router's job is to stop feeding it new ones."""
+        live = [e for e in self.engines if e.alive()]
+        if not live:
+            raise RuntimeError("all replicas dead")
+        if self.router == "round_robin" or len(live) == 1:
+            return live[next(self._rr) % len(live)]
+        return min(live, key=lambda e: e.load())
 
     # -- LLMEngine surface -------------------------------------------------
     def submit(self, req: GenRequest) -> GenRequest:
-        return self._pick().submit(req)
+        # a replica can die between _pick and submit; retry on the
+        # survivors (EngineOverloaded and validation errors propagate)
+        for _ in range(len(self.engines)):
+            eng = self._pick()
+            try:
+                return eng.submit(req)
+            except RuntimeError as e:
+                if "engine stopped" not in str(e):
+                    raise
+        raise RuntimeError("all replicas dead")
 
     def generate(self, prompt_tokens: list[int], **kw) -> list[int]:
         return self.submit(GenRequest(prompt_tokens, **kw)).tokens()
@@ -1104,6 +1179,7 @@ class ReplicatedLLMEngine:
         per = [e.stats() for e in self.engines]
         return {
             "replicas": len(per),
+            "replicas_alive": sum(e.alive() for e in self.engines),
             "router": self.router,
             "slots": sum(s["slots"] for s in per),
             "active": sum(s["active"] for s in per),

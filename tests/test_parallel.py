@@ -1,6 +1,8 @@
 """Parallelism tests on the virtual 8-device CPU mesh (conftest.py) — the
 same code path the driver's dryrun_multichip exercises."""
 
+import threading
+
 import jax
 import jax.numpy as jnp
 import pytest
@@ -205,6 +207,87 @@ class TestDPServing:
             d0 = set(jax.tree.leaves(eng.engines[0].params)[0].devices())
             d1 = set(jax.tree.leaves(eng.engines[1].params)[0].devices())
             assert d0.isdisjoint(d1) and len(d0) == 4 and len(d1) == 4
+        finally:
+            eng.close()
+
+    def test_replica_death_drains_queue_and_reroutes(self):
+        """When one replica's scheduler thread dies (an escape past the
+        per-iteration recovery handler), its queued requests must be
+        end-of-streamed — not silently lost or stuck until stream timeout —
+        and the router must stop feeding the dead replica (VERDICT r4 #7)."""
+        import time as _time
+
+        from gofr_tpu.llm import GenRequest, ReplicatedLLMEngine
+
+        cfg = TransformerConfig.tiny()
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        eng = ReplicatedLLMEngine(
+            cfg, params, replicas=2, slots=2, max_seq_len=64,
+            prefill_buckets=(8,), router="round_robin", warmup=False,
+        )
+        try:
+            victim, survivor = eng.engines
+            # wedge the victim's scheduler in a patched _admit, then make
+            # it raise a BaseException that escapes `except Exception`
+            entered, release = threading.Event(), threading.Event()
+
+            def dying_admit():
+                entered.set()
+                release.wait(timeout=10)
+                raise SystemExit  # daemon-thread-silent, escapes recovery
+
+            victim._admit = dying_admit
+            # wait until the scheduler is INSIDE the patch (its in-progress
+            # real _admit call could otherwise still consume the queue)
+            assert entered.wait(timeout=10)
+            # park a request in the victim's admit queue while its
+            # scheduler is wedged
+            parked = victim.submit(GenRequest([5, 9, 2], max_new_tokens=5))
+            release.set()
+            victim._thread.join(timeout=10)
+            assert not victim._thread.is_alive()
+            # death is detected and the parked request was ended, promptly
+            deadline = _time.time() + 10
+            while victim.alive() and _time.time() < deadline:
+                _time.sleep(0.01)
+            assert not victim.alive()
+            toks = parked.tokens()
+            assert parked.finish_reason == "cancelled" and toks == []
+            # router only feeds the survivor now — round-robin over 1
+            for _ in range(4):
+                r = eng.submit(GenRequest([7, 1], max_new_tokens=3))
+                assert r.tokens() == self._reference(params, cfg, [7, 1], 3)
+            st = eng.stats()
+            assert st["replicas"] == 2 and st["replicas_alive"] == 1
+            assert all(eng._pick() is survivor for _ in range(4))
+        finally:
+            eng.close()
+
+    def test_submit_racing_death_does_not_hang(self):
+        """A submit that passes the _stop check just before _die's drain
+        must still be ended (code-review TOCTOU finding): the post-put
+        re-check drains the queue itself."""
+        from gofr_tpu.llm import GenRequest, LLMEngine
+
+        cfg = TransformerConfig.tiny()
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        eng = LLMEngine(
+            cfg, params, slots=2, max_seq_len=64, prefill_buckets=(8,),
+            warmup=False,
+        )
+        try:
+            # simulate the race deterministically: flip _stop between the
+            # submit-side check and the put by patching the EMA update's
+            # lock acquisition window — simplest faithful stand-in is to
+            # run _die first but call the post-check path directly
+            eng._die("injected for race test")
+            req = GenRequest([5, 9, 2], max_new_tokens=4)
+            req.submitted_at = 0.0
+            eng._admit_q.put(req)  # what submit() does after its check
+            if eng._stop:  # the re-check submit() now performs
+                eng._drain_pending()
+            assert req.finish_reason == "cancelled"
+            assert req.tokens() == []
         finally:
             eng.close()
 
