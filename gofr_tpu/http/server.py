@@ -31,6 +31,17 @@ class HTTPProtocolError(Exception):
         self.message = message
 
 
+def _cl_value(digits: str) -> int:
+    """Parse an all-digits Content-Length, clamped at MAX_BODY_BYTES+1 like
+    the native codec (every oversized value means the same thing: too
+    large). The length pre-check keeps a multi-KB digit string from
+    tripping CPython's int-conversion digit limit (uncaught ValueError)."""
+    s = digits.lstrip("0")
+    if len(s) > 15:
+        return MAX_BODY_BYTES + 1
+    return min(int(s or "0"), MAX_BODY_BYTES + 1)
+
+
 def _clean_header(s: object) -> str:
     """Strip CR/LF/NUL so a handler echoing untrusted input into a response
     header cannot split the response (Go's net/http sanitizes these too)."""
@@ -95,9 +106,7 @@ async def _read_headers(reader: asyncio.StreamReader) -> tuple[str, str, str, di
             if not (v.isascii() and v.isdigit()):
                 raise HTTPProtocolError(400, "bad content-length")
             if k in headers and headers[k] != v:
-                a = min(int(headers[k]), MAX_BODY_BYTES + 1)
-                b = min(int(v), MAX_BODY_BYTES + 1)
-                if a != b:
+                if _cl_value(headers[k]) != _cl_value(v):
                     raise HTTPProtocolError(400, "conflicting content-length")
         # the FINAL transfer coding must be chunked or the body length is
         # undefined (RFC 7230 3.3.3); checked per-line like the native
@@ -144,12 +153,11 @@ async def _read_body(reader: asyncio.StreamReader, headers: dict[str, str]) -> b
     cl = headers.get("content-length")
     if cl is None:
         return b""
-    try:
-        n = int(cl)
-    except ValueError as e:
-        raise HTTPProtocolError(400, "bad content-length") from e
-    if n < 0:
+    if not (cl.isascii() and cl.isdigit()):
         raise HTTPProtocolError(400, "bad content-length")
+    # clamped parse: a huge digit string means "too large" (413), and must
+    # not trip CPython's int-conversion digit limit (native codec parity)
+    n = _cl_value(cl)
     if n > MAX_BODY_BYTES:
         raise HTTPProtocolError(413, "body too large")
     if n == 0:

@@ -321,6 +321,15 @@ async def test_unhandled_dispatch_error_returns_500(server_cls):
             b"POST / HTTP/1.1\r\nTransfer-Encoding: chunked\r\n\r\n0x10\r\n\r\n",
             b"400",
         ),  # 0x-prefixed chunk size
+        (
+            b"POST / HTTP/1.1\r\nContent-Length: " + b"9" * 4400 + b"\r\n\r\n",
+            b"413",
+        ),  # digit string past CPython's int limit: oversized, not a crash
+        (
+            b"POST / HTTP/1.1\r\nContent-Length: " + b"9" * 4400
+            + b"\r\nContent-Length: " + b"8" * 4400 + b"\r\n\r\n",
+            b"413",
+        ),  # two different oversized values both clamp to "too large"
     ],
 )
 @async_test
