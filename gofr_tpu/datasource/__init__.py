@@ -26,3 +26,23 @@ class ErrorDB(Exception):
 
 def health(status: str, **details) -> dict:
     return {"status": status, "details": details}
+
+
+def tls_from_config(config, prefix: str):
+    """Shared env -> ssl.SSLContext convention for the wire datasources
+    (redis/kafka/mqtt/mongo) and servers: {PREFIX}_TLS=true enables TLS,
+    {PREFIX}_TLS_CA_CERT points at a PEM bundle, and
+    {PREFIX}_TLS_INSECURE=true skips verification (dev only). Returns
+    None when TLS is off. The reference gets this surface for free from
+    its driver libraries (e.g. service/new.go:68-89 accepts https
+    addresses); here it is one explicit convention for every client."""
+    if str(config.get(f"{prefix}_TLS") or "").lower() not in ("1", "true", "yes"):
+        return None
+    import ssl
+
+    ca = config.get(f"{prefix}_TLS_CA_CERT")
+    ctx = ssl.create_default_context(cafile=ca or None)
+    if str(config.get(f"{prefix}_TLS_INSECURE") or "").lower() in ("1", "true", "yes"):
+        ctx.check_hostname = False
+        ctx.verify_mode = ssl.CERT_NONE
+    return ctx

@@ -43,6 +43,83 @@ def capture_stderr() -> Iterator[io.StringIO]:
         sys.stderr = old
 
 
+_CERT_CACHE: tuple[str, str] | None = None
+
+
+def self_signed_cert() -> tuple[str, str]:
+    """Generate (once per process) a self-signed localhost certificate and
+    key, returning (cert_pem_path, key_pem_path). SANs cover localhost and
+    127.0.0.1 so a verifying client context with cafile=cert_path passes
+    full hostname checking — TLS tests exercise the real verification
+    path, not verify_mode=CERT_NONE."""
+    global _CERT_CACHE
+    if _CERT_CACHE is not None:
+        return _CERT_CACHE
+    import datetime
+    import ipaddress
+    import tempfile
+
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import rsa
+    from cryptography.x509.oid import NameOID
+
+    key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    name = x509.Name([x509.NameAttribute(NameOID.COMMON_NAME, "localhost")])
+    now = datetime.datetime.now(datetime.timezone.utc)
+    cert = (
+        x509.CertificateBuilder()
+        .subject_name(name)
+        .issuer_name(name)
+        .public_key(key.public_key())
+        .serial_number(x509.random_serial_number())
+        .not_valid_before(now - datetime.timedelta(minutes=5))
+        .not_valid_after(now + datetime.timedelta(days=1))
+        .add_extension(
+            x509.SubjectAlternativeName(
+                [
+                    x509.DNSName("localhost"),
+                    x509.IPAddress(ipaddress.IPv4Address("127.0.0.1")),
+                ]
+            ),
+            critical=False,
+        )
+        .sign(key, hashes.SHA256())
+    )
+    d = tempfile.mkdtemp(prefix="gofr-tls-")
+    cert_path, key_path = f"{d}/cert.pem", f"{d}/key.pem"
+    with open(cert_path, "wb") as f:
+        f.write(cert.public_bytes(serialization.Encoding.PEM))
+    with open(key_path, "wb") as f:
+        f.write(
+            key.private_bytes(
+                serialization.Encoding.PEM,
+                serialization.PrivateFormat.TraditionalOpenSSL,
+                serialization.NoEncryption(),
+            )
+        )
+    _CERT_CACHE = (cert_path, key_path)
+    return _CERT_CACHE
+
+
+def server_tls_context():
+    """ssl.SSLContext serving the self_signed_cert() pair."""
+    import ssl
+
+    cert, key = self_signed_cert()
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    ctx.load_cert_chain(cert, key)
+    return ctx
+
+
+def client_tls_context():
+    """Verifying ssl.SSLContext trusting (only) the self_signed_cert()."""
+    import ssl
+
+    cert, _ = self_signed_cert()
+    return ssl.create_default_context(cafile=cert)
+
+
 class MiniRedis:
     """In-process RESP2 server on an ephemeral port (asyncio, own thread).
 
@@ -51,9 +128,19 @@ class MiniRedis:
     (LPUSH/RPOP), KEYS, FLUSHDB, PING, INFO, SELECT.
     """
 
-    def __init__(self):
+    def __init__(
+        self,
+        password: str | None = None,
+        username: str | None = None,
+        tls: bool = False,
+    ):
         self.data: dict[bytes, object] = {}
         self.expiry: dict[bytes, float] = {}
+        # password set -> connections must AUTH first (requirepass / ACL
+        # semantics), exercising the client's auth handshake paths
+        self.password = password
+        self.username = username
+        self.tls = tls  # serve over self_signed_cert() TLS
         self.port: int | None = None
         self._loop: asyncio.AbstractEventLoop | None = None
         self._thread: threading.Thread | None = None
@@ -70,7 +157,10 @@ class MiniRedis:
 
     def _run(self) -> None:
         async def main():
-            self._server = await asyncio.start_server(self._client, "127.0.0.1", 0)
+            self._server = await asyncio.start_server(
+                self._client, "127.0.0.1", 0,
+                ssl=server_tls_context() if self.tls else None,
+            )
             self.port = self._server.sockets[0].getsockname()[1]
             self._loop = asyncio.get_running_loop()
             self._started.set()
@@ -89,6 +179,7 @@ class MiniRedis:
 
     # -- protocol ---------------------------------------------------------
     async def _client(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+        authed = self.password is None
         try:
             while True:
                 line = (await reader.readline()).strip()
@@ -102,6 +193,22 @@ class MiniRedis:
                     assert ln[:1] == b"$"
                     size = int(ln[1:])
                     parts.append((await reader.readexactly(size + 2))[:-2])
+                if self.password is not None and parts[0].upper() == b"AUTH":
+                    pw_ok = parts[-1].decode() == self.password
+                    user_ok = len(parts) == 2 or parts[1].decode() == (
+                        self.username or "default"
+                    )
+                    if pw_ok and user_ok:
+                        authed = True
+                        writer.write(self._simple("OK"))
+                    else:
+                        writer.write(b"-WRONGPASS invalid username-password pair\r\n")
+                    await writer.drain()
+                    continue
+                if not authed:
+                    writer.write(b"-NOAUTH Authentication required.\r\n")
+                    await writer.drain()
+                    continue
                 writer.write(self._dispatch(parts))
                 await writer.drain()
         except (asyncio.IncompleteReadError, ConnectionError, AssertionError):

@@ -30,10 +30,20 @@ class FakeMongoServer:
     """Minimal mongod stand-in. `batch_size` forces cursor paging so the
     client's getMore path is exercised."""
 
-    def __init__(self, batch_size: int = 101):
+    def __init__(
+        self,
+        batch_size: int = 101,
+        users: dict[str, str] | None = None,
+        tls: bool = False,
+    ):
         self.store = InMemoryMongo()
         self.store.connect()
         self.batch_size = batch_size
+        # users set -> connections must complete a SCRAM conversation
+        # (saslStart/saslContinue) before running CRUD, like a mongod with
+        # auth enabled; tls -> serve over testutil.self_signed_cert()
+        self.users = users
+        self.tls = tls
         self._cursors: dict[int, list[dict]] = {}
         self._cursor_ids = itertools.count(1)
         self._lock = threading.Lock()
@@ -65,6 +75,14 @@ class FakeMongoServer:
             ).start()
 
     def _serve_conn(self, conn: socket.socket) -> None:
+        if self.tls:
+            from . import server_tls_context
+
+            try:
+                conn = server_tls_context().wrap_socket(conn, server_side=True)
+            except OSError:
+                return
+
         def recv_exact(n: int) -> bytes:
             buf = b""
             while len(buf) < n:
@@ -74,12 +92,13 @@ class FakeMongoServer:
                 buf += chunk
             return buf
 
+        state = {"authed": self.users is None, "scram": None}
         try:
             while True:
                 frame = mb.read_message(recv_exact)
                 rid, _, body = mb.decode_op_msg(frame)
                 try:
-                    reply = self._execute(body)
+                    reply = self._execute(body, state)
                 except _CommandError as e:
                     reply = {"ok": 0.0, "errmsg": e.args[0], "code": e.code}
                 conn.sendall(
@@ -97,7 +116,8 @@ class FakeMongoServer:
                 pass
 
     # -- command dispatch --------------------------------------------------
-    def _execute(self, body: dict) -> dict:
+    def _execute(self, body: dict, state: dict | None = None) -> dict:
+        state = state if state is not None else {"authed": True, "scram": None}
         db = body.get("$db", "test")
         if "hello" in body or "isMaster" in body:
             return {
@@ -107,6 +127,11 @@ class FakeMongoServer:
             }
         if "ping" in body:
             return {"ok": 1.0}
+        if "saslStart" in body or "saslContinue" in body:
+            return self._sasl(body, state)
+        if not state["authed"]:
+            # mongod with auth enabled: everything else is Unauthorized
+            raise _CommandError("command requires authentication", 13)
         if "find" in body:
             return self._find(db, body)
         if "getMore" in body:
@@ -127,6 +152,49 @@ class FakeMongoServer:
             self.store.drop_collection(body["drop"])
             return {"ok": 1.0, "nIndexesWas": 1}
         raise _CommandError(f"no such command: {next(iter(body))!r}", 59)
+
+    def _sasl(self, body: dict, state: dict) -> dict:
+        """SCRAM conversation (saslStart/saslContinue), mongod reply
+        shapes: {conversationId, payload, done, ok}."""
+        import hashlib
+
+        from ..datasource.scram import ScramError, ScramServer
+
+        if self.users is None:
+            raise _CommandError("authentication not enabled", 18)
+        try:
+            if "saslStart" in body:
+                mech = str(body.get("mechanism", ""))
+                users = self.users
+                if mech == "SCRAM-SHA-1":
+                    # MongoDB's SHA-1 flow uses md5(user:mongo:pwd) hex as
+                    # the effective SCRAM password (drivers' auth spec)
+                    users = {
+                        u: hashlib.md5(f"{u}:mongo:{p}".encode()).hexdigest()
+                        for u, p in users.items()
+                    }
+                state["scram"] = ScramServer(mech, users)
+                server_first = state["scram"].process_client_first(
+                    bytes(body["payload"]).decode()
+                )
+                return {
+                    "ok": 1.0, "conversationId": 1, "done": False,
+                    "payload": server_first.encode(),
+                }
+            if state["scram"] is None:
+                raise _CommandError("no SASL conversation in progress", 17)
+            payload = bytes(body.get("payload", b""))
+            if not payload:  # empty final round (no skipEmptyExchange)
+                return {"ok": 1.0, "conversationId": 1, "done": True,
+                        "payload": b""}
+            server_final = state["scram"].process_client_final(payload.decode())
+            state["authed"] = True
+            return {
+                "ok": 1.0, "conversationId": 1, "done": True,
+                "payload": server_final.encode(),
+            }
+        except ScramError as e:
+            raise _CommandError(f"Authentication failed: {e}", 18) from e
 
     def _find(self, db: str, body: dict) -> dict:
         coll = body["find"]

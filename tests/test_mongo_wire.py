@@ -223,7 +223,8 @@ class TestWireCRUD:
 
     def test_reconnects_after_connection_drop(self, db):
         db.insert_one("rc", {"v": 1})
-        db._sock.close()  # simulate broker-side drop
+        for c in db._idle:  # simulate server-side drop of pooled sockets
+            c.sock.close()
         with pytest.raises(ConnectionError):
             db.count_documents("rc")
         assert db.count_documents("rc") == 1  # next command redials
@@ -241,3 +242,163 @@ class TestContainerIntegration:
         assert mongo.find_one("c", {"v": 7})["v"] == 7
         h = app.container.health()
         assert h["mongo"]["status"] == "UP"
+
+
+class TestAuthTLSPool:
+    """SCRAM auth, TLS, and the connection pool (VERDICT r4 #2, #8):
+    handshake success AND failure paths against the fake speaking the
+    real SASL conversation."""
+
+    @pytest.fixture(scope="class")
+    def auth_server(self):
+        srv = FakeMongoServer(users={"svc": "hunter2"})
+        yield srv
+        srv.close()
+
+    def test_scram_sha256_auth_roundtrip(self, auth_server):
+        c = WireMongo(
+            "127.0.0.1", auth_server.port, "authdb",
+            username="svc", password="hunter2",
+        )
+        c.connect()
+        try:
+            c.insert_one("docs", {"v": 1})
+            assert c.count_documents("docs") == 1
+        finally:
+            c.drop_collection("docs")
+            c.close()
+
+    def test_scram_sha1_auth_roundtrip(self, auth_server):
+        c = WireMongo(
+            "127.0.0.1", auth_server.port, "authdb",
+            username="svc", password="hunter2", auth_mechanism="SCRAM-SHA-1",
+        )
+        c.connect()
+        try:
+            assert c.count_documents("none") == 0
+        finally:
+            c.close()
+
+    def test_wrong_password_rejected(self, auth_server):
+        c = WireMongo(
+            "127.0.0.1", auth_server.port, "authdb",
+            username="svc", password="wrong",
+        )
+        with pytest.raises(MongoError, match="Authentication failed"):
+            c.connect()
+        c.close()
+
+    def test_unknown_user_rejected(self, auth_server):
+        c = WireMongo(
+            "127.0.0.1", auth_server.port, "authdb",
+            username="ghost", password="hunter2",
+        )
+        with pytest.raises(MongoError, match="Authentication failed"):
+            c.connect()
+        c.close()
+
+    def test_unauthenticated_crud_rejected(self, auth_server):
+        c = WireMongo("127.0.0.1", auth_server.port, "authdb")  # no creds
+        with pytest.raises(MongoError) as ei:
+            c.insert_one("docs", {"v": 1})
+        assert ei.value.code == 13  # Unauthorized
+        c.close()
+
+    def test_tls_handshake_and_crud(self):
+        from gofr_tpu.testutil import client_tls_context
+
+        srv = FakeMongoServer(tls=True)
+        try:
+            c = WireMongo(
+                "127.0.0.1", srv.port, "tlsdb", tls=client_tls_context()
+            )
+            c.connect()
+            c.insert_one("docs", {"v": 2})
+            assert c.find_one("docs", {"v": 2})["v"] == 2
+            c.close()
+        finally:
+            srv.close()
+
+    def test_tls_client_rejects_untrusted_cert(self):
+        import ssl
+
+        srv = FakeMongoServer(tls=True)
+        try:
+            c = WireMongo("127.0.0.1", srv.port, "tlsdb", tls=True, timeout=2)
+            with pytest.raises((ssl.SSLError, ConnectionError, OSError)):
+                c.connect()
+            c.close()
+        finally:
+            srv.close()
+
+    def test_tls_with_scram_combined(self):
+        from gofr_tpu.testutil import client_tls_context
+
+        srv = FakeMongoServer(users={"svc": "pw"}, tls=True)
+        try:
+            c = WireMongo(
+                "127.0.0.1", srv.port, "db",
+                username="svc", password="pw", tls=client_tls_context(),
+            )
+            c.connect()
+            assert c.health_check()["status"] == "UP"
+            c.close()
+        finally:
+            srv.close()
+
+    def test_pooled_concurrent_crud_through_container(self, auth_server):
+        """Task: drive CRUD through the handler-visible surface
+        (container.mongo) from many threads; the pool must serve them
+        concurrently (more than one socket dialed) with no lost writes."""
+        import threading as _th
+
+        from gofr_tpu.app import App
+        from gofr_tpu.config import new_mock_config
+
+        app = App(config=new_mock_config({"APP_NAME": "pool-stress"}))
+        client = WireMongo(
+            "127.0.0.1", auth_server.port, "pooldb",
+            username="svc", password="hunter2", pool_size=3,
+        )
+        app.add_mongo(client)
+        mongo = app.container.mongo
+        errors: list[Exception] = []
+
+        def worker(i: int):
+            try:
+                for j in range(20):
+                    mongo.insert_one("stress", {"w": i, "j": j})
+                    assert mongo.find_one("stress", {"w": i, "j": j}) is not None
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        threads = [_th.Thread(target=worker, args=(i,)) for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert not errors, errors[:1]
+        assert mongo.count_documents("stress") == 8 * 20
+        assert client._total > 1  # actually pooled, not serialized on one
+        client.drop_collection("stress")
+        client.close()
+
+    def test_username_without_password_is_config_error(self, auth_server):
+        c = WireMongo(
+            "127.0.0.1", auth_server.port, "db", username="svc",
+            auth_mechanism="SCRAM-SHA-1",
+        )
+        with pytest.raises(ValueError, match="without a password"):
+            c.connect()
+        c.close()
+
+    def test_failed_auth_does_not_leak_pool_slots(self, auth_server):
+        c = WireMongo(
+            "127.0.0.1", auth_server.port, "db",
+            username="svc", password="wrong", pool_size=2,
+        )
+        for _ in range(6):  # repeated retries must not exhaust the pool cap
+            with pytest.raises(MongoError):
+                c.count_documents("x")
+        assert c._total == 0 and c._idle == []
+        c.close()
