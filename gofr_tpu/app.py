@@ -74,17 +74,34 @@ class App:
         (gofr_tpu/native), pure-Python asyncio streams server otherwise.
         GOFR_HTTP_NATIVE=0 forces the fallback; both pass the same
         conformance suite (tests/test_native_http.py)."""
+        tls = self._server_tls()
         if self.config.get_or_default("GOFR_HTTP_NATIVE", "1") != "0":
             from .http.nativeserver import NativeHTTPServer
 
             if NativeHTTPServer.available():
                 return NativeHTTPServer(
-                    self.router.dispatch, self.http_port, logger=self.logger
+                    self.router.dispatch, self.http_port, logger=self.logger,
+                    tls=tls,
                 )
             self.logger.warn(
                 "native HTTP codec unavailable; using pure-Python server"
             )
-        return AsyncHTTPServer(self.router.dispatch, self.http_port, logger=self.logger)
+        return AsyncHTTPServer(
+            self.router.dispatch, self.http_port, logger=self.logger, tls=tls
+        )
+
+    def _server_tls(self):
+        """Optional HTTPS: HTTP_TLS_CERT_FILE + HTTP_TLS_KEY_FILE PEM
+        paths (the reference terminates TLS at the ingress instead)."""
+        cert = self.config.get("HTTP_TLS_CERT_FILE")
+        key = self.config.get("HTTP_TLS_KEY_FILE")
+        if not cert or not key:
+            return None
+        import ssl
+
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        ctx.load_cert_chain(cert, key)
+        return ctx
 
     def _cors_overrides(self) -> dict[str, str]:
         """ACCESS_CONTROL_ALLOW_* env overrides -> header names."""

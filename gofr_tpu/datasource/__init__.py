@@ -28,6 +28,19 @@ def health(status: str, **details) -> dict:
     return {"status": status, "details": details}
 
 
+def wrap_tls(sock, tls, host: str):
+    """Wrap a connected socket in TLS when enabled. `tls` is None/False
+    (off), True (default verifying context), or an ssl.SSLContext. One
+    helper so SNI/timeout fixes land in every blocking-socket client
+    (kafka/mqtt/mongo) at once."""
+    if tls is None or tls is False:
+        return sock
+    import ssl
+
+    ctx = ssl.create_default_context() if tls is True else tls
+    return ctx.wrap_socket(sock, server_hostname=host)
+
+
 def tls_from_config(config, prefix: str):
     """Shared env -> ssl.SSLContext convention for the wire datasources
     (redis/kafka/mqtt/mongo) and servers: {PREFIX}_TLS=true enables TLS,

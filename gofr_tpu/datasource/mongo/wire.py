@@ -57,14 +57,9 @@ class _Conn:
             (owner.host, owner.port), timeout=owner.timeout
         )
         raw.settimeout(owner.timeout)
-        if owner.tls is not None and owner.tls is not False:
-            import ssl
+        from .. import wrap_tls
 
-            ctx = (
-                ssl.create_default_context() if owner.tls is True else owner.tls
-            )
-            raw = ctx.wrap_socket(raw, server_hostname=owner.host)
-        self.sock = raw
+        self.sock = wrap_tls(raw, owner.tls, owner.host)
 
     def close(self) -> None:
         try:

@@ -54,6 +54,14 @@ class KafkaConfig:
             hp.strip()
             for hp in (config.get("PUBSUB_BROKER") or "localhost:9092").split(",")
         ]
+        # SASL (PLAIN / SCRAM-SHA-256 / SCRAM-SHA-1) + TLS: the surface the
+        # reference inherits from segmentio/kafka-go's sasl + TLSConfig
+        self.sasl_mechanism = config.get("KAFKA_SASL_MECHANISM") or None
+        self.sasl_username = config.get("KAFKA_SASL_USERNAME") or None
+        self.sasl_password = config.get("KAFKA_SASL_PASSWORD") or None
+        from .. import tls_from_config
+
+        self.tls = tls_from_config(config, "KAFKA")
         self.group = config.get_or_default("KAFKA_CONSUMER_GROUP", "gofr-consumer")
         self.batch_size = int(config.get_or_default("KAFKA_BATCH_SIZE", "100"))
         self.batch_bytes = int(config.get_or_default("KAFKA_BATCH_BYTES", str(1 << 20)))
@@ -67,21 +75,114 @@ class KafkaConfig:
 
 
 class _Broker:
-    """One TCP connection to one broker, request/response under a lock."""
+    """One TCP connection to one broker, request/response under a lock.
+    On (re)connect: optional TLS wrap, ApiVersions negotiation, then the
+    configured SASL exchange — so every fresh socket is authenticated
+    before any caller's request rides it."""
 
-    def __init__(self, host: str, port: int, client_id: str, timeout: float = 10.0):
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        client_id: str,
+        timeout: float = 10.0,
+        *,
+        tls=None,
+        sasl: tuple[str, str, str] | None = None,  # (mechanism, user, pass)
+    ):
         self.host, self.port = host, port
         self.client_id = client_id
         self.timeout = timeout
+        self.tls = tls
+        self.sasl = sasl
+        self.api_versions: dict[int, tuple[int, int]] = {}
         self._sock: socket.socket | None = None
         self._lock = threading.Lock()
         self._corr = 0
 
     def _connect(self) -> None:
-        if self._sock is None:
-            s = socket.create_connection((self.host, self.port), timeout=self.timeout)
-            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            self._sock = s
+        if self._sock is not None:
+            return
+        s = socket.create_connection((self.host, self.port), timeout=self.timeout)
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        from .. import wrap_tls
+
+        s = wrap_tls(s, self.tls, self.host)
+        self._sock = s
+        try:
+            self._handshake()
+        except BaseException:
+            # never cache a half-initialized (unauthenticated) socket
+            try:
+                s.close()
+            finally:
+                self._sock = None
+            raise
+
+    def _raw_call(self, api_key: int, api_version: int, body: bytes) -> kp.Reader:
+        """Request/response on the freshly dialed socket, used only from
+        _connect (the caller already holds the lock)."""
+        self._corr += 1
+        corr = self._corr
+        self._sock.sendall(
+            kp.encode_request(api_key, api_version, corr, self.client_id, body)
+        )
+        size = struct.unpack(">i", self._recv_exact(4))[0]
+        r = kp.Reader(self._recv_exact(size))
+        got = r.i32()
+        if got != corr:
+            raise ConnectionError(f"kafka correlation mismatch {got} != {corr}")
+        return r
+
+    def _handshake(self) -> None:
+        _err, self.api_versions = kp.dec_api_versions_resp(
+            self._raw_call(kp.API_VERSIONS, 0, kp.enc_api_versions_req())
+        )
+        if self.sasl is None:
+            return
+        mechanism, user, password = self.sasl
+        err, offered = kp.dec_sasl_handshake_resp(
+            self._raw_call(
+                kp.SASL_HANDSHAKE, 1, kp.enc_sasl_handshake_req(mechanism)
+            )
+        )
+        if err != kp.NONE:
+            raise KafkaError(err, f"sasl handshake ({mechanism} not in {offered})")
+
+        def auth_round(payload: bytes) -> bytes:
+            aerr, msg, out = kp.dec_sasl_authenticate_resp(
+                self._raw_call(
+                    kp.SASL_AUTHENTICATE, 0, kp.enc_sasl_authenticate_req(payload)
+                )
+            )
+            if aerr != kp.NONE:
+                raise KafkaError(aerr, f"sasl authenticate: {msg}")
+            return out
+
+        if mechanism == "PLAIN":
+            auth_round(b"\x00" + user.encode() + b"\x00" + password.encode())
+        elif mechanism in ("SCRAM-SHA-256", "SCRAM-SHA-512"):
+            from ..scram import ScramClient
+
+            client = ScramClient(mechanism, user, password)
+            server_first = auth_round(client.first_message().encode())
+            server_final = auth_round(
+                client.process_server_first(server_first.decode()).encode()
+            )
+            client.verify_server_final(server_final.decode())
+        else:
+            raise KafkaError(
+                kp.UNSUPPORTED_SASL_MECHANISM, f"unsupported {mechanism!r}"
+            )
+
+    def supports(self, api_key: int, version: int) -> bool:
+        lo_hi = self.api_versions.get(api_key)
+        return lo_hi is not None and lo_hi[0] <= version <= lo_hi[1]
+
+    def uses_v2_records(self) -> bool:
+        """Modern record batches need Produce>=3 and Fetch>=4. An empty
+        api_versions map (socket not yet dialed) resolves on first call."""
+        return self.supports(kp.PRODUCE, 3) and self.supports(kp.FETCH, 4)
 
     def _recv_exact(self, n: int) -> bytes:
         buf = b""
@@ -91,6 +192,12 @@ class _Broker:
                 raise ConnectionError("kafka broker closed connection")
             buf += chunk
         return buf
+
+    def ensure_connected(self) -> None:
+        """Dial (and negotiate versions / authenticate) if needed, so
+        api_versions is populated before a caller picks a wire format."""
+        with self._lock:
+            self._connect()
 
     def call(self, api_key: int, api_version: int, body: bytes) -> kp.Reader:
         with self._lock:
@@ -154,7 +261,16 @@ class KafkaPubSub(_BasePubSub):
         key = (host, port)
         b = self._brokers.get(key)
         if b is None:
-            b = self._brokers[key] = _Broker(host, port, self.cfg.client_id)
+            sasl = None
+            if self.cfg.sasl_mechanism:
+                sasl = (
+                    self.cfg.sasl_mechanism,
+                    self.cfg.sasl_username or "",
+                    self.cfg.sasl_password or "",
+                )
+            b = self._brokers[key] = _Broker(
+                host, port, self.cfg.client_id, tls=self.cfg.tls, sasl=sasl
+            )
         return b
 
     def _bootstrap(self) -> _Broker:
@@ -302,21 +418,42 @@ class KafkaPubSub(_BasePubSub):
                 by_leader.setdefault(broker, {}).setdefault(topic, {})[pid] = originals
         first_err: Exception | None = None
         for broker, topics in by_leader.items():
+            try:
+                broker.ensure_connected()  # api_versions drives the format
+            except (OSError, ConnectionError, KafkaError) as e:
+                for parts in topics.values():
+                    for originals in parts.values():
+                        self._requeue(originals)
+                first_err = first_err or e
+                continue
+            use_v2 = broker.uses_v2_records()
+            now_ms = int(time.time() * 1000)
+
+            def to_wire(originals):
+                records = [
+                    kp.Record(key=None, value=raw, timestamp=now_ms)
+                    for _t, raw in originals
+                ]
+                return (
+                    kp.encode_record_batch(records)
+                    if use_v2
+                    else kp.encode_message_set(records)
+                )
+
             wire = {
-                t: {
-                    pid: kp.encode_message_set(
-                        [
-                            kp.Record(key=None, value=raw,
-                                      timestamp=int(time.time() * 1000))
-                            for _t, raw in originals
-                        ]
-                    )
-                    for pid, originals in parts.items()
-                }
+                t: {pid: to_wire(originals) for pid, originals in parts.items()}
                 for t, parts in topics.items()
             }
             try:
-                r = broker.call(kp.PRODUCE, 2, kp.enc_produce_req(1, 5000, wire))
+                # KafkaError included: broker.call can redial and re-run
+                # the SASL handshake mid-flush (another thread closed the
+                # shared socket); an auth failure there must requeue too
+                if use_v2:
+                    r = broker.call(
+                        kp.PRODUCE, 3, kp.enc_produce_req_v3(1, 5000, wire)
+                    )
+                else:
+                    r = broker.call(kp.PRODUCE, 2, kp.enc_produce_req(1, 5000, wire))
                 resp = kp.dec_produce_resp(r)
                 for topic, parts in resp.items():
                     for pid, (err, _base) in parts.items():
@@ -334,7 +471,7 @@ class KafkaPubSub(_BasePubSub):
                                 "app_pubsub_publish_success_count",
                                 by=len(topics[topic][pid]), topic=topic,
                             )
-            except (OSError, ConnectionError) as e:
+            except (OSError, ConnectionError, KafkaError) as e:
                 # transport failure: requeue everything aimed at this broker;
                 # other leaders' sends proceed (at-least-once, never drop)
                 for topic, parts in topics.items():
@@ -401,8 +538,18 @@ class KafkaPubSub(_BasePubSub):
         for pid, po in req.items():
             by_leader.setdefault(self._leader(topic, pid), {})[pid] = po
         for broker, parts in by_leader.items():
-            r = broker.call(kp.FETCH, 2, kp.enc_fetch_req(max_wait_ms, 1, {topic: parts}))
-            resp = kp.dec_fetch_resp(r).get(topic, {})
+            broker.ensure_connected()
+            if broker.uses_v2_records():
+                r = broker.call(
+                    kp.FETCH, 4,
+                    kp.enc_fetch_req_v4(max_wait_ms, 1, 1 << 25, {topic: parts}),
+                )
+                resp = kp.dec_fetch_resp_v4(r).get(topic, {})
+            else:
+                r = broker.call(
+                    kp.FETCH, 2, kp.enc_fetch_req(max_wait_ms, 1, {topic: parts})
+                )
+                resp = kp.dec_fetch_resp(r).get(topic, {})
             for pid, p in resp.items():
                 if p["error"] == kp.OFFSET_OUT_OF_RANGE:
                     # log truncated under us: restart from the configured edge
@@ -416,7 +563,7 @@ class KafkaPubSub(_BasePubSub):
                     continue
                 if p["error"] != kp.NONE:
                     raise KafkaError(p["error"], f"fetch {topic}/{pid}")
-                records = kp.decode_message_set(p["records"])
+                records = kp.decode_records(p["records"])  # sniffs v1 vs v2
                 # brokers may return records below the requested offset
                 # (message-set alignment); drop them
                 records = [rec for rec in records if rec.offset >= offsets[pid]]

@@ -70,6 +70,11 @@ class MQTTConfig:
             if config.get("MQTT_CLEAN_SESSION")
             else self.qos == 0
         )
+        # TLS (mqtts, typically port 8883): MQTT_TLS / _TLS_CA_CERT /
+        # _TLS_INSECURE env convention, or assign an SSLContext directly
+        from .. import tls_from_config
+
+        self.tls = tls_from_config(config, "MQTT")
 
 
 class MQTTPubSub(_BasePubSub):
@@ -111,6 +116,9 @@ class MQTTPubSub(_BasePubSub):
                 return
         s = socket.create_connection((self.cfg.host, self.cfg.port), timeout=self.cfg.timeout)
         s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        from .. import wrap_tls
+
+        s = wrap_tls(s, self.cfg.tls, self.cfg.host)
         s.sendall(
             mp.connect_packet(
                 self.cfg.client_id, keepalive=self.cfg.keepalive,

@@ -23,7 +23,11 @@ import os
 
 __all__ = ["ScramClient", "ScramError", "scram_server_keys"]
 
-_HASHES = {"SCRAM-SHA-256": hashlib.sha256, "SCRAM-SHA-1": hashlib.sha1}
+_HASHES = {
+    "SCRAM-SHA-256": hashlib.sha256,
+    "SCRAM-SHA-512": hashlib.sha512,  # Kafka's other standard mechanism
+    "SCRAM-SHA-1": hashlib.sha1,  # MongoDB legacy
+}
 
 
 class ScramError(Exception):

@@ -334,6 +334,7 @@ class NativeHTTPServer:
         port: int = 8000,
         host: str = "0.0.0.0",
         logger: Logger | None = None,
+        tls=None,
     ):
         codec = load_http_codec()
         if codec is None:
@@ -344,6 +345,7 @@ class NativeHTTPServer:
         self.host = host
         self.logger = logger
         self.reuse_port = False
+        self.tls = tls  # server-side ssl.SSLContext (HTTPS); see server.py
         self._server: asyncio.AbstractServer | None = None
         self._loop: asyncio.AbstractEventLoop | None = None
 
@@ -358,10 +360,14 @@ class NativeHTTPServer:
             self.host,
             self.port,
             reuse_port=self.reuse_port or None,
+            ssl=self.tls,
         )
         self.port = self._server.sockets[0].getsockname()[1]
         if self.logger:
-            self.logger.info(f"HTTP server (native codec) listening on :{self.port}")
+            scheme = "HTTPS" if self.tls is not None else "HTTP"
+            self.logger.info(
+                f"{scheme} server (native codec) listening on :{self.port}"
+            )
 
     async def serve_forever(self) -> None:
         if self._server is None:

@@ -188,6 +188,7 @@ class AsyncHTTPServer:
         port: int = 8000,
         host: str = "0.0.0.0",
         logger: Logger | None = None,
+        tls=None,
     ):
         self.dispatch = dispatch  # async (Request) -> Response
         self.port = port
@@ -196,16 +197,20 @@ class AsyncHTTPServer:
         # SO_REUSEPORT bind: lets N worker processes share the port with
         # kernel-level connection balancing (App multi-worker mode)
         self.reuse_port = False
+        # tls: server-side ssl.SSLContext (HTTPS). The reference terminates
+        # TLS at the ingress; this is the standalone-deployment escape hatch
+        self.tls = tls
         self._server: asyncio.AbstractServer | None = None
 
     async def start(self) -> None:
         self._server = await asyncio.start_server(
             self._handle_conn, self.host, self.port, limit=MAX_HEADER_BYTES,
-            reuse_port=self.reuse_port or None,
+            reuse_port=self.reuse_port or None, ssl=self.tls,
         )
         self.port = self._server.sockets[0].getsockname()[1]
         if self.logger:
-            self.logger.info(f"HTTP server listening on :{self.port}")
+            scheme = "HTTPS" if self.tls is not None else "HTTP"
+            self.logger.info(f"{scheme} server listening on :{self.port}")
 
     async def serve_forever(self) -> None:
         if self._server is None:

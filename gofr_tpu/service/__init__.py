@@ -37,6 +37,7 @@ __all__ = [
     "OAuth",
     "CustomHeaders",
     "HealthConfig",
+    "TLSConfig",
     "CircuitBreaker",
     "CircuitOpenError",
 ]
@@ -76,6 +77,10 @@ class HTTPService:
         self.auth_header: Callable[[], dict[str, str]] | None = None
         self.health_endpoint = ".well-known/alive"
         self.circuit: CircuitBreaker | None = None
+        # TLS for https addresses: None uses urllib's default verification;
+        # an ssl.SSLContext (e.g. with a private CA) overrides it — the
+        # reference's TLSConfig seam on its http.Client (service/new.go:68-89)
+        self.tls_context = None
 
     # -- request path (new.go:135-195) ------------------------------------
     def _headers(self, headers: dict | None) -> dict:
@@ -122,7 +127,9 @@ class HTTPService:
         t0 = time.perf_counter()
         status = 0
         try:
-            with urllib.request.urlopen(req, timeout=timeout) as resp:
+            with urllib.request.urlopen(
+                req, timeout=timeout, context=self.tls_context
+            ) as resp:
                 out = Response(resp.status, dict(resp.headers), resp.read())
         except urllib.error.HTTPError as e:
             out = Response(e.code, dict(e.headers), e.read())
@@ -288,6 +295,26 @@ class HealthConfig:
 
     def apply(self, svc: HTTPService) -> None:
         svc.health_endpoint = endpoint_strip(self.endpoint)
+
+
+class TLSConfig:
+    """Option: TLS settings for https addresses — a ready SSLContext, a
+    private CA bundle, or (dev only) verification off. Mirrors the
+    reference's TLSConfig on its http.Client (service/new.go:68-89)."""
+
+    def __init__(self, context=None, *, ca_cert: str | None = None,
+                 insecure: bool = False):
+        import ssl
+
+        if context is None:
+            context = ssl.create_default_context(cafile=ca_cert)
+            if insecure:
+                context.check_hostname = False
+                context.verify_mode = ssl.CERT_NONE
+        self.context = context
+
+    def apply(self, svc: HTTPService) -> None:
+        svc.tls_context = self.context
 
 
 def endpoint_strip(e: str) -> str:

@@ -4,9 +4,13 @@ The test stand-in for a broker, exactly as MiniRedis (testutil) speaks real
 RESP: unit tests drive the from-scratch Kafka client end-to-end over TCP
 without an external service (the reference's CI instead provisions a real
 Kafka container, go.yml:61-77 — this image has none, so the broker is
-in-process). Implements the same API subset the client uses: Produce v2,
-Fetch v2, ListOffsets v1, Metadata v1, OffsetCommit v2, OffsetFetch v1,
-FindCoordinator v0, CreateTopics v0, DeleteTopics v0.
+in-process). Implements the same API subset the client uses: Produce v2/v3,
+Fetch v2/v4 (v2 record batches AND v1 message sets), ListOffsets v1,
+Metadata v1, OffsetCommit v2, OffsetFetch v1, FindCoordinator v0,
+CreateTopics v0, DeleteTopics v0, ApiVersions v0, SaslHandshake v1,
+SaslAuthenticate v0 (PLAIN + SCRAM). `legacy=True` advertises only the
+old Produce/Fetch versions so tests cover the v1 MessageSet negotiation
+path; `users=` enforces SASL; `tls=True` serves the self-signed test cert.
 """
 
 from __future__ import annotations
@@ -24,7 +28,14 @@ class FakeKafkaBroker:
     """Single-node broker (node_id 0). Topics live in memory as
     {topic: {partition: [Record]}}; group offsets as {(group, topic, pid)}."""
 
-    def __init__(self, host: str = "127.0.0.1"):
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        *,
+        legacy: bool = False,
+        users: dict[str, str] | None = None,
+        tls: bool = False,
+    ):
         self._sock = socket.socket()
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, 0))
@@ -32,6 +43,9 @@ class FakeKafkaBroker:
         self.host = host
         self.port = self._sock.getsockname()[1]
         self.node_id = 0
+        self.legacy = legacy  # advertise pre-KIP-98 Produce/Fetch only
+        self.users = users  # SASL required when set
+        self.tls = tls
         self._topics: dict[str, dict[int, list[kp.Record]]] = {}
         self._group_offsets: dict[tuple[str, str, int], int] = {}
         self._lock = threading.Lock()
@@ -40,6 +54,21 @@ class FakeKafkaBroker:
         self.fail_next_produce: int | None = None
         self._thread = threading.Thread(target=self._accept_loop, daemon=True)
         self._thread.start()
+
+    def api_versions(self) -> dict[int, tuple[int, int]]:
+        produce_fetch = {
+            kp.PRODUCE: (0, 2), kp.FETCH: (0, 2),
+        } if self.legacy else {
+            kp.PRODUCE: (0, 3), kp.FETCH: (0, 4),
+        }
+        return {
+            **produce_fetch,
+            kp.LIST_OFFSETS: (0, 1), kp.METADATA: (0, 1),
+            kp.OFFSET_COMMIT: (0, 2), kp.OFFSET_FETCH: (0, 1),
+            kp.FIND_COORDINATOR: (0, 0), kp.SASL_HANDSHAKE: (0, 1),
+            kp.API_VERSIONS: (0, 0), kp.CREATE_TOPICS: (0, 0),
+            kp.DELETE_TOPICS: (0, 0), kp.SASL_AUTHENTICATE: (0, 0),
+        }
 
     @property
     def address(self) -> str:
@@ -96,6 +125,15 @@ class FakeKafkaBroker:
         return buf
 
     def _serve_conn(self, conn: socket.socket) -> None:
+        if self.tls:
+            from . import server_tls_context
+
+            try:
+                conn = server_tls_context().wrap_socket(conn, server_side=True)
+            except OSError:
+                return
+        # per-connection SASL state, like a real broker's SASL listener
+        state = {"authed": self.users is None, "scram": None, "mech": None}
         with conn:
             while not self._closed:
                 head = self._recv_exact(conn, 4)
@@ -106,10 +144,14 @@ class FakeKafkaBroker:
                 if payload is None:
                     return
                 r = kp.Reader(payload)
-                api_key, _api_ver, corr = r.i16(), r.i16(), r.i32()
+                api_key, api_ver, corr = r.i16(), r.i16(), r.i32()
                 r.string()  # client_id
+                if not state["authed"] and api_key not in (
+                    kp.API_VERSIONS, kp.SASL_HANDSHAKE, kp.SASL_AUTHENTICATE
+                ):
+                    return  # real brokers cut unauthenticated connections
                 try:
-                    body = self._dispatch(api_key, r)
+                    body = self._dispatch(api_key, api_ver, r, state)
                 except Exception:  # noqa: BLE001 — a broken frame kills the conn
                     return
                 try:
@@ -117,13 +159,23 @@ class FakeKafkaBroker:
                 except OSError:
                     return
 
-    def _dispatch(self, api_key: int, r: kp.Reader) -> bytes:
+    def _dispatch(self, api_key: int, api_ver: int, r: kp.Reader, state: dict) -> bytes:
+        if api_key == kp.API_VERSIONS:
+            return kp.enc_api_versions_resp(self.api_versions())
+        if api_key == kp.SASL_HANDSHAKE:
+            return self._sasl_handshake(kp.dec_sasl_handshake_req(r), state)
+        if api_key == kp.SASL_AUTHENTICATE:
+            return self._sasl_authenticate(kp.dec_sasl_authenticate_req(r), state)
         if api_key == kp.METADATA:
             return self._metadata(kp.dec_metadata_req(r))
         if api_key == kp.PRODUCE:
-            return self._produce(*kp.dec_produce_req(r))
+            if api_ver >= 3:
+                return self._produce(*kp.dec_produce_req_v3(r), api_ver=api_ver)
+            return self._produce(*kp.dec_produce_req(r), api_ver=api_ver)
         if api_key == kp.FETCH:
-            return self._fetch(kp.dec_fetch_req(r))
+            if api_ver >= 4:
+                return self._fetch(kp.dec_fetch_req_v4(r), api_ver=api_ver)
+            return self._fetch(kp.dec_fetch_req(r), api_ver=api_ver)
         if api_key == kp.LIST_OFFSETS:
             return self._list_offsets(kp.dec_list_offsets_req(r))
         if api_key == kp.OFFSET_COMMIT:
@@ -138,6 +190,52 @@ class FakeKafkaBroker:
         if api_key == kp.DELETE_TOPICS:
             return self._delete_topics(kp.dec_delete_topics_req(r))
         raise ValueError(f"unsupported api_key {api_key}")
+
+    def _sasl_handshake(self, mechanism: str, state: dict) -> bytes:
+        # what real brokers offer (no SHA-1 in Kafka)
+        offered = ["PLAIN", "SCRAM-SHA-256", "SCRAM-SHA-512"]
+        if self.users is None or mechanism not in offered:
+            return kp.enc_sasl_handshake_resp(
+                kp.UNSUPPORTED_SASL_MECHANISM, offered
+            )
+        state["mech"] = mechanism
+        return kp.enc_sasl_handshake_resp(kp.NONE, offered)
+
+    def _sasl_authenticate(self, auth: bytes, state: dict) -> bytes:
+        from ..datasource.scram import ScramError, ScramServer
+
+        mech = state.get("mech")
+        if mech is None:
+            return kp.enc_sasl_authenticate_resp(
+                kp.ILLEGAL_SASL_STATE, "handshake first", b""
+            )
+        if mech == "PLAIN":
+            try:
+                _authzid, user, password = auth.split(b"\x00", 2)
+            except ValueError:
+                return kp.enc_sasl_authenticate_resp(
+                    kp.SASL_AUTHENTICATION_FAILED, "malformed PLAIN", b""
+                )
+            if self.users.get(user.decode()) == password.decode():
+                state["authed"] = True
+                return kp.enc_sasl_authenticate_resp(kp.NONE, None, b"")
+            return kp.enc_sasl_authenticate_resp(
+                kp.SASL_AUTHENTICATION_FAILED, "bad credentials", b""
+            )
+        try:
+            if state["scram"] is None:
+                state["scram"] = ScramServer(mech, self.users)
+                first = state["scram"].process_client_first(auth.decode())
+                return kp.enc_sasl_authenticate_resp(kp.NONE, None, first.encode())
+            final = state["scram"].process_client_final(auth.decode())
+            state["authed"] = True
+            state["scram"] = None
+            return kp.enc_sasl_authenticate_resp(kp.NONE, None, final.encode())
+        except ScramError as e:
+            state["scram"] = None
+            return kp.enc_sasl_authenticate_resp(
+                kp.SASL_AUTHENTICATION_FAILED, str(e), b""
+            )
 
     def _metadata(self, want: list[str] | None) -> bytes:
         with self._lock:
@@ -156,7 +254,7 @@ class FakeKafkaBroker:
         )
 
     def _produce(self, acks: int, _timeout: int,
-                 topics: dict[str, dict[int, bytes]]) -> bytes:
+                 topics: dict[str, dict[int, bytes]], api_ver: int = 2) -> bytes:
         resp: dict[str, dict[int, tuple[int, int]]] = {}
         with self._lock:
             for name, parts in topics.items():
@@ -172,13 +270,16 @@ class FakeKafkaBroker:
                         continue
                     log = tparts[pid]
                     base = len(log)
-                    for i, rec in enumerate(kp.decode_message_set(record_set)):
+                    # decode_records sniffs v1 MessageSet vs v2 batch, so
+                    # one store serves clients on either format
+                    for i, rec in enumerate(kp.decode_records(record_set)):
                         rec.offset = base + i
                         log.append(rec)
                     resp[name][pid] = (kp.NONE, base)
         return kp.enc_produce_resp(resp)
 
-    def _fetch(self, topics: dict[str, dict[int, tuple[int, int]]]) -> bytes:
+    def _fetch(self, topics: dict[str, dict[int, tuple[int, int]]],
+               api_ver: int = 2) -> bytes:
         resp: dict[str, dict[int, tuple[int, int, bytes]]] = {}
         with self._lock:
             for name, parts in topics.items():
@@ -200,7 +301,17 @@ class FakeKafkaBroker:
                         size += len(rec.value or b"") + 34
                         if size >= max_bytes:
                             break
-                    resp[name][pid] = (kp.NONE, hw, kp.encode_message_set(out))
+                    if not out:
+                        wire = b""
+                    elif api_ver >= 4:
+                        # v2 batch offsets are base+delta: rebase on the
+                        # first record's absolute offset
+                        wire = kp.encode_record_batch(out, base_offset=out[0].offset)
+                    else:
+                        wire = kp.encode_message_set(out)
+                    resp[name][pid] = (kp.NONE, hw, wire)
+        if api_ver >= 4:
+            return kp.enc_fetch_resp_v4(resp)
         return kp.enc_fetch_resp(resp)
 
     def _list_offsets(self, topics: dict[str, dict[int, int]]) -> bytes:

@@ -32,7 +32,13 @@ class _Session:
 
 
 class FakeMQTTBroker:
-    def __init__(self, host: str = "127.0.0.1", *, password: str | None = None):
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        *,
+        password: str | None = None,
+        tls: bool = False,
+    ):
         self._sock = socket.socket()
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, 0))
@@ -40,6 +46,7 @@ class FakeMQTTBroker:
         self.host = host
         self.port = self._sock.getsockname()[1]
         self.password = password  # when set, CONNECT must carry it
+        self.tls = tls  # serve over testutil.self_signed_cert()
         self._sessions: list[_Session] = []
         self._lock = threading.Lock()
         self._closed = False
@@ -84,6 +91,18 @@ class FakeMQTTBroker:
                 target=self._serve_conn, args=(conn,), daemon=True
             ).start()
 
+    def _maybe_tls(self, conn: socket.socket) -> socket.socket | None:
+        """Per-connection TLS wrap (in the connection thread, like
+        fakekafka — a stalled handshake must not freeze the accept loop)."""
+        if not self.tls:
+            return conn
+        from . import server_tls_context
+
+        try:
+            return server_tls_context().wrap_socket(conn, server_side=True)
+        except OSError:
+            return None
+
     @staticmethod
     def _recv_exact(conn: socket.socket, n: int) -> bytes:
         buf = b""
@@ -95,6 +114,9 @@ class FakeMQTTBroker:
         return buf
 
     def _serve_conn(self, conn: socket.socket) -> None:
+        conn = self._maybe_tls(conn)
+        if conn is None:
+            return
         sess: _Session | None = None
         try:
             p = mp.read_packet_from(lambda n: self._recv_exact(conn, n))
