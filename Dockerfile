@@ -1,0 +1,36 @@
+# Container image for a gofr_tpu app — mirrors the reference's Dockerfile
+# shape (build the http-server example, expose 8000) adapted to Python:
+# there is no static-binary stage, so one slim image carries the
+# interpreter, the framework, and a g++ toolchain for the compile-on-
+# first-use native cores (gofr_tpu/native). For TPU pods, swap the
+# jax[cpu] pin for the libtpu-bundled jax build your fleet uses and
+# schedule onto nodes with the TPU device plugin; everything else is
+# identical — scale-out is stateless pod replication, as in the
+# reference's Kubernetes story.
+
+FROM python:3.12-slim
+
+RUN apt-get update \
+    && apt-get install -y --no-install-recommends g++ \
+    && rm -rf /var/lib/apt/lists/*
+
+WORKDIR /app
+COPY pyproject.toml ./
+COPY gofr_tpu/ gofr_tpu/
+COPY examples/ examples/
+
+RUN pip install --no-cache-dir \
+    "jax[cpu]" flax optax orbax-checkpoint chex einops numpy \
+    grpcio cryptography google-crc32c
+
+# pre-build the native cores so first-request latency is not a compile
+RUN python -c "from gofr_tpu.native import load_http_codec, load_data_core; \
+    load_http_codec(); load_data_core()"
+
+ENV JAX_PLATFORMS=cpu
+# PYTHONPATH makes the framework importable from any example's directory;
+# WORKDIR in the example dir lets its configs/.env load (config convention)
+ENV PYTHONPATH=/app
+WORKDIR /app/examples/http-server
+EXPOSE 8000 9000 2121 9100 9101
+CMD ["python", "main.py"]

@@ -129,6 +129,80 @@ class pb:
 _PUBLISHER = "/google.pubsub.v1.Publisher/"
 _SUBSCRIBER = "/google.pubsub.v1.Subscriber/"
 _ident = lambda b: b  # noqa: E731 — bytes in, bytes out
+_UNIMPLEMENTED = object()  # sentinel: server lacks StreamingPull
+
+
+class _StreamPull:
+    """One StreamingPull bidi stream for one subscription. The request
+    side is a queue-fed iterator (initial subscribe message, then ack
+    batches); a receiver thread buffers ReceivedMessage frames for
+    next(). Stream death flips `dead` — the owner redials lazily."""
+
+    def __init__(self, owner: "GooglePubSub", sub: str):
+        import queue as _queue
+
+        self.sub = sub
+        self.dead = False
+        self.unimplemented = False
+        self._send_q: "_queue.Queue[bytes | None]" = _queue.Queue()
+        self._msgs: collections.deque = collections.deque()
+        self._cv = threading.Condition()
+        # StreamingPullRequest: subscription=1, stream_ack_deadline_seconds=5
+        self._send_q.put(pb.str_field(1, sub) + pb.int_field(5, 10))
+        fn = owner._channel.stream_stream(
+            _SUBSCRIBER + "StreamingPull",
+            request_serializer=_ident, response_deserializer=_ident,
+        )
+        metadata = owner._auth.metadata() if owner._send_auth else None
+        self._call = fn(self._requests(), metadata=metadata)
+        self._grpc = owner._grpc
+        threading.Thread(
+            target=self._recv_loop, name="gpubsub-stream", daemon=True
+        ).start()
+
+    def _requests(self):
+        while True:
+            item = self._send_q.get()
+            if item is None:
+                return
+            yield item
+
+    def _recv_loop(self) -> None:
+        try:
+            for frame in self._call:
+                decoded = pb.decode(frame)
+                with self._cv:
+                    self._msgs.extend(decoded.get(1, []))
+                    self._cv.notify_all()
+        except Exception as e:  # noqa: BLE001 — stream death is a state, not a crash
+            code = getattr(e, "code", lambda: None)()
+            if code == self._grpc.StatusCode.UNIMPLEMENTED:
+                self.unimplemented = True
+        finally:
+            with self._cv:
+                self.dead = True
+                self._cv.notify_all()
+
+    def next(self, timeout: float) -> bytes | None:
+        deadline = time.monotonic() + timeout
+        with self._cv:
+            while not self._msgs and not self.dead:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return None
+                self._cv.wait(remaining)
+            return self._msgs.popleft() if self._msgs else None
+
+    def ack(self, ack_id: str) -> None:
+        # StreamingPullRequest.ack_ids = 2, riding the same stream
+        self._send_q.put(pb.str_field(1, self.sub) + pb.str_field(2, ack_id))
+
+    def close(self) -> None:
+        self._send_q.put(None)
+        try:
+            self._call.cancel()
+        except Exception:  # noqa: BLE001
+            pass
 
 
 class GooglePubSub(_BasePubSub):
@@ -205,6 +279,15 @@ class GooglePubSub(_BasePubSub):
         self._topics: set[str] = set()
         self._subs: set[str] = set()
         self._last_error: str | None = None
+        # StreamingPull (the transport the reference's subscription.Receive
+        # uses, google.go:142): messages push over one bidi stream instead
+        # of paying a unary Pull round trip each; acks ride the same
+        # stream. Default on, with automatic permanent fallback to unary
+        # Pull when the server doesn't implement it.
+        self._streaming = config.get_or_default(
+            "GOOGLE_STREAMING_PULL", "true"
+        ).lower() not in ("0", "false")
+        self._streams: dict[str, _StreamPull] = {}
 
     # -- call plumbing -----------------------------------------------------
     def _call(self, service: str, method: str, body: bytes, timeout: float = 10.0) -> bytes:
@@ -282,6 +365,21 @@ class GooglePubSub(_BasePubSub):
         finally:
             self._log_pub(topic, raw, ok)
 
+    def _rm_to_message(self, topic: str, rm_raw: bytes, acker) -> Message:
+        """ReceivedMessage bytes -> framework Message (shared by the unary
+        and streaming pull paths). `acker(ack_id)` performs the ack."""
+        rm = pb.decode(rm_raw)
+        ack_id = pb.first(rm, 1, b"").decode()
+        pm = pb.decode(pb.first(rm, 2, b""))
+        data = pb.first(pm, 1, b"")
+        attrs = {}
+        for entry in pm.get(2, []):
+            kv = pb.decode(entry)
+            attrs[pb.first(kv, 1, b"").decode()] = pb.first(kv, 2, b"").decode()
+        return Message(
+            topic, data, metadata=attrs, committer=lambda: acker(ack_id)
+        )
+
     def _pull_blocking(self, topic: str, timeout: float) -> Message | None:
         deadline = time.monotonic() + timeout
         try:
@@ -289,6 +387,29 @@ class GooglePubSub(_BasePubSub):
         except Exception:  # noqa: BLE001 — endpoint down; report None
             return None
         sub = self._sub_path(topic)
+        while self._streaming:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return None
+            got = self._stream_next(topic, sub, remaining)
+            if got is _UNIMPLEMENTED:
+                # server has no StreamingPull (old emulator): permanent
+                # unary fallback, same semantics at higher latency
+                self._streaming = False
+                if self.logger is not None:
+                    self.logger.warn(
+                        "Google Pub/Sub: StreamingPull unimplemented by "
+                        "server; falling back to unary Pull"
+                    )
+                break
+            if got is not None:
+                return got
+            # None inside the window means the stream died mid-wait (a
+            # timeout exits via `remaining` above). Pace the redial so a
+            # flapping endpoint doesn't get hot-looped with fresh streams;
+            # un-fetched messages of the dead stream redeliver after the
+            # ack deadline.
+            time.sleep(min(0.05, max(deadline - time.monotonic(), 0)))
         while True:
             remaining = deadline - time.monotonic()
             if remaining <= 0:
@@ -303,19 +424,35 @@ class GooglePubSub(_BasePubSub):
                 return None
             received = resp.get(1, [])
             if received:
-                rm = pb.decode(received[0])
-                ack_id = pb.first(rm, 1, b"").decode()
-                pm = pb.decode(pb.first(rm, 2, b""))
-                data = pb.first(pm, 1, b"")
-                attrs = {}
-                for entry in pm.get(2, []):
-                    kv = pb.decode(entry)
-                    attrs[pb.first(kv, 1, b"").decode()] = pb.first(kv, 2, b"").decode()
-                return Message(
-                    topic, data, metadata=attrs,
-                    committer=lambda: self._ack(sub, ack_id),
+                return self._rm_to_message(
+                    topic, received[0], lambda ack_id: self._ack(sub, ack_id)
                 )
             time.sleep(min(0.05, max(deadline - time.monotonic(), 0)))
+
+    def _stream_next(self, topic: str, sub: str, timeout: float):
+        """One message via the topic's StreamingPull stream (creating or
+        re-creating it as needed). Returns a Message, None (timeout /
+        transient stream death — next call redials), or _UNIMPLEMENTED."""
+        with self._lock:
+            st = self._streams.get(topic)
+        if st is None or st.dead:
+            if st is not None and st.unimplemented:
+                return _UNIMPLEMENTED
+            try:
+                st = _StreamPull(self, sub)
+            except Exception as e:  # noqa: BLE001
+                self._last_error = str(e)
+                return None
+            with self._lock:
+                old, self._streams[topic] = self._streams.get(topic), st
+            if old is not None:
+                old.close()
+        rm_raw = st.next(timeout)
+        if rm_raw is None:
+            if st.unimplemented:
+                return _UNIMPLEMENTED
+            return None
+        return self._rm_to_message(topic, rm_raw, st.ack)
 
     def _ack(self, sub: str, ack_id: str) -> None:
         self._call(
@@ -352,6 +489,9 @@ class GooglePubSub(_BasePubSub):
         with self._lock:
             self._topics.discard(topic)
             self._subs.discard(topic)
+            stream = self._streams.pop(topic, None)
+        if stream is not None:
+            stream.close()
 
     def health(self) -> dict:
         try:
@@ -380,4 +520,8 @@ class GooglePubSub(_BasePubSub):
         return health(STATUS_UP if up else STATUS_DOWN, **details)
 
     def close(self) -> None:
+        with self._lock:
+            streams, self._streams = list(self._streams.values()), {}
+        for s in streams:
+            s.close()
         self._channel.close()

@@ -54,8 +54,8 @@ class KafkaConfig:
             hp.strip()
             for hp in (config.get("PUBSUB_BROKER") or "localhost:9092").split(",")
         ]
-        # SASL (PLAIN / SCRAM-SHA-256 / SCRAM-SHA-1) + TLS: the surface the
-        # reference inherits from segmentio/kafka-go's sasl + TLSConfig
+        # SASL (PLAIN / SCRAM-SHA-256 / SCRAM-SHA-512) + TLS: the surface
+        # the reference inherits from segmentio/kafka-go's sasl + TLSConfig
         self.sasl_mechanism = config.get("KAFKA_SASL_MECHANISM") or None
         self.sasl_username = config.get("KAFKA_SASL_USERNAME") or None
         self.sasl_password = config.get("KAFKA_SASL_PASSWORD") or None

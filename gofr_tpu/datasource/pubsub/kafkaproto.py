@@ -382,6 +382,12 @@ def decode_record_batches(data: bytes) -> list[Record]:
         attrs = r.i16()
         if attrs & 0x07:
             raise ValueError("compressed record batches not supported")
+        if attrs & 0x20:
+            # control batch (transaction COMMIT/ABORT markers): not
+            # application data — skip, or consumers would surface the
+            # marker bytes as messages
+            pos += 12 + batch_len
+            continue
         r.i32()  # lastOffsetDelta
         base_ts = r.i64()
         r.i64()  # maxTimestamp

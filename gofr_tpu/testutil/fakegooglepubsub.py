@@ -28,11 +28,14 @@ class _State:
         self.unacked: dict[str, tuple] = {}  # ack_id -> (sub, record)
         self.acked: list[str] = []
         self.lock = threading.Lock()
+        self.arrived = threading.Condition(lock=self.lock)  # publish signal
         self.ids = itertools.count(1)
 
 
 class FakeGooglePubSub:
-    def __init__(self, host: str = "127.0.0.1"):
+    def __init__(self, host: str = "127.0.0.1", *, no_streaming: bool = False):
+        # no_streaming simulates an old emulator without StreamingPull, so
+        # tests can cover the client's permanent unary-Pull fallback
         self.state = _State()
         self._server = grpc.server(ThreadPoolExecutor(max_workers=8))
         handlers = {
@@ -47,10 +50,15 @@ class FakeGooglePubSub:
             "Pull": self._pull,
             "Acknowledge": self._acknowledge,
         }
+        stream_handlers = (
+            {} if no_streaming else {"StreamingPull": self._streaming_pull}
+        )
         self._server.add_generic_rpc_handlers(
             (
                 _Generic("google.pubsub.v1.Publisher", handlers),
-                _Generic("google.pubsub.v1.Subscriber", sub_handlers),
+                _Generic(
+                    "google.pubsub.v1.Subscriber", sub_handlers, stream_handlers
+                ),
             )
         )
         self.port = self._server.add_insecure_port(f"{host}:0")
@@ -114,6 +122,7 @@ class FakeGooglePubSub:
                             (ack, data, attrs, mid)
                         )
                 out_ids += pb.str_field(1, mid)
+            self.state.arrived.notify_all()  # wake StreamingPull senders
         return out_ids
 
     def _create_subscription(self, body: bytes, ctx) -> bytes:
@@ -156,6 +165,48 @@ class FakeGooglePubSub:
                 out += pb.str_field(1, rm)
         return out
 
+    def _streaming_pull(self, request_iterator, ctx):
+        """Bidi StreamingPull: first request names the subscription; later
+        requests carry ack_ids; responses push message batches as they
+        arrive (no client round trip per message)."""
+        first = pb.decode(next(request_iterator))
+        sub = pb.first(first, 1, b"").decode()
+        with self.state.lock:
+            if sub not in self.state.subs:
+                ctx.abort(grpc.StatusCode.NOT_FOUND, "no such subscription")
+
+        def ack_loop():
+            try:
+                for req in request_iterator:
+                    msg = pb.decode(req)
+                    with self.state.lock:
+                        for ack in msg.get(2, []):
+                            a = ack.decode()
+                            self.state.unacked.pop(a, None)
+                            self.state.acked.append(a)
+            except Exception:  # noqa: BLE001 — stream teardown
+                pass
+
+        threading.Thread(target=ack_loop, daemon=True).start()
+        while ctx.is_active():
+            with self.state.lock:
+                q = self.state.queues.setdefault(sub, collections.deque())
+                batch = []
+                while q:
+                    rec = q.popleft()
+                    self.state.unacked[rec[0]] = (sub, rec)
+                    batch.append(rec)
+                if not batch:
+                    self.state.arrived.wait(timeout=0.2)
+                    continue
+            out = b""
+            for ack, data, attrs, mid in batch:
+                pm = pb.str_field(1, data) + pb.str_field(3, mid)
+                for k, v in attrs.items():
+                    pm += pb.map_entry(2, k, v)
+                out += pb.str_field(1, pb.str_field(1, ack) + pb.str_field(2, pm))
+            yield out
+
     def _acknowledge(self, body: bytes, ctx) -> bytes:
         msg = pb.decode(body)
         with self.state.lock:
@@ -177,14 +228,24 @@ class FakeGooglePubSub:
 
 
 class _Generic(grpc.GenericRpcHandler):
-    def __init__(self, service: str, methods: dict):
+    def __init__(self, service: str, methods: dict, streams: dict | None = None):
         self._service = service
         self._methods = methods
+        self._streams = streams or {}
 
     def service(self, handler_call_details):
         # path: /package.Service/Method
         _, svc, method = handler_call_details.method.split("/")
-        if svc != self._service or method not in self._methods:
+        if svc != self._service:
+            return None
+        if method in self._streams:
+            fn = self._streams[method]
+            return grpc.stream_stream_rpc_method_handler(
+                lambda it, ctx: fn(it, ctx),
+                request_deserializer=lambda b: b,
+                response_serializer=lambda b: b,
+            )
+        if method not in self._methods:
             return None
         fn = self._methods[method]
         return grpc.unary_unary_rpc_method_handler(

@@ -443,3 +443,23 @@ class TestKafkaSaslTls:
         finally:
             c.close()
             b.close()
+
+    def test_control_batches_skipped(self):
+        """Transaction COMMIT/ABORT markers (attrs bit 5) are broker
+        bookkeeping, not messages — the decoder must not surface them."""
+        import struct as _struct
+
+        data = kp.encode_record_batch([kp.Record(b"k", b"v", 1)], base_offset=0)
+        ctrl = bytearray(
+            kp.encode_record_batch([kp.Record(None, b"\x00\x00\x00\x00", 1)],
+                                   base_offset=1)
+        )
+        # flip the isControl bit in attributes (offset 21 after the CRC)
+        attrs_off = 8 + 4 + 4 + 1 + 4
+        attrs = _struct.unpack_from(">h", ctrl, attrs_off)[0] | 0x20
+        _struct.pack_into(">h", ctrl, attrs_off, attrs)
+        # re-CRC the mutated body
+        body = bytes(ctrl[attrs_off:])
+        _struct.pack_into(">I", ctrl, 17, kp.crc32c(body))
+        out = kp.decode_record_batches(data + bytes(ctrl))
+        assert [(r.key, r.value) for r in out] == [(b"k", b"v")]
