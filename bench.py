@@ -61,8 +61,17 @@ def _greet_subprocess() -> dict | None:
             capture_output=True, text=True, timeout=300,
             cwd=os.path.dirname(os.path.abspath(__file__)),
         )
-        return json.loads(proc.stdout.strip().splitlines()[-1])
-    except (subprocess.TimeoutExpired, json.JSONDecodeError, IndexError):
+        # the subprocess prints the full result JSON and then the compact
+        # summary line LAST — walk backwards to the full object
+        for line in reversed(proc.stdout.strip().splitlines()):
+            try:
+                obj = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(obj, dict) and "detail" in obj:
+                return obj
+        return None
+    except subprocess.TimeoutExpired:
         return None
 
 

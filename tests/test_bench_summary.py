@@ -63,3 +63,21 @@ def test_summary_line_minimal_result():
     )
     assert s == {"metric": "m", "value": 1, "unit": "u", "vs_baseline": 0.1,
                  "device": "cpu", "p50_ms": 3.0}
+
+
+def test_greet_subprocess_parses_full_result_not_summary():
+    """The greet subprocess prints the full result and THEN the compact
+    summary; the parser must return the object with `detail` (regression:
+    it took the last line and crashed the serving bench on KeyError)."""
+    import json as _json
+    from unittest import mock
+
+    full = {"metric": "greet_qps", "value": 4000.0, "unit": "req/s",
+            "vs_baseline": 4.0, "detail": {"p50_ms": 0.4,
+                                           "uncongested_p50_ms": 0.35}}
+    summary = bench._summary_line(full)
+    stdout = _json.dumps(full) + "\n" + _json.dumps(summary) + "\n"
+    proc = mock.Mock(stdout=stdout)
+    with mock.patch("subprocess.run", return_value=proc):
+        got = bench._greet_subprocess()
+    assert got == full
