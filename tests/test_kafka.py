@@ -463,3 +463,30 @@ class TestKafkaSaslTls:
         _struct.pack_into(">I", ctrl, 17, kp.crc32c(body))
         out = kp.decode_record_batches(data + bytes(ctrl))
         assert [(r.key, r.value) for r in out] == [(b"k", b"v")]
+
+    def test_reconnect_reauthenticates(self):
+        """Every fresh socket redoes the SASL handshake — a broker-side
+        drop must not leave the client sending unauthenticated requests
+        (which the broker would cut)."""
+        b = FakeKafkaBroker(users={"svc": "hunter2"})
+        c = self._authed(b, "SCRAM-SHA-256")
+        try:
+            c.publish_sync("rc", b"before")
+            c.flush()
+            # wait out any in-flight background flush before dropping the
+            # socket (the 50 ms flusher can race the explicit flush)
+            deadline = time.time() + 2
+            while [r.value for r in b.records("rc")] != [b"before"]:
+                assert time.time() < deadline, b.records("rc")
+                time.sleep(0.01)
+            bk = c._broker_at(b.host, b.port)
+            bk.close()  # simulate broker-side connection drop
+            c.publish_sync("rc", b"after")
+            c.flush()
+            deadline = time.time() + 2
+            while len(b.records("rc")) < 2 and time.time() < deadline:
+                time.sleep(0.01)
+            assert [r.value for r in b.records("rc")] == [b"before", b"after"]
+        finally:
+            c.close()
+            b.close()
