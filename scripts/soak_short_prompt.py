@@ -1,0 +1,48 @@
+"""End-of-round soak: the short-prompt north-star point held for N
+minutes, zero errors (round-4 precedent: 1,018 QPS over 3 min).
+
+Run on the real chip: `python scripts/soak_short_prompt.py [minutes]`.
+"""
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+
+def main() -> None:
+    minutes = float(sys.argv[1]) if len(sys.argv) > 1 else 3.0
+    import jax
+
+    from bench import _closed_loop
+    from gofr_tpu.llm import LLMEngine
+    from gofr_tpu.models import TransformerConfig, init_params
+    from gofr_tpu.models.quant import quantize_params
+
+    cfg = TransformerConfig.gemma_2b()
+    params = jax.jit(init_params, static_argnums=1)(jax.random.PRNGKey(0), cfg)
+    eng = LLMEngine(
+        cfg, params, slots=256, max_seq_len=16 + 16 + 16,
+        prefill_buckets=(16,), decode_chunk=8, admit_cap=32, quantize=True,
+    )
+    try:
+        _closed_loop(eng, cfg, 8, 16, 512, 1024)  # warm
+        t_end = time.time() + minutes * 60
+        total = 0
+        t0 = time.perf_counter()
+        rounds = []
+        while time.time() < t_end:
+            r = _closed_loop(eng, cfg, 8, 16, 4096, 1024)
+            rounds.append(r["qps"])
+            total += r["requests"]
+        wall = time.perf_counter() - t0
+        print(
+            f"SOAK ok: {total} completions in {wall/60:.1f} min, "
+            f"sustained {total/wall:.1f} QPS "
+            f"(per-round {min(rounds):.0f}-{max(rounds):.0f}), zero errors"
+        )
+    finally:
+        eng.close()
+
+
+if __name__ == "__main__":
+    main()
