@@ -8,6 +8,7 @@ shard_map + ppermute) makes long-context first-class.
 """
 
 from .mesh import make_mesh, mesh_shape_for
+from .multihost import init_distributed, is_primary, topology
 from .ring import ring_attention, ring_prefill
 from .sharding import (
     batch_spec,
@@ -25,6 +26,9 @@ from .pipeline import (
 from .train import lm_loss, make_train_step, place_batch
 
 __all__ = [
+    "init_distributed",
+    "is_primary",
+    "topology",
     "pipeline_layers",
     "pp_lm_loss",
     "pp_param_shardings",
