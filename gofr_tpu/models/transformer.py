@@ -54,6 +54,7 @@ class TransformerConfig:
     final_logit_cap: float = 0.0
     act: str = "gelu"  # MLP gate activation: "gelu" (Gemma) | "silu" (Llama)
     scale_embed: bool = True  # multiply embeddings by sqrt(d_model) (Gemma)
+    sliding_window: int = 0  # Mistral-style local attention; 0 = global
     dtype: Any = jnp.bfloat16
 
     # ---- presets -------------------------------------------------------
@@ -77,6 +78,30 @@ class TransformerConfig:
             vocab_size=128_256, d_model=4096, n_layers=32, n_heads=32,
             n_kv_heads=8, head_dim=128, d_ff=14_336, rope_theta=500_000.0,
             norm_eps=1e-5, act="silu", scale_embed=False,
+        )
+
+    @staticmethod
+    def mistral_7b() -> "TransformerConfig":
+        """Mistral-7B-v0.1: Llama-shaped (SwiGLU, GQA 32/8, untied head,
+        no embed scaling) plus a 4096-token sliding attention window —
+        each layer attends locally, with receptive field growing by one
+        window per layer."""
+        return TransformerConfig(
+            vocab_size=32_000, d_model=4096, n_layers=32, n_heads=32,
+            n_kv_heads=8, head_dim=128, d_ff=14_336, rope_theta=10_000.0,
+            norm_eps=1e-5, act="silu", scale_embed=False,
+            sliding_window=4096,
+        )
+
+    @staticmethod
+    def tiny_mistral(vocab_size: int = 512) -> "TransformerConfig":
+        """CI-sized Mistral-style config: window 8 so sequences past 8
+        tokens actually exercise the band mask."""
+        return TransformerConfig(
+            vocab_size=vocab_size, d_model=64, n_layers=2, n_heads=4,
+            n_kv_heads=2, head_dim=16, d_ff=128, rope_theta=10_000.0,
+            norm_eps=1e-5, act="silu", scale_embed=False,
+            sliding_window=8, dtype=jnp.float32,
         )
 
     @staticmethod
@@ -198,7 +223,8 @@ def _layer_body(
         k_cache = upd(k_cache, k.astype(k_cache.dtype), cache_length)
         v_cache = upd(v_cache, v.astype(v_cache.dtype), cache_length)
         attn = decode_attention(
-            q, k_cache, v_cache, cache_length + 1, logit_cap=cfg.attn_logit_cap
+            q, k_cache, v_cache, cache_length + 1,
+            logit_cap=cfg.attn_logit_cap, window=cfg.sliding_window,
         )
         new_k, new_v = k_cache, v_cache
     else:
@@ -210,7 +236,10 @@ def _layer_body(
         if prefill_attn is not None:
             attn = prefill_attn(q, k, v)
         else:
-            attn = multi_head_attention(q, k, v, causal=True, logit_cap=cfg.attn_logit_cap)
+            attn = multi_head_attention(
+                q, k, v, causal=True, logit_cap=cfg.attn_logit_cap,
+                window=cfg.sliding_window,
+            )
         # Prefill fills the cache from position 0 (right-padded batches).
         new_k, new_v = k, v
 
@@ -437,7 +466,7 @@ def decode_chunk(
             )
             attn = chunk_decode_attention(
                 q, kc_l, vc_l, kb_l, vb_l, cache.length, k_i,
-                logit_cap=cfg.attn_logit_cap,
+                logit_cap=cfg.attn_logit_cap, window=cfg.sliding_window,
             )
             x = x + qmm(attn.reshape(b, 1, hq * hd), lp["wo"]).astype(x.dtype)
             h = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)

@@ -52,6 +52,7 @@ def mha_reference(
     logit_cap: float = 0.0,
     kv_mask: jnp.ndarray | None = None,  # [b, sk] bool, True = attend
     q_positions: jnp.ndarray | None = None,  # [b, sq] absolute positions
+    window: int = 0,  # sliding window: attend to (q_pos - window, q_pos]
 ) -> jnp.ndarray:
     b, sq, hq, d = q.shape
     hkv = k.shape[2]
@@ -69,14 +70,19 @@ def mha_reference(
 
     sk = k.shape[1]
     mask = jnp.ones((b, sq, sk), dtype=bool)
-    if causal:
+    if causal or window > 0:
         qpos = (
             q_positions
             if q_positions is not None
             else jnp.broadcast_to(jnp.arange(sq), (b, sq))
         )
         kpos = jnp.arange(sk)
-        mask = mask & (kpos[None, None, :] <= qpos[:, :, None])
+        if causal:
+            mask = mask & (kpos[None, None, :] <= qpos[:, :, None])
+        if window > 0:
+            # sliding window (Mistral): keys older than window-1 positions
+            # before the query are masked out
+            mask = mask & (kpos[None, None, :] > qpos[:, :, None] - window)
     if kv_mask is not None:
         mask = mask & kv_mask[:, None, :]
     logits = jnp.where(mask[:, None, None, :, :], logits, NEG_INF)
@@ -243,6 +249,7 @@ def decode_attention(
     *,
     scale: float | None = None,
     logit_cap: float = 0.0,
+    window: int = 0,  # sliding window over absolute positions
 ) -> jnp.ndarray:
     """Decode is HBM-bandwidth-bound, so the einsums read the cache at its
     STORED dtype (f32 accumulation via preferred_element_type) — routing
@@ -266,6 +273,11 @@ def decode_attention(
     if logit_cap > 0.0:
         s = logit_cap * jnp.tanh(s / logit_cap)
     kv_mask = jnp.arange(max_len)[None, :] < lengths[:, None]  # [b, max_len]
+    if window > 0:
+        # query sits at absolute position lengths-1: keep [lengths-window, ..)
+        kv_mask = kv_mask & (
+            jnp.arange(max_len)[None, :] >= lengths[:, None] - window
+        )
     s = jnp.where(kv_mask[:, None, None, None, :], s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1).astype(v_cache.dtype)
     out = jnp.einsum(
@@ -285,6 +297,7 @@ def chunk_decode_attention(
     *,
     scale: float | None = None,
     logit_cap: float = 0.0,
+    window: int = 0,  # sliding window over absolute positions
 ) -> jnp.ndarray:
     """Decode attention over main cache + chunk ring buffer.
 
@@ -316,6 +329,13 @@ def chunk_decode_attention(
         s_buf = logit_cap * jnp.tanh(s_buf / logit_cap)
     main_mask = jnp.arange(max_len)[None, :] < lengths[:, None]  # [b, max_len]
     buf_mask = jnp.arange(chunk)[None, :] <= step  # [1, chunk]
+    if window > 0:
+        # query's absolute position is lengths + step; main-cache rows live
+        # at absolute 0..lengths-1 and buffer row i at lengths + i
+        main_mask = main_mask & (
+            jnp.arange(max_len)[None, :] > lengths[:, None] + step - window
+        )
+        buf_mask = buf_mask & (jnp.arange(chunk)[None, :] > step - window)
     s_main = jnp.where(main_mask[:, None, None, None, :], s_main, NEG_INF)
     s_buf = jnp.where(buf_mask[:, None, None, None, :], s_buf, NEG_INF)
 
@@ -365,18 +385,23 @@ def multi_head_attention(
     logit_cap: float = 0.0,
     kv_mask: jnp.ndarray | None = None,
     q_positions: jnp.ndarray | None = None,
+    window: int = 0,
     block_q: int = 128,
     block_k: int = 128,
 ) -> jnp.ndarray:
     """Platform dispatcher: Pallas flash kernel on TPU when shapes tile
-    cleanly onto the MXU, XLA reference otherwise. kv_mask/q_positions force
-    the reference path (the flash kernel assumes dense causal prefill)."""
-    if kv_mask is None and q_positions is None and _flash_ok(q, k, block_q, block_k):
+    cleanly onto the MXU, XLA reference otherwise. kv_mask/q_positions/
+    window force the reference path (the flash kernel assumes dense causal
+    prefill)."""
+    if (
+        kv_mask is None and q_positions is None and window == 0
+        and _flash_ok(q, k, block_q, block_k)
+    ):
         return flash_attention(
             q, k, v, causal=causal, scale=scale, logit_cap=logit_cap,
             block_q=block_q, block_k=block_k,
         )
     return mha_reference(
         q, k, v, causal=causal, scale=scale, logit_cap=logit_cap,
-        kv_mask=kv_mask, q_positions=q_positions,
+        kv_mask=kv_mask, q_positions=q_positions, window=window,
     )

@@ -55,11 +55,27 @@ def init_distributed(
                 num_processes=num_processes,
                 process_id=process_id,
             )
-        elif jax.default_backend() == "tpu":
+        elif _tpu_plausible():
             # TPU pods self-discover coordinator/topology from metadata;
-            # single-host TPU initializes to a 1-process "cluster"
-            jax.distributed.initialize()
+            # single-host TPU initializes to a 1-process "cluster". The
+            # plausibility check must NOT touch jax.default_backend():
+            # evaluating it initializes XLA, after which initialize()
+            # always raises — so detect via libtpu/env, and treat a
+            # too-late call as single-process rather than crashing.
+            try:
+                jax.distributed.initialize()
+            except (RuntimeError, ValueError):
+                pass  # backend already up, or not actually a pod
     return topology()
+
+
+def _tpu_plausible() -> bool:
+    """TPU presence WITHOUT initializing the XLA backend."""
+    import importlib.util
+
+    if "tpu" in os.environ.get("JAX_PLATFORMS", "").lower():
+        return True
+    return importlib.util.find_spec("libtpu") is not None
 
 
 def topology() -> dict:

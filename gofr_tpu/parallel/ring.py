@@ -165,6 +165,12 @@ def ring_prefill(
 
     if getattr(cfg, "attn_logit_cap", 0.0):
         raise NotImplementedError("ring_prefill: attn_logit_cap unsupported")
+    if getattr(cfg, "sliding_window", 0):
+        # the prefill_attn override bypasses _layer_body's window mask;
+        # silently attending globally would fill the cache with logits
+        # that diverge from the model — refuse until the ring kernel
+        # learns band masking
+        raise NotImplementedError("ring_prefill: sliding_window unsupported")
     n = mesh.shape[axis]
     b, s = tokens.shape
     if s % n != 0:

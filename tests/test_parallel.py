@@ -529,3 +529,18 @@ class TestRingPrefill:
         toks = jnp.zeros((1, 60), jnp.int32)  # 60 % 8 != 0
         with pytest.raises(ValueError):
             ring_prefill(params, cfg, toks, jnp.asarray([60]), mesh=mesh)
+
+
+def test_ring_prefill_refuses_sliding_window():
+    """ring_prefill's attention override bypasses the band mask — it must
+    refuse windowed configs instead of silently attending globally."""
+    from gofr_tpu.models import TransformerConfig, init_params
+    from gofr_tpu.parallel import make_mesh, ring_prefill
+
+    cfg = TransformerConfig.tiny_mistral()
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    mesh = make_mesh({"seq": 8})
+    toks = jnp.zeros((1, 16), jnp.int32)
+    lens = jnp.asarray([16], jnp.int32)
+    with pytest.raises(NotImplementedError, match="sliding_window"):
+        ring_prefill(params, cfg, toks, lens, mesh=mesh)
