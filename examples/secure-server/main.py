@@ -1,0 +1,109 @@
+"""examples/secure-server: every security surface in one app.
+
+HTTPS serving, basic-auth-protected routes, password+TLS Redis, and
+SCRAM-SHA-256+TLS MongoDB — the production posture the reference gets
+from its driver libraries and ingress, wired explicitly here
+(docs/advanced-guide/security.md).
+
+Demo mode (default, SECURE_DEMO=0 to disable) starts in-process
+stand-ins speaking the real wire protocols — MiniRedis enforcing AUTH
+over TLS and FakeMongoServer enforcing SCRAM over TLS, both serving a
+generated self-signed certificate — then wires the app through the
+SAME env-config path a real deployment uses. Point the env at real
+services (REDIS_HOST, SECURE_MONGO_HOST/PORT, your CA) and unset
+SECURE_DEMO for production.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, "../..")
+
+import gofr_tpu
+from gofr_tpu.datasource.mongo.wire import WireMongo
+
+BASIC_USER, BASIC_PASS = "admin", "change-me"
+
+
+async def store_secret(ctx):
+    body = ctx.bind()
+    if not isinstance(body, dict):
+        raise gofr_tpu.ErrorInvalidParam("body")
+    for key, value in body.items():
+        await ctx.redis.set(f"secret:{key}", value)
+        ctx.mongo.insert_one("audit", {"action": "store", "key": key})
+    return "stored"
+
+
+async def read_secret(ctx):
+    key = ctx.path_param("key")
+    value = await ctx.redis.get(f"secret:{key}")
+    if value is None:
+        raise gofr_tpu.ErrorEntityNotFound("secret", key)
+    ctx.mongo.insert_one("audit", {"action": "read", "key": key})
+    return {key: value.decode()}
+
+
+async def audit_log(ctx):
+    entries = ctx.mongo.find("audit")
+    return {"entries": [
+        {"action": e["action"], "key": e["key"]} for e in entries
+    ]}
+
+
+def _start_demo_backends():
+    """In-process authed+TLS stand-ins, wired through the standard env
+    convention so the app code below is identical to production."""
+    from gofr_tpu.testutil import MiniRedis, self_signed_cert
+    from gofr_tpu.testutil.fakemongo import FakeMongoServer
+
+    cert, key = self_signed_cert()
+    redis = MiniRedis(password="redis-demo-pw", tls=True).start()
+    mongo = FakeMongoServer(users={"svc": "mongo-demo-pw"}, tls=True)
+    os.environ.setdefault("HTTP_TLS_CERT_FILE", cert)
+    os.environ.setdefault("HTTP_TLS_KEY_FILE", key)
+    os.environ["REDIS_HOST"] = "127.0.0.1"
+    os.environ["REDIS_PORT"] = str(redis.port)
+    os.environ["REDIS_PASSWORD"] = "redis-demo-pw"
+    os.environ["REDIS_TLS"] = "true"
+    os.environ["REDIS_TLS_CA_CERT"] = cert
+    os.environ["SECURE_MONGO_HOST"] = "127.0.0.1"
+    os.environ["SECURE_MONGO_PORT"] = str(mongo.port)
+    os.environ["SECURE_MONGO_USER"] = "svc"
+    os.environ["SECURE_MONGO_PASSWORD"] = "mongo-demo-pw"
+    os.environ["SECURE_MONGO_TLS_CA_CERT"] = cert
+    return redis, mongo
+
+
+def build_app():
+    demo = os.environ.get("SECURE_DEMO", "1").lower() not in (
+        "0", "false", "no", "off",
+    )
+    backends = _start_demo_backends() if demo else None
+
+    app = gofr_tpu.new()
+    app._secure_demo_backends = backends  # kept alive with the app
+
+    # Mongo is provider-injected (mongo.go:41-74 pattern), with SCRAM+TLS
+    import ssl
+
+    ca = os.environ.get("SECURE_MONGO_TLS_CA_CERT")
+    tls = ssl.create_default_context(cafile=ca) if ca else None
+    app.add_mongo(WireMongo(
+        os.environ.get("SECURE_MONGO_HOST", "localhost"),
+        int(os.environ.get("SECURE_MONGO_PORT", "27017")),
+        "securedb",
+        username=os.environ.get("SECURE_MONGO_USER"),
+        password=os.environ.get("SECURE_MONGO_PASSWORD"),
+        tls=tls,
+    ))
+
+    app.enable_basic_auth(BASIC_USER, BASIC_PASS)
+    app.post("/secrets", store_secret)
+    app.get("/secrets/{key}", read_secret)
+    app.get("/audit", audit_log)
+    return app
+
+
+if __name__ == "__main__":
+    build_app().run()
