@@ -71,6 +71,7 @@ def _start_demo_backends():
     os.environ["SECURE_MONGO_PORT"] = str(mongo.port)
     os.environ["SECURE_MONGO_USER"] = "svc"
     os.environ["SECURE_MONGO_PASSWORD"] = "mongo-demo-pw"
+    os.environ["SECURE_MONGO_TLS"] = "true"
     os.environ["SECURE_MONGO_TLS_CA_CERT"] = cert
     return redis, mongo
 
@@ -85,10 +86,10 @@ def build_app():
     app._secure_demo_backends = backends  # kept alive with the app
 
     # Mongo is provider-injected (mongo.go:41-74 pattern), with SCRAM+TLS
-    import ssl
+    # via the shared {PREFIX}_TLS / _TLS_CA_CERT / _TLS_INSECURE convention
+    from gofr_tpu.datasource import tls_from_config
 
-    ca = os.environ.get("SECURE_MONGO_TLS_CA_CERT")
-    tls = ssl.create_default_context(cafile=ca) if ca else None
+    tls = tls_from_config(app.config, "SECURE_MONGO")
     app.add_mongo(WireMongo(
         os.environ.get("SECURE_MONGO_HOST", "localhost"),
         int(os.environ.get("SECURE_MONGO_PORT", "27017")),

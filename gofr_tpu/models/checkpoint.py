@@ -105,7 +105,20 @@ def _params_from_hf(
     def t(x):  # HF [out, in] -> ours [in, out]
         return np.ascontiguousarray(np.asarray(x).T)
 
+    # Qwen2 family: bias on the q/k/v projections (packed like the weights).
+    # The checkpoint and the config must agree — a silent mismatch would
+    # either drop loaded biases from the forward pass or KeyError deep
+    # inside a jit trace.
+    has_bias = "model.layers.0.self_attn.q_proj.bias" in tensors
+    cfg_bias = getattr(cfg, "qkv_bias", False)
+    if has_bias != cfg_bias:
+        raise ValueError(
+            f"checkpoint {'has' if has_bias else 'lacks'} q/k/v projection "
+            f"biases but cfg.qkv_bias={cfg_bias} — use a matching config "
+            f"(e.g. TransformerConfig.qwen2_7b() for Qwen2 checkpoints)"
+        )
     wq, wkv, wo, w_gate, w_up, w_down, attn_n, mlp_n = ([] for _ in range(8))
+    bq, bkv = [], []
     for i in range(L):
         p = f"model.layers.{i}."
         wq.append(t(_get(tensors, p + "self_attn.q_proj.weight")))  # [d, hq*hd]
@@ -115,6 +128,15 @@ def _params_from_hf(
         k = k.reshape(d, hkv, hd)
         v = v.reshape(d, hkv, hd)
         wkv.append(np.stack([k, v], axis=2).reshape(d, 2 * hkv * hd))
+        if has_bias:
+            bq.append(np.asarray(_get(tensors, p + "self_attn.q_proj.bias")))
+            kb = np.asarray(_get(tensors, p + "self_attn.k_proj.bias"))
+            vb = np.asarray(_get(tensors, p + "self_attn.v_proj.bias"))
+            bkv.append(
+                np.stack(
+                    [kb.reshape(hkv, hd), vb.reshape(hkv, hd)], axis=1
+                ).reshape(2 * hkv * hd)
+            )
         wo.append(t(_get(tensors, p + "self_attn.o_proj.weight")))  # [hq*hd, d]
         w_gate.append(t(_get(tensors, p + "mlp.gate_proj.weight")))  # [d, ff]
         w_up.append(t(_get(tensors, p + "mlp.up_proj.weight")))
@@ -154,11 +176,13 @@ def _params_from_hf(
         if head.shape != embed.shape or sample_differs or not np.array_equal(head, embed):
             out_extra["unembed"] = jnp.asarray(head, dt)
 
+    bias_layers = {"bq": stack(bq), "bkv": stack(bkv)} if has_bias else {}
     return {
         **out_extra,
         "embed": jnp.asarray(embed, dt),
         "final_norm": jnp.asarray(final_norm, dt),
         "layers": {
+            **bias_layers,
             "attn_norm": stack(attn_n),
             "wq": stack(wq),
             "wkv": stack(wkv),

@@ -146,10 +146,19 @@ def init_params_quantized(rng, cfg, dtype=jnp.bfloat16) -> dict:
         s = jnp.full(s_shape, 1.0 / (73.0 * fan_in**0.5), dtype)
         return QTensor(q=q, s=s)
 
+    bias = (
+        {
+            "bq": jnp.zeros((L, hq * hd), dtype),
+            "bkv": jnp.zeros((L, 2 * hkv * hd), dtype),
+        }
+        if getattr(cfg, "qkv_bias", False)
+        else {}
+    )
     return {
         "embed": qw((cfg.vocab_size, d), d),
         "final_norm": jnp.zeros((d,), dtype),
         "layers": {
+            **bias,
             "attn_norm": jnp.zeros((L, d), dtype),
             "wq": qw((L, d, hq * hd), d),
             "wkv": qw((L, d, 2 * hkv * hd), d),

@@ -55,6 +55,7 @@ class TransformerConfig:
     act: str = "gelu"  # MLP gate activation: "gelu" (Gemma) | "silu" (Llama)
     scale_embed: bool = True  # multiply embeddings by sqrt(d_model) (Gemma)
     sliding_window: int = 0  # Mistral-style local attention; 0 = global
+    qkv_bias: bool = False  # Qwen2-style bias on the q/k/v projections
     dtype: Any = jnp.bfloat16
 
     # ---- presets -------------------------------------------------------
@@ -105,6 +106,26 @@ class TransformerConfig:
         )
 
     @staticmethod
+    def qwen2_7b() -> "TransformerConfig":
+        """Qwen2-7B: Llama-shaped (SwiGLU, GQA 28/4, untied head, no
+        embed scaling) plus bias on the q/k/v projections."""
+        return TransformerConfig(
+            vocab_size=152_064, d_model=3584, n_layers=28, n_heads=28,
+            n_kv_heads=4, head_dim=128, d_ff=18_944, rope_theta=1_000_000.0,
+            norm_eps=1e-6, act="silu", scale_embed=False, qkv_bias=True,
+        )
+
+    @staticmethod
+    def tiny_qwen2(vocab_size: int = 512) -> "TransformerConfig":
+        """CI-sized Qwen2-style config (silu, qkv bias, no embed scale)."""
+        return TransformerConfig(
+            vocab_size=vocab_size, d_model=64, n_layers=2, n_heads=4,
+            n_kv_heads=2, head_dim=16, d_ff=128, rope_theta=1_000_000.0,
+            norm_eps=1e-6, act="silu", scale_embed=False, qkv_bias=True,
+            dtype=jnp.float32,
+        )
+
+    @staticmethod
     def tiny_llama(vocab_size: int = 512) -> "TransformerConfig":
         """CI-sized Llama-style config (silu, no embed scale)."""
         return TransformerConfig(
@@ -150,10 +171,21 @@ def init_params(rng: jax.Array, cfg: TransformerConfig) -> dict:
             cfg.dtype
         )
 
+    bias = (
+        {
+            # random (not zero) so tests exercising random-init params make
+            # the bias add load-bearing, like a trained checkpoint's
+            "bq": w(jax.random.fold_in(keys[1], 1), (L, hq * hd), d),
+            "bkv": w(jax.random.fold_in(keys[2], 1), (L, 2 * hkv * hd), d),
+        }
+        if cfg.qkv_bias
+        else {}
+    )
     return {
         "embed": w(keys[0], (cfg.vocab_size, d), d),
         "final_norm": jnp.zeros((d,), cfg.dtype),
         "layers": {
+            **bias,
             "attn_norm": jnp.zeros((L, d), cfg.dtype),
             "wq": w(keys[1], (L, d, hq * hd), d),
             "wkv": w(keys[2], (L, d, 2 * hkv * hd), d),
@@ -206,11 +238,17 @@ def _layer_body(
     mm = qmm if decode else qmm_a8
 
     h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
-    q = mm(h, lp["wq"]).reshape(b, s, hq, hd)
+    q = mm(h, lp["wq"])
+    if cfg.qkv_bias:  # Qwen2: bias rides the flat output (pre-reshape)
+        q = q + lp["bq"].astype(q.dtype)
+    q = q.reshape(b, s, hq, hd)
     # wkv packs heads OUTERMOST ([hkv, 2, hd] per output column block) so a
     # TP shard of the flat output dim holds whole (k, v) head pairs — keeps
     # Megatron column-parallel layout collective-free inside the layer.
-    kv = mm(h, lp["wkv"]).reshape(b, s, hkv, 2, hd)
+    kv = mm(h, lp["wkv"])
+    if cfg.qkv_bias:
+        kv = kv + lp["bkv"].astype(kv.dtype)
+    kv = kv.reshape(b, s, hkv, 2, hd)
     k, v = kv[:, :, :, 0], kv[:, :, :, 1]
     q = apply_rope(q, positions, cfg.rope_theta)
     k = apply_rope(k, positions, cfg.rope_theta)
@@ -453,8 +491,14 @@ def decode_chunk(
         def layer(x, xs):
             lp, kc_l, vc_l, kb_l, vb_l = xs
             h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
-            q = qmm(h, lp["wq"]).reshape(b, 1, hq, hd)
-            kv = qmm(h, lp["wkv"]).reshape(b, 1, hkv, 2, hd)
+            q = qmm(h, lp["wq"])
+            if cfg.qkv_bias:
+                q = q + lp["bq"].astype(q.dtype)
+            q = q.reshape(b, 1, hq, hd)
+            kv = qmm(h, lp["wkv"])
+            if cfg.qkv_bias:
+                kv = kv + lp["bkv"].astype(kv.dtype)
+            kv = kv.reshape(b, 1, hkv, 2, hd)
             k_new, v_new = kv[:, :, :, 0], kv[:, :, :, 1]
             q = apply_rope(q, positions, cfg.rope_theta)
             k_new = apply_rope(k_new, positions, cfg.rope_theta)

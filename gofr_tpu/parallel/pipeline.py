@@ -156,17 +156,17 @@ def pp_param_shardings(
     staged = NamedSharding(mesh, P(axis))
     repl = NamedSharding(mesh, P())
     extra = {"unembed": repl} if untied else {}
+    layer_keys = [
+        "attn_norm", "wq", "wkv", "wo", "mlp_norm",
+        "w_gate", "w_up", "w_down",
+    ]
+    if getattr(cfg, "qkv_bias", False):  # Qwen2: biases are layer leaves too
+        layer_keys += ["bq", "bkv"]
     return {
         **extra,
         "embed": repl,
         "final_norm": repl,
-        "layers": {
-            k: staged
-            for k in (
-                "attn_norm", "wq", "wkv", "wo", "mlp_norm",
-                "w_gate", "w_up", "w_down",
-            )
-        },
+        "layers": {k: staged for k in layer_keys},
     }
 
 

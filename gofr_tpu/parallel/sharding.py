@@ -35,11 +35,18 @@ def param_specs(
     m = model_axis if tp > 1 else None
     kv = m if shard_kv else None
     extra = {"unembed": P(m, None)} if untied else {}
+    # Qwen2-style qkv biases follow their weight's output-column sharding
+    bias = (
+        {"bq": P(None, m), "bkv": P(None, kv)}
+        if getattr(cfg, "qkv_bias", False)
+        else {}
+    )
     return {
         **extra,
         "embed": P(m, None),
         "final_norm": P(None),
         "layers": {
+            **bias,
             "attn_norm": P(None, None),
             "wq": P(None, None, m),
             "wkv": P(None, None, kv),

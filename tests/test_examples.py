@@ -411,7 +411,8 @@ class TestSecureServer:
             "HTTP_TLS_CERT_FILE", "HTTP_TLS_KEY_FILE", "REDIS_HOST",
             "REDIS_PORT", "REDIS_PASSWORD", "REDIS_TLS", "REDIS_TLS_CA_CERT",
             "SECURE_MONGO_HOST", "SECURE_MONGO_PORT", "SECURE_MONGO_USER",
-            "SECURE_MONGO_PASSWORD", "SECURE_MONGO_TLS_CA_CERT",
+            "SECURE_MONGO_PASSWORD", "SECURE_MONGO_TLS",
+            "SECURE_MONGO_TLS_CA_CERT",
         )
         snapshot = {v: os.environ.pop(v, None) for v in demo_vars}
         try:

@@ -228,3 +228,89 @@ def test_mistral_decode_matches_prefill():
         want.append(nxt)
         seq.append(nxt)
     assert out == want
+
+
+def test_qwen2_qkv_bias_logits_match_hf():
+    """Qwen2 family: Llama-shaped weights plus bias on the q/k/v
+    projections. HF zero-initializes biases, which would make the bias
+    add unfalsifiable — randomize them first so they are load-bearing."""
+    from transformers import Qwen2Config, Qwen2ForCausalLM
+
+    torch.manual_seed(5)
+    hf_cfg = Qwen2Config(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=16, max_position_embeddings=64, rms_norm_eps=1e-6,
+        rope_theta=1_000_000.0, tie_word_embeddings=False,
+        attn_implementation="eager", sliding_window=None, use_sliding_window=False,
+    )
+    model = Qwen2ForCausalLM(hf_cfg).eval().float()
+    with torch.no_grad():
+        for layer in model.model.layers:
+            for proj in ("q_proj", "k_proj", "v_proj"):
+                getattr(layer.self_attn, proj).bias.normal_(std=0.5)
+
+    cfg = TransformerConfig.tiny_qwen2(vocab_size=256)
+    params = llama_params_from_hf(_state_np(model), cfg)
+    assert "bq" in params["layers"] and "bkv" in params["layers"]
+
+    rng = np.random.default_rng(5)
+    tokens = rng.integers(0, 256, (2, 14))
+    with torch.no_grad():
+        want = model(torch.tensor(tokens)).logits.numpy()
+    got = _our_logits(params, cfg, tokens)
+    assert np.max(np.abs(got - want)) < ATOL, np.max(np.abs(got - want))
+
+    # the biases are load-bearing: zeroing them must diverge
+    import jax.numpy as jnp
+
+    params0 = dict(params)
+    params0["layers"] = {
+        **params["layers"],
+        "bq": jnp.zeros_like(params["layers"]["bq"]),
+        "bkv": jnp.zeros_like(params["layers"]["bkv"]),
+    }
+    got0 = _our_logits(params0, cfg, tokens)
+    assert np.max(np.abs(got0 - want)) > 1e-2
+
+
+def test_qwen2_engine_matches_reference():
+    """qkv-bias family through the slot engine's fused chunk decode."""
+    from gofr_tpu.llm import GenRequest, LLMEngine
+    from gofr_tpu.models import generate, init_params
+
+    cfg = TransformerConfig.tiny_qwen2()
+    params = init_params(jax.random.PRNGKey(6), cfg)
+    eng = LLMEngine(cfg, params, slots=2, max_seq_len=64, prefill_buckets=(16,))
+    try:
+        rng = np.random.default_rng(6)
+        prompt = rng.integers(1, cfg.vocab_size, 11).tolist()
+        got = eng.submit(GenRequest(prompt, max_new_tokens=8)).tokens()
+        toks = jnp.asarray([prompt], jnp.int32)
+        lens = jnp.asarray([11], jnp.int32)
+        want = [int(t) for t in np.asarray(generate(params, cfg, toks, lens, 8))[0]]
+        assert got == want
+    finally:
+        eng.close()
+
+
+def test_loader_rejects_bias_config_mismatch():
+    """A checkpoint/config disagreement on qkv biases must fail loudly at
+    load time, not silently drop biases or KeyError inside a jit trace."""
+    from transformers import Qwen2Config, Qwen2ForCausalLM
+
+    torch.manual_seed(7)
+    hf_cfg = Qwen2Config(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=16, rms_norm_eps=1e-6, tie_word_embeddings=False,
+        sliding_window=None, use_sliding_window=False,
+    )
+    state = _state_np(Qwen2ForCausalLM(hf_cfg).eval().float())
+    # biased checkpoint + bias-free config
+    with pytest.raises(ValueError, match="qkv_bias"):
+        llama_params_from_hf(state, TransformerConfig.tiny_llama(vocab_size=256))
+    # bias-free checkpoint + biased config
+    unbiased = {k: v for k, v in state.items() if not k.endswith("_proj.bias")}
+    with pytest.raises(ValueError, match="qkv_bias"):
+        llama_params_from_hf(unbiased, TransformerConfig.tiny_qwen2(vocab_size=256))

@@ -544,3 +544,20 @@ def test_ring_prefill_refuses_sliding_window():
     lens = jnp.asarray([16], jnp.int32)
     with pytest.raises(NotImplementedError, match="sliding_window"):
         ring_prefill(params, cfg, toks, lens, mesh=mesh)
+
+
+def test_qwen2_bias_family_trains_under_pp():
+    """qkv-bias layer leaves must be covered by the pipeline-parallel
+    shardings (regression: the hard-coded key list omitted them)."""
+    import numpy as np
+
+    from gofr_tpu.parallel import make_pp_train_step
+
+    cfg = TransformerConfig.tiny_qwen2()
+    pmesh = jax.sharding.Mesh(np.array(jax.devices()[:2]).reshape(2), ("stage",))
+    shard_fn, init_opt, step = make_pp_train_step(cfg, pmesh, n_micro=2)
+    params = shard_fn(init_params(jax.random.PRNGKey(0), cfg))
+    toks = jax.random.randint(jax.random.PRNGKey(1), (4, 16), 0, cfg.vocab_size)
+    mask = jnp.ones_like(toks, dtype=bool)
+    _, _, loss = step(params, init_opt(params), toks, mask)
+    assert float(loss) > 0
