@@ -260,3 +260,26 @@ class TestStreamingPull:
         finally:
             c.close()
             server.close()
+
+    def test_stream_death_redials_transparently(self):
+        """A dropped StreamingPull stream (server restart, LB kill) must
+        not strand the subscriber: the next pull redials a fresh stream
+        and delivery continues."""
+        server = FakeGooglePubSub()
+        c = make_client(server)
+        try:
+            c._ensure_subscription("rd")
+            assert run(c.subscribe("rd", timeout=0.3)) is None  # opens stream
+            st = c._streams["rd"]
+            st._call.cancel()  # simulate the server dropping the stream
+            deadline = time.monotonic() + 5
+            while not st.dead and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert st.dead
+            c.publish_sync("rd", b"after-drop")
+            msg = run(c.subscribe("rd", timeout=5))
+            assert msg is not None and msg.value == b"after-drop"
+            assert c._streams["rd"] is not st  # a fresh stream took over
+        finally:
+            c.close()
+            server.close()

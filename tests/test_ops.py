@@ -101,3 +101,26 @@ class TestRMSNorm:
     def test_bf16_stays_bf16(self):
         x = jax.random.normal(jax.random.PRNGKey(0), (4, 64), jnp.bfloat16)
         assert rms_norm(x, jnp.zeros(64, jnp.bfloat16)).dtype == jnp.bfloat16
+
+
+def test_flash_sliding_window_matches_reference():
+    """Banded flash kernel (Mistral sliding window): block-skipped kernel
+    must equal the reference band mask, including queries whose whole
+    window is inside one block and ones spanning block boundaries."""
+    ks = jax.random.split(jax.random.PRNGKey(11), 3)
+    q, k, v = (jax.random.normal(kk, (2, 512, 4, 128)) for kk in ks)
+    for window in (64, 128, 200, 511):
+        ref = mha_reference(q, k, v, causal=True, window=window)
+        out = flash_attention(q, k, v, causal=True, window=window, interpret=True)
+        assert jnp.abs(ref - out).max() < 2e-5, window
+
+
+def test_flash_window_multiple_of_block_skips_blocks():
+    """Sanity at window == block size: the first K block of a late query
+    block is fully dead and must be skipped without poisoning the
+    running softmax (fully-masked-row guard)."""
+    ks = jax.random.split(jax.random.PRNGKey(12), 3)
+    q, k, v = (jax.random.normal(kk, (1, 384, 2, 128)) for kk in ks)
+    ref = mha_reference(q, k, v, causal=True, window=128)
+    out = flash_attention(q, k, v, causal=True, window=128, interpret=True)
+    assert jnp.abs(ref - out).max() < 2e-5
