@@ -47,9 +47,19 @@ def ring_attention(
     axis: str = "seq",
     causal: bool = True,
     scale: float | None = None,
+    window: int = 0,  # sliding window over GLOBAL positions; 0 = full
 ) -> jnp.ndarray:
     """Drop-in for multi_head_attention when seq is sharded. GQA: pass K/V
-    already expanded to q's head count (ring traffic is the cost anyway)."""
+    already expanded to q's head count (ring traffic is the cost anyway).
+
+    window > 0 applies the Mistral band (q_pos - window, q_pos] in global
+    coordinates: chunks entirely behind every local query's band are
+    skipped at the lax.cond (their rotation still happens — the ring
+    schedule is fixed — but their attention math doesn't), and straddling
+    chunks get an elementwise band mask. Rows transiently fully-masked in
+    a chunk self-correct through the finite-NEG_INF online softmax, the
+    same mechanism the flash kernel relies on; the diagonal chunk always
+    holds each row's own position, so no row ends fully masked."""
     scale = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
     n = mesh.shape[axis]
 
@@ -71,13 +81,25 @@ def ring_attention(
             src_idx = (axis_idx - t) % n  # chunk owner at this rotation
             # Chunk-level causality: attend iff src chunk is not in the future.
             live = src_idx <= axis_idx if causal else jnp.bool_(True)
+            if window > 0:
+                # chunk dead iff entirely behind every local query's band:
+                # its last global position <= first local q position - window
+                band_live = (src_idx + 1) * sq - 1 > axis_idx * sq - window
+                live = jnp.logical_and(live, band_live)
 
-            def do(carry_in, kc=kc, vc=vc, t=t):
+            def do(carry_in, kc=kc, vc=vc, t=t, src_idx=src_idx):
                 m_acc, l_acc, o_acc = carry_in
                 # Diagonal chunk (t == 0) needs the triangular mask; earlier
                 # chunks are fully visible (the cond already gated future
-                # chunks out), so no mask at all.
-                mask = tri if (causal and t == 0) else None
+                # chunks out) unless a band boundary cuts through them.
+                if window > 0:
+                    qpos = axis_idx * sq + jnp.arange(sq)[:, None]
+                    kpos = src_idx * sq + jnp.arange(sq)[None, :]
+                    mask = kpos > qpos - window
+                    if causal and t == 0:
+                        mask = mask & tri
+                else:
+                    mask = tri if (causal and t == 0) else None
                 m_c, l_c, o_c = _chunk_attn(qc, kc, vc, scale, mask)
                 m_new = jnp.maximum(m_acc, m_c)
                 a_old = jnp.exp(m_acc - m_new)
@@ -116,13 +138,17 @@ def _ring_prefill_fn(cfg, mesh: Mesh, axis: str, max_cache_len: int):
 
     reps = cfg.n_heads // cfg.n_kv_heads
 
+    window = getattr(cfg, "sliding_window", 0)
+
     def attn(q, k, v):
         # GQA: expand K/V to q's head count (ring traffic is the cost here
         # and KV is 1/reps of it; see ring_attention docstring)
         if reps > 1:
             k = jnp.repeat(k, reps, axis=2)
             v = jnp.repeat(v, reps, axis=2)
-        return ring_attention(q, k, v, mesh=mesh, axis=axis, causal=True)
+        return ring_attention(
+            q, k, v, mesh=mesh, axis=axis, causal=True, window=window
+        )
 
     @jax.jit
     def run(params, tokens, lengths):
@@ -165,12 +191,6 @@ def ring_prefill(
 
     if getattr(cfg, "attn_logit_cap", 0.0):
         raise NotImplementedError("ring_prefill: attn_logit_cap unsupported")
-    if getattr(cfg, "sliding_window", 0):
-        # the prefill_attn override bypasses _layer_body's window mask;
-        # silently attending globally would fill the cache with logits
-        # that diverge from the model — refuse until the ring kernel
-        # learns band masking
-        raise NotImplementedError("ring_prefill: sliding_window unsupported")
     n = mesh.shape[axis]
     b, s = tokens.shape
     if s % n != 0:

@@ -531,19 +531,37 @@ class TestRingPrefill:
             ring_prefill(params, cfg, toks, jnp.asarray([60]), mesh=mesh)
 
 
-def test_ring_prefill_refuses_sliding_window():
-    """ring_prefill's attention override bypasses the band mask — it must
-    refuse windowed configs instead of silently attending globally."""
-    from gofr_tpu.models import TransformerConfig, init_params
+def test_ring_attention_sliding_window_matches_reference():
+    """Banded ring attention: chunk skipping + in-chunk band masks over
+    global positions must equal the reference band mask, for windows
+    smaller than / equal to / spanning multiple ring chunks."""
+    from gofr_tpu.parallel import make_mesh, ring_attention
+
+    mesh = make_mesh({"seq": 8})
+    ks = jax.random.split(jax.random.PRNGKey(3), 3)
+    q, k, v = (jax.random.normal(kk, (2, 64, 4, 32)) for kk in ks)
+    for window in (3, 8, 20, 63):
+        ref = mha_reference(q, k, v, causal=True, window=window)
+        out = ring_attention(
+            q, k, v, mesh=mesh, axis="seq", causal=True, window=window
+        )
+        assert jnp.abs(ref - out).max() < 2e-5, window
+
+
+def test_ring_prefill_sliding_window_matches_plain_prefill():
+    """Long-context SP prefill for the Mistral family: seq-sharded ring
+    prefill logits must match the single-device windowed prefill."""
+    from gofr_tpu.models import TransformerConfig, init_params, prefill
     from gofr_tpu.parallel import make_mesh, ring_prefill
 
     cfg = TransformerConfig.tiny_mistral()
     params = init_params(jax.random.PRNGKey(0), cfg)
+    toks = jax.random.randint(jax.random.PRNGKey(1), (2, 32), 0, cfg.vocab_size)
+    lens = jnp.asarray([32, 32], jnp.int32)
+    ref, _ = prefill(params, cfg, toks, lens, 48)
     mesh = make_mesh({"seq": 8})
-    toks = jnp.zeros((1, 16), jnp.int32)
-    lens = jnp.asarray([16], jnp.int32)
-    with pytest.raises(NotImplementedError, match="sliding_window"):
-        ring_prefill(params, cfg, toks, lens, mesh=mesh)
+    out, _ = ring_prefill(params, cfg, toks, lens, mesh=mesh, max_cache_len=48)
+    assert jnp.abs(ref - out).max() < 1e-3
 
 
 def test_qwen2_bias_family_trains_under_pp():
