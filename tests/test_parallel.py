@@ -579,3 +579,27 @@ def test_qwen2_bias_family_trains_under_pp():
     mask = jnp.ones_like(toks, dtype=bool)
     _, _, loss = step(params, init_opt(params), toks, mask)
     assert float(loss) > 0
+
+
+def test_qwen2_bias_family_trains_dp_tp():
+    """Bias leaves ride the DP x TP train step like any other param
+    (sharded by param_specs, updated by the optimizer)."""
+    from gofr_tpu.parallel import make_train_step
+
+    cfg = TransformerConfig.tiny_qwen2()
+    mesh = make_mesh({"data": 2, "model": 4})
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    shard_fn, init_opt, step = make_train_step(cfg, mesh, learning_rate=1e-2)
+    params = shard_fn(params)
+    opt_state = init_opt(params)
+    toks = jax.random.randint(jax.random.PRNGKey(1), (4, 16), 0, cfg.vocab_size)
+    mask = jnp.ones_like(toks, dtype=bool)
+    toks, mask = place_batch((toks, mask), mesh)
+    first = None
+    b0 = params["layers"]["bq"]
+    for _ in range(5):
+        params, opt_state, loss = step(params, opt_state, toks, mask)
+        first = first if first is not None else float(loss)
+    assert float(loss) < first
+    # the biases actually trained (optimizer touched them)
+    assert float(jnp.abs(params["layers"]["bq"] - b0).max()) > 0
