@@ -41,8 +41,13 @@ def build_engine(app):
         "7b": TransformerConfig.gemma_7b,
         "llama3-8b": TransformerConfig.llama3_8b,
         "tiny-llama": TransformerConfig.tiny_llama,
+        # sliding-window presets: the engine automatically serves these
+        # from a window-bounded rolling KV cache (gofr_tpu.kvcache) —
+        # slot memory O(window) instead of O(LLM_MAX_SEQ)
+        "mistral-7b": TransformerConfig.mistral_7b,
+        "tiny-mistral": TransformerConfig.tiny_mistral,
     }[preset]()
-    is_llama = "llama" in preset
+    is_llama = "llama" in preset or "mistral" in preset
 
     ckpt = os.environ.get("GEMMA_CKPT", "")
     if ckpt:
@@ -84,6 +89,12 @@ def build_engine(app):
         # decode) — halves the HBM stream decode is bound by, and the only
         # way 7B fits one v5e chip
         quantize=os.environ.get("GEMMA_INT8", "").lower() in ("1", "true"),
+        # prefix_cache_mb is NOT passed here: register_llm defaults it
+        # from the documented TPU_LLM_PREFIX_CACHE_MB config knob
+        # (docs/references/configs.md). Set it >0 to retain prefill KV
+        # rows keyed by prompt so repeated/shared-prefix prompts skip
+        # prefill (gofr_tpu.kvcache); hit/miss/eviction counters appear
+        # on /metrics and in stats().
         **kw,
     )
 

@@ -248,6 +248,12 @@ class TPURuntime:
         self.default_max_batch = int(get("TPU_BATCH_MAX_SIZE", "64"))
         self.default_max_delay_ms = float(get("TPU_BATCH_MAX_DELAY_MS", "2"))
         self.default_max_inflight = int(get("TPU_BATCH_MAX_INFLIGHT", "8"))
+        # LLM engine kv-cache defaults (gofr_tpu.kvcache), overridable per
+        # register_llm call: prefix-cache byte budget in MB (0 disables).
+        # Same env-knob precedent as the batcher's KAFKA_BATCH_* lineage.
+        self.default_llm_prefix_cache_mb = float(
+            get("TPU_LLM_PREFIX_CACHE_MB", "0")
+        )
         self._models: dict[str, _Model] = {}
         self._lock = threading.Lock()
         if metrics is not None:
@@ -400,9 +406,14 @@ class TPURuntime:
         the plain models; reachable as ctx.tpu().llm(name). Pass
         `replicas=N` (or `devices=[...]` / `meshes=[(mesh, specs), ...]`)
         for data-parallel replicated serving — N independent engines with
-        a per-request router behind the same handle (SURVEY §2.8 row 1)."""
+        a per-request router behind the same handle (SURVEY §2.8 row 1).
+        KV layout/residency policy (rolling window caches, prefix reuse)
+        comes from gofr_tpu.kvcache; `prefix_cache_mb` defaults to the
+        TPU_LLM_PREFIX_CACHE_MB config knob."""
         from ...llm import LLMEngine, ReplicatedLLMEngine
 
+        engine_kw.setdefault("prefix_cache_mb", self.default_llm_prefix_cache_mb)
+        engine_kw.setdefault("kv_label", name)  # metric-series label
         if not hasattr(self, "_llms"):
             self._llms: dict[str, Any] = {}
         if name in self._llms:

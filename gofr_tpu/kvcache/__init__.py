@@ -1,0 +1,343 @@
+"""KV-cache subsystem: layout, residency, and reuse policy for LLM serving.
+
+The serving engine (gofr_tpu.llm) used to hard-code one dense KV slab
+[n_layers, slots, max_seq_len, hkv, hd] and pay a full prefill for every
+request. This package owns the engine's memory model instead, providing
+three pieces the same way vLLM's PagedAttention and SGLang's
+RadixAttention own theirs — adapted to a TPU-resident, statically-shaped
+engine where dynamic block tables would defeat XLA:
+
+- **Window-bounded rolling caches.** For sliding-window models (Mistral)
+  a slot never needs more than the last `window` K/V rows, so the slot
+  cache becomes a RING of capacity C = window + decode_chunk: row index =
+  absolute position mod C (ops.attention.ring_positions reconstructs
+  absolute positions for masking), prefill ring-packs its rows with one
+  gather, and the chunk merge wraps modulo C. Memory and decode bandwidth
+  per slot drop from O(max_seq_len) to O(window); tokens are bit-identical
+  to the dense path because attention sees exactly the same windowed keys.
+
+- **Prefix cache.** Hash of the prompt (the shared prefix unit at this
+  engine's wave-granular admission) -> the retained prefill artifacts:
+  one KV row [L, 1, C, hkv, hd] pair plus the last-token logits, with
+  reference counting (a pinned entry — looked up but not yet inserted —
+  is never evicted) and LRU eviction under a byte budget. The engine
+  consults it at admit: a hit skips the prefill wave entirely, assembling
+  cached rows into the existing _insert_many scatter path and sampling
+  the first token from the stored logits (greedy traffic reproduces the
+  uncached tokens exactly; sampled traffic draws from the same logits).
+
+- **Observability.** Hit/miss/eviction/store counters and resident-bytes
+  gauges, registered with the metrics manager (Prometheus: app_kvcache_*)
+  and surfaced through CacheManager.stats() -> engine.stats().
+
+No counterpart in the reference repo (a Go web framework); this is the
+serving-memory layer of the TPU north star (ROADMAP: long-context serving
+end-to-end, prefix caching — VERDICT r5 levers #1 and #9).
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Any
+
+import numpy as np
+
+__all__ = ["CacheManager", "PrefixCache", "ring_pack"]
+
+# Serializes metric registration across CacheManagers: ReplicatedLLMEngine
+# builds N engines on parallel threads, and a bare has()/new_* pair racing
+# itself emits the Manager's already-registered WARN — the exact noise the
+# probe exists to avoid. Registration itself is idempotent either way.
+_METRICS_REG_LOCK = threading.Lock()
+
+
+def ring_pack(cache, capacity: int):
+    """Re-layout a dense position-indexed prefill cache into a ring of
+    `capacity`: row j of the result holds the last prompt position
+    congruent to j mod capacity (ops.attention.ring_positions), i.e. the
+    newest `capacity` rows survive and older ones — already outside every
+    future window — are dropped. One gather per k/v (deterministic, unlike
+    a duplicate-index scatter, whose write order XLA leaves unspecified).
+    Never-written rows are zeroed so packed caches compare reproducibly.
+
+    cache.k/.v: [L, b, s, hkv, hd] with rows at their absolute positions
+    (right-padded prompts: rows >= length are pad junk and never gathered,
+    because ring_positions only yields p <= length-1). Returns the same
+    KVCache type with row axis `capacity` and lengths unchanged (absolute).
+    """
+    import jax.numpy as jnp
+
+    from ..models.transformer import KVCache
+    from ..ops import ring_positions
+
+    s = cache.k.shape[2]
+    pos = ring_positions(cache.length, capacity)  # [b, C]
+    valid = pos >= 0
+    idx = jnp.clip(pos, 0, s - 1)[None, :, :, None, None]
+
+    def take(a):
+        rows = jnp.take_along_axis(a, idx, axis=2)
+        return jnp.where(valid[None, :, :, None, None], rows, 0).astype(a.dtype)
+
+    return KVCache(k=take(cache.k), v=take(cache.v), length=cache.length)
+
+
+class _Entry:
+    """One retained prefix: device-resident KV row + last-token logits."""
+
+    __slots__ = ("key", "k", "v", "length", "logits", "nbytes", "refs")
+
+    def __init__(self, key, k, v, length, logits, nbytes):
+        self.key = key
+        self.k = k  # [L, 1, C, hkv, hd]
+        self.v = v
+        self.length = length  # int — absolute prompt length
+        self.logits = logits  # [1, vocab] f32 last-token logits
+        self.nbytes = nbytes
+        self.refs = 0
+
+
+class PrefixCache:
+    """Prompt-prefix -> retained KV rows, refcounted, LRU-evicted.
+
+    Thread-safe (the engine's scheduler thread mutates it while stats()
+    and the metrics exporter read from others). Lookup PINS the entry
+    (refs += 1) so eviction can never free rows an admission wave is
+    about to insert; the engine releases the pin after _insert_many.
+    Eviction is strict LRU over unpinned entries, triggered by put()
+    whenever resident bytes exceed the budget. An entry larger than the
+    whole budget is refused outright (storing it would evict everything
+    and then itself be the next victim)."""
+
+    def __init__(self, capacity_bytes: int, metrics=None, model: str = "llm"):
+        self.capacity_bytes = int(capacity_bytes)
+        self.metrics = metrics
+        self.model = model
+        self._entries: OrderedDict[bytes, _Entry] = OrderedDict()
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+        self.stores = 0
+        self.resident_bytes = 0
+
+    @staticmethod
+    def key_for(tokens) -> bytes:
+        """Exact-content key: the int32 bytes of the token sequence. A
+        dict keyed on the bytes themselves cannot collide (unlike a
+        truncated digest), and Python hashes them once per lookup."""
+        return np.asarray(tokens, np.int32).tobytes()
+
+    def _count(self, event: str) -> None:
+        if self.metrics is not None:
+            self.metrics.increment_counter(
+                "app_kvcache_events", 1.0, model=self.model, event=event
+            )
+
+    def _gauge(self) -> None:
+        if self.metrics is not None:
+            self.metrics.set_gauge(
+                "app_kvcache_resident_bytes", float(self.resident_bytes),
+                model=self.model, kind="prefix",
+            )
+
+    def lookup(self, key: bytes) -> _Entry | None:
+        """Hit: move to MRU, pin, return the entry. Miss: count, None."""
+        with self._lock:
+            e = self._entries.get(key)
+            if e is None:
+                self.misses += 1
+                self._count("miss")
+                return None
+            self._entries.move_to_end(key)
+            e.refs += 1
+            self.hits += 1
+        self._count("hit")
+        return e
+
+    def release(self, entry: _Entry) -> None:
+        with self._lock:
+            entry.refs -= 1
+
+    def put(self, key: bytes, k, v, length: int, logits) -> bool:
+        """Retain a freshly prefilled row; returns False when skipped
+        (duplicate key or oversized entry)."""
+        nbytes = int(k.nbytes) + int(v.nbytes) + int(logits.nbytes)
+        with self._lock:
+            if key in self._entries or nbytes > self.capacity_bytes:
+                return False
+            self._entries[key] = _Entry(key, k, v, int(length), logits, nbytes)
+            self.resident_bytes += nbytes
+            self.stores += 1
+            evicted = 0
+            while self.resident_bytes > self.capacity_bytes:
+                victim = next(
+                    (ky for ky, e in self._entries.items() if e.refs == 0), None
+                )
+                if victim is None:  # everything pinned: over budget, wait
+                    break
+                self.resident_bytes -= self._entries.pop(victim).nbytes
+                self.evictions += 1
+                evicted += 1
+        self._count("store")
+        for _ in range(evicted):
+            self._count("eviction")
+        self._gauge()
+        return True
+
+    def assemble(self, entries: list[_Entry], width: int, capacity: int):
+        """Stack pinned entries into a prefill-shaped wave: (KVCache
+        [L, width, capacity, ...], logits [width, vocab]). Padding rows
+        repeat entry 0 — the engine's insert meta is idempotent over pads.
+        Entries are stored TRIMMED to their prefill bucket (the byte
+        budget should buy prefixes, not padding), so each is zero-padded
+        back to the slot capacity here; the pad rows sit beyond every
+        entry's valid length and are never attended."""
+        import jax.numpy as jnp
+
+        from ..models.transformer import KVCache
+
+        es = list(entries) + [entries[0]] * (width - len(entries))
+
+        def widen(a):
+            pad = capacity - a.shape[2]
+            if pad == 0:
+                return a
+            return jnp.pad(a, [(0, 0), (0, 0), (0, pad), (0, 0), (0, 0)])
+
+        cache = KVCache(
+            k=jnp.concatenate([widen(e.k) for e in es], axis=1),
+            v=jnp.concatenate([widen(e.v) for e in es], axis=1),
+            length=jnp.asarray([e.length for e in es], jnp.int32),
+        )
+        return cache, jnp.concatenate([e.logits for e in es], axis=0)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+            self.resident_bytes = 0
+        self._gauge()
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "hits": self.hits,
+                "misses": self.misses,
+                "evictions": self.evictions,
+                "stores": self.stores,
+                "entries": len(self._entries),
+                "resident_bytes": self.resident_bytes,
+                "capacity_bytes": self.capacity_bytes,
+            }
+
+
+class CacheManager:
+    """Owns the serving engine's KV layout, residency, and reuse policy.
+
+    Layout decision (static, at engine build): a model with a sliding
+    window smaller than the sequence budget gets a ROLLING slot cache of
+    capacity `window + decode_chunk` — the window itself plus one chunk of
+    merge slack, so an end-of-chunk merge only ever overwrites rows
+    already behind every window (models.transformer.decode_chunk). Global-
+    attention models (or window >= max_seq_len) keep the dense slab; the
+    engine code is identical either way, only shapes and masks differ.
+
+    `window=None` auto-adopts cfg.sliding_window; `window=0` forces the
+    dense layout (the A/B lever the equality tests use).
+    """
+
+    def __init__(
+        self,
+        cfg,
+        slots: int,
+        max_seq_len: int,
+        decode_chunk: int,
+        *,
+        window: int | None = None,
+        prefix_cache_mb: float = 0.0,
+        metrics=None,
+        model: str = "llm",
+    ):
+        import jax.numpy as jnp
+
+        self.cfg = cfg
+        self.slots = slots
+        self.max_seq_len = max_seq_len
+        w = cfg.sliding_window if window is None else window
+        if w and w != cfg.sliding_window:
+            raise ValueError(
+                f"kv window {w} must match cfg.sliding_window "
+                f"{cfg.sliding_window} (attention masks use the config)"
+            )
+        self.window = int(w or 0)
+        self.rolling = 0 < self.window and self.window + decode_chunk < max_seq_len
+        self.capacity = self.window + decode_chunk if self.rolling else max_seq_len
+        # static arg for decode_chunk/attention: ring capacity, 0 = dense
+        self.ring = self.capacity if self.rolling else 0
+        itemsize = jnp.dtype(cfg.dtype).itemsize
+        self.slot_bytes = (
+            2 * cfg.n_layers * slots * self.capacity * cfg.n_kv_heads
+            * cfg.head_dim * itemsize
+        )
+        self.metrics = metrics
+        self.model = model
+        if metrics is not None:
+            with _METRICS_REG_LOCK:
+                if not metrics.has("app_kvcache_events"):
+                    metrics.new_counter(
+                        "app_kvcache_events",
+                        "kv-cache events (event=hit|miss|store|eviction)",
+                    )
+                if not metrics.has("app_kvcache_resident_bytes"):
+                    metrics.new_gauge(
+                        "app_kvcache_resident_bytes",
+                        "resident kv bytes (kind=slots|prefix)",
+                    )
+            metrics.set_gauge(
+                "app_kvcache_resident_bytes", float(self.slot_bytes),
+                model=model, kind="slots",
+            )
+        self.prefix = (
+            PrefixCache(int(prefix_cache_mb * 1024 * 1024), metrics, model)
+            if prefix_cache_mb > 0
+            else None
+        )
+
+    # -- slot cache -------------------------------------------------------
+    def init_cache(self, rows: int):
+        """A zeroed slot (or prefill-scratch) cache at the planned width."""
+        from ..models.transformer import init_cache
+
+        return init_cache(self.cfg, rows, self.capacity)
+
+    def prefill_cache_len(self, bucket: int) -> int:
+        """Row width the prefill op should build its cache at: the dense
+        layout pads straight to capacity; the rolling layout keeps the
+        position-indexed rows (bucket wide) and ring-packs after."""
+        return bucket if self.rolling else self.capacity
+
+    def pack_prefill(self, cache):
+        """Convert a freshly prefilled cache to the slot layout."""
+        return ring_pack(cache, self.capacity) if self.rolling else cache
+
+    # -- observability ----------------------------------------------------
+    def stats(self) -> dict[str, Any]:
+        return {
+            "layout": "rolling" if self.rolling else "dense",
+            "capacity": self.capacity,
+            "window": self.window,
+            "slot_bytes": self.slot_bytes,
+            "prefix": self.prefix.stats() if self.prefix is not None else None,
+        }
+
+    def close(self) -> None:
+        if self.prefix is not None:
+            self.prefix.clear()
+        if self.metrics is not None:
+            # the slab is freed with the engine: a stale gauge would keep
+            # reporting a closed engine's KV bytes as resident forever
+            self.metrics.set_gauge(
+                "app_kvcache_resident_bytes", 0.0,
+                model=self.model, kind="slots",
+            )

@@ -9,8 +9,16 @@ request model at the model-serving layer (SURVEY.md §7 hard part 5:
 Design (all shapes static; a bounded set of compiled executables):
 
 - **Slots.** A fixed decode batch of S slots with one persistent KV cache
-  [n_layers, S, max_seq_len, hkv, hd] on device. Inactive slots are masked
-  (their tokens are discarded on host; their cursors never advance).
+  on device whose layout the kvcache subsystem owns: a dense
+  [n_layers, S, max_seq_len, hkv, hd] slab for global attention, or a
+  window-bounded ROLLING ring [n_layers, S, window+chunk, hkv, hd] for
+  sliding-window models (O(window) memory/bandwidth per slot). Inactive
+  slots are masked (their tokens are discarded on host; their cursors
+  never advance).
+- **Prefix reuse.** With prefix_cache_mb > 0, admission consults a
+  refcounted LRU cache of retained prefill KV rows keyed by the prompt —
+  a hit skips the prefill wave entirely and inserts the cached rows
+  (gofr_tpu.kvcache; hit/miss/eviction counters in stats()["kvcache"]).
 - **Fused decode chunks.** Decode advances ALL slots K steps per dispatch
   (models.transformer.decode_chunk: a lax.scan over a chunk-ring-buffer
   layer body with on-device sampling — the main cache is read-only inside
@@ -143,12 +151,16 @@ class LLMEngine:
         metrics=None,
         warmup: bool = True,
         quantize: bool = False,
+        kv_window: int | None = None,
+        prefix_cache_mb: float = 0.0,
+        kv_label: str = "llm",
     ):
         import jax
         import jax.numpy as jnp
 
+        from .kvcache import CacheManager
         from .models.transformer import decode_chunk as chunk_fn
-        from .models.transformer import init_cache, prefill
+        from .models.transformer import prefill
         from .utils import enable_compilation_cache
 
         enable_compilation_cache(logger=logger)
@@ -193,6 +205,18 @@ class LLMEngine:
         self.shed = 0  # deadline sheds at admission
         self.logger = logger
         self.metrics = metrics
+        # KV layout/residency/reuse policy lives in the kvcache subsystem:
+        # rolling ring for sliding-window models (slot memory O(window)),
+        # dense slab otherwise; optional prompt-prefix reuse at admission.
+        # kv_label distinguishes metric series: register_llm passes the
+        # registered model name, and replicated serving suffixes a replica
+        # index — otherwise N replicas' resident-bytes gauges share one
+        # label set and clobber each other on /metrics.
+        self.kv = CacheManager(
+            cfg, slots, max_seq_len, decode_chunk,
+            window=kv_window, prefix_cache_mb=prefix_cache_mb,
+            metrics=metrics, model=kv_label,
+        )
         if mesh is not None and param_specs is not None:
             from .parallel.sharding import shard_params
 
@@ -223,24 +247,40 @@ class LLMEngine:
             sampled = jnp.take_along_axis(topi, local[:, None], axis=1)[:, 0]
             return jnp.where(temps > 0.0, sampled, greedy).astype(jnp.int32)
 
+        keep_logits = self.kv.prefix is not None
+
         def _prefill_op(params, pack, rng):
             """pack [nb, bucket+2] int32: tokens | lengths | temps-as-bits.
             One packed host->device transfer per wave — through the axon
             tunnel every h2d array costs ~3.5 ms of host-blocking latency
-            regardless of size, so the engine never ships loose vectors."""
+            regardless of size, so the engine never ships loose vectors.
+            For windowed configs the dense banded prefill is ring-packed to
+            the rolling slot width; when the prefix cache is on, the last-
+            token logits ride along so hits can re-sample first tokens."""
             tokens = pack[:, :-2]
             lengths = pack[:, -2]
             temps = jax.lax.bitcast_convert_type(pack[:, -1], jnp.float32)
-            last_logits, cache = prefill(params, cfg, tokens, lengths, max_seq_len)
+            last_logits, cache = prefill(
+                params, cfg, tokens, lengths,
+                self.kv.prefill_cache_len(tokens.shape[1]),
+            )
+            cache = self.kv.pack_prefill(cache)
             rng, sub = jax.random.split(rng)
             first = _sample(last_logits, temps, sub)
-            return first, cache, rng
+            return first, cache, (last_logits if keep_logits else None), rng
+
+        def _hit_first(logits, temps, rng):
+            """First token for prefix-cache hits: the stored last-token
+            logits sampled at each request's own temperature — greedy hits
+            reproduce the uncached stream bit-for-bit."""
+            rng, sub = jax.random.split(rng)
+            return _sample(logits, temps, sub), rng
 
         def _make_chunk_op(K: int):
             def _chunk_op(params, tokens, cache, active, temps, rng):
                 return chunk_fn(
                     params, cfg, tokens, cache, active, temps, rng,
-                    n_steps=K, sample_fn=_sample,
+                    n_steps=K, sample_fn=_sample, ring=self.kv.ring,
                 )
 
             return jax.jit(_chunk_op, donate_argnums=(2,))
@@ -287,19 +327,21 @@ class LLMEngine:
             return tail, active, temps
 
         self._prefill_op = jax.jit(_prefill_op)
-        # Two chunk lengths: the full chunk amortizes dispatch at load; a
-        # short chunk (quarter length) runs when the batch is quiet so a
-        # fresh request's prefill never queues behind ~90 ms of decode —
-        # pipeline granularity is the TTFT floor at low occupancy.
+        # Two chunk lengths: the full chunk amortizes dispatch and is
+        # chained eagerly to cover remaining demand (an 8-token completion
+        # costs ~2 RTTs); the short variant (quarter length) only serves
+        # tail ends where even one full chunk overshoots the whole batch's
+        # remaining need (_dispatch).
         self._chunk_short = max(1, decode_chunk // 4)
         self._chunk_ops = {decode_chunk: _make_chunk_op(decode_chunk)}
         if self._chunk_short != decode_chunk:
             self._chunk_ops[self._chunk_short] = _make_chunk_op(self._chunk_short)
         self._insert_many = jax.jit(_insert_many, donate_argnums=(0,))
         self._admit_update = jax.jit(_admit_update, donate_argnums=(0, 1, 2))
+        self._hit_first_op = jax.jit(_hit_first) if keep_logits else None
         self._rng = jax.random.PRNGKey(0)
 
-        self.cache = init_cache(cfg, slots, max_seq_len)
+        self.cache = self.kv.init_cache(slots)
         if device is not None:
             self.cache = jax.device_put(self.cache, device)
         self._slot_req: list[GenRequest | None] = [None] * slots
@@ -437,6 +479,7 @@ class LLMEngine:
                 "wave_reqs": self._stat_wave_reqs,
                 "rejected": self.rejected,
                 "shed": self.shed,
+                "kvcache": self.kv.stats(),
             }
 
     def load(self) -> int:
@@ -473,6 +516,7 @@ class LLMEngine:
         self._collector.join(timeout=15)
         self._abort_all()
         self._drain_pending()
+        self.kv.close()  # drop retained prefix rows (device buffers)
 
     def _drain_pending(self) -> None:
         """End-of-stream every request still in the waiting list or the
@@ -508,12 +552,16 @@ class LLMEngine:
         zero_rng = self._rng
         meta = jnp.zeros((3, self.admit_cap), jnp.int32)
 
-        from .models.transformer import init_cache
-
         def warm_prefill(nb: int, b: int):
             pack = jnp.zeros((nb, b + 2), jnp.int32).at[:, -2].set(1)
-            first, c, _ = self._prefill_op(self.params, pack, zero_rng)
+            first, c, _logits, _ = self._prefill_op(self.params, pack, zero_rng)
             return first, c
+
+        def warm_hit_first(nb: int):
+            self._hit_first_op(
+                jnp.zeros((nb, self.cfg.vocab_size), jnp.float32),
+                jnp.zeros((nb,), jnp.float32), zero_rng,
+            )
 
         # every power-of-two admission width (wave sizing in _admit)
         nbs: list[int] = []
@@ -531,7 +579,7 @@ class LLMEngine:
             the same buffer."""
             cache = self.cache
             for nb in nbs:
-                scratch = init_cache(self.cfg, nb, self.max_seq_len)
+                scratch = self.kv.init_cache(nb)
                 cache = self._insert_many(cache, scratch, meta)
                 self._admit_update(
                     jnp.zeros((self.slots,), jnp.int32),
@@ -549,11 +597,16 @@ class LLMEngine:
             return last, cache
 
         n_tasks = len(self.prefill_buckets) * len(nbs) + 1
+        if self._hit_first_op is not None:
+            n_tasks += len(nbs)
         with ThreadPoolExecutor(max_workers=n_tasks) as pool:
             futs = [pool.submit(warm_cache_ops)]
             for b in self.prefill_buckets:
                 for nb in nbs:
                     futs.append(pool.submit(warm_prefill, nb, b))
+            if self._hit_first_op is not None:
+                for nb in nbs:
+                    futs.append(pool.submit(warm_hit_first, nb))
             last, cache = futs[0].result()
             for f in futs[1:]:
                 f.result()
@@ -573,6 +626,16 @@ class LLMEngine:
             if n <= b:
                 return b
         return self.max_seq_len
+
+    def _wave_width(self, n: int) -> int:
+        """Admission-wave batch dim: next power of two, capped at
+        admit_cap — a wave of 2 must not pay the admit_cap-padded prefill
+        (measured nb=1: 4.3 ms, nb=16: 30.5 ms; mid-load throughput
+        collapsed when every trickle wave compiled/ran at full width).
+        Bounded executable count: log2(admit_cap)+1 variants per bucket
+        (and per hit-sample op), all pre-warmed — _warm enumerates the
+        SAME widths, so any change here must change there too."""
+        return min(self.admit_cap, 1 << max(0, n - 1).bit_length())
 
     def _inflight_steps(self) -> dict[int, int]:
         """Per-slot decode steps already dispatched for the CURRENT owner.
@@ -710,21 +773,49 @@ class LLMEngine:
         # without this the router undercounts a replica mid-admission and
         # least-loaded piles every request onto it
         self._admitting += len(pulled)
+        # prefix-cache consult: a hit skips its prefill wave entirely — the
+        # retained KV rows and stored last-token logits go through the SAME
+        # insert path as a prefilled wave (one _insert_many scatter + one
+        # tail merge), so shared-prefix traffic costs no device prefill.
+        # lookup() pins each entry (refcount) until its rows are inserted.
+        hits: list[tuple[GenRequest, Any]] = []
+        misses: list[GenRequest] = pulled
+        if self.kv.prefix is not None:
+            hits, misses = [], []
+            for r in pulled:
+                e = self.kv.prefix.lookup(self.kv.prefix.key_for(r.prompt_tokens))
+                (misses.append(r) if e is None else hits.append((r, e)))
+        try:
+            for i in range(0, len(hits), self.admit_cap):
+                group = hits[i : i + self.admit_cap]
+                reqs = [r for r, _ in group]
+                nb = self._wave_width(len(reqs))
+                new_cache, logits = self.kv.prefix.assemble(
+                    [e for _, e in group], nb, self.kv.capacity
+                )
+                temps = np.zeros((nb,), np.float32)
+                temps[: len(reqs)] = [r.temperature for r in reqs]
+                first_dev, self._rng = self._hit_first_op(
+                    logits, jnp.asarray(temps), self._rng
+                )
+                self._slot_in(reqs, first_dev, new_cache, free)
+        finally:
+            # unpin EVERY looked-up entry in all paths — including the
+            # groups never reached when an earlier group's device call
+            # escapes to the scheduler's recovery. A pin that never drops
+            # makes its entry uneviction-able forever.
+            for _, e in hits:
+                self.kv.prefix.release(e)
         # group by bucket to share prefill executions; chunks of admit_cap
         by_bucket: dict[int, list[GenRequest]] = {}
-        for r in pulled:
+        for r in misses:
             by_bucket.setdefault(self._bucket_for(len(r.prompt_tokens)), []).append(r)
         by_wave: list[tuple[int, list[GenRequest]]] = []
         for bucket, reqs in by_bucket.items():
             for i in range(0, len(reqs), self.admit_cap):
                 by_wave.append((bucket, reqs[i : i + self.admit_cap]))
         for bucket, reqs in by_wave:
-            # batch dim: next power of two — a wave of 2 must not pay the
-            # admit_cap-padded prefill (measured nb=1: 4.3 ms, nb=16:
-            # 30.5 ms; mid-load throughput collapsed when every trickle
-            # wave compiled/ran at the full width). Bounded executable
-            # count: log2(admit_cap)+1 variants per bucket, all pre-warmed.
-            nb = min(self.admit_cap, 1 << max(0, len(reqs) - 1).bit_length())
+            nb = self._wave_width(len(reqs))
             pack = np.zeros((nb, bucket + 2), np.int32)
             pack[:, -2] = 1  # pad rows: 1 token, discarded
             for j, r in enumerate(reqs):
@@ -733,7 +824,7 @@ class LLMEngine:
                 pack[j, -2] = n
                 pack[j, -1] = np.float32(r.temperature).view(np.int32)
             t0 = time.perf_counter()
-            first_dev, new_cache, self._rng = self._prefill_op(
+            first_dev, new_cache, logits_dev, self._rng = self._prefill_op(
                 self.params, jnp.asarray(pack), self._rng,
             )
             if self.metrics is not None:
@@ -741,37 +832,70 @@ class LLMEngine:
                     "app_tpu_stats", time.perf_counter() - t0,
                     model="llm", op=f"prefill_dispatch_{bucket}",
                 )
-            with self._work_cv:
-                meta = np.zeros((3, self.admit_cap), np.int32)
-                taken: list[tuple[int, GenRequest]] = []
+            if self.kv.prefix is not None:
+                # retain each fresh row + its last-token logits for future
+                # hits; device-side slices, refcount/LRU inside the cache.
+                # Rows are TRIMMED to the wave's bucket (valid rows never
+                # exceed it — dense slabs are capacity-wide and mostly pad
+                # at short buckets, so storing them whole would spend the
+                # byte budget capacity/bucket-fold on padding); assemble()
+                # pads back to capacity at hit time.
+                keep = min(bucket, self.kv.capacity)
                 for j, r in enumerate(reqs):
-                    slot = free.pop(0)
-                    old = self._slot_req[slot]
-                    if old is not None and old.cancelled and old.finish_reason is None:
-                        # a cancelled occupant may have no in-flight snapshot
-                        # left to deliver its end-of-stream — close it here
-                        old.finish_reason = "cancelled"
-                        old.out.put(None)
-                    taken.append((slot, r))
-                    self._slot_req[slot] = r
-                    meta[0, j], meta[1, j] = slot, j
-                    meta[2, j] = np.float32(r.temperature).view(np.int32)
-                # pad entries duplicate entry 0 (idempotent)
-                for j in range(len(reqs), self.admit_cap):
-                    meta[:, j] = meta[:, 0]
-                md = jnp.asarray(meta)  # ONE packed h2d per wave
-                self.cache = self._insert_many(self.cache, new_cache, md)
-                self._tail, self._active, self._temps = self._admit_update(
-                    self._tail, self._active, self._temps, first_dev, md
-                )
-                self._start_fetch(first_dev)
-                self._inflight.append(("prefill", first_dev, taken))
-                self._admitting -= len(reqs)
-                # under the lock: stats() iterates _stat_waves concurrently
-                self._stat_waves[nb] = self._stat_waves.get(nb, 0) + 1
-                self._stat_wave_reqs += len(reqs)
-                self._work_cv.notify()
+                    self.kv.prefix.put(
+                        self.kv.prefix.key_for(r.prompt_tokens),
+                        new_cache.k[:, j : j + 1, :keep],
+                        new_cache.v[:, j : j + 1, :keep],
+                        len(r.prompt_tokens), logits_dev[j : j + 1],
+                    )
+            self._slot_in(reqs, first_dev, new_cache, free, wave_nb=nb)
         return True
+
+    def _slot_in(
+        self,
+        reqs: list[GenRequest],
+        first_dev,
+        new_cache,
+        free: list[int],
+        wave_nb: int | None = None,
+    ) -> None:
+        """Shared admission tail for prefilled waves and prefix-cache hit
+        waves: copy KV rows into (virtually) free slots via ONE jitted
+        insert-many, scatter first tokens into the on-device chain tail,
+        and queue the entry for the collector. wave_nb records prefill wave
+        width telemetry (hit waves dispatched no prefill, so they don't)."""
+        jnp = self._jnp
+        with self._work_cv:
+            meta = np.zeros((3, self.admit_cap), np.int32)
+            taken: list[tuple[int, GenRequest]] = []
+            for j, r in enumerate(reqs):
+                slot = free.pop(0)
+                old = self._slot_req[slot]
+                if old is not None and old.cancelled and old.finish_reason is None:
+                    # a cancelled occupant may have no in-flight snapshot
+                    # left to deliver its end-of-stream — close it here
+                    old.finish_reason = "cancelled"
+                    old.out.put(None)
+                taken.append((slot, r))
+                self._slot_req[slot] = r
+                meta[0, j], meta[1, j] = slot, j
+                meta[2, j] = np.float32(r.temperature).view(np.int32)
+            # pad entries duplicate entry 0 (idempotent)
+            for j in range(len(reqs), self.admit_cap):
+                meta[:, j] = meta[:, 0]
+            md = jnp.asarray(meta)  # ONE packed h2d per wave
+            self.cache = self._insert_many(self.cache, new_cache, md)
+            self._tail, self._active, self._temps = self._admit_update(
+                self._tail, self._active, self._temps, first_dev, md
+            )
+            self._start_fetch(first_dev)
+            self._inflight.append(("prefill", first_dev, taken))
+            self._admitting -= len(reqs)
+            if wave_nb is not None:
+                # under the lock: stats() iterates _stat_waves concurrently
+                self._stat_waves[wave_nb] = self._stat_waves.get(wave_nb, 0) + 1
+                self._stat_wave_reqs += len(reqs)
+            self._work_cv.notify()
 
     @staticmethod
     def _start_fetch(arr) -> None:
@@ -816,18 +940,25 @@ class LLMEngine:
         """Launch one decode chunk chained from the on-device tail and
         return the dispatched chunk length (the scheduler debits it from
         its step budget). All inputs are device-resident — zero h2d
-        transfers per chunk. Chunk length adapts: the short variant runs
-        for tail ends (fewer steps needed than a short chunk) and for
-        quiet batches (low occupancy, empty queue) where fine pipeline
-        granularity keeps a fresh request's prefill from queueing behind a
-        long chunk."""
+        transfers per chunk. Chunk length adapts to DEMAND, not occupancy:
+        the short variant runs only for tail ends (fewer steps needed than
+        a short chunk); otherwise the full chunk is dispatched and chained
+        eagerly. The r5 engine instead forced short chunks whenever the
+        batch was quiet, optimizing speculative TTFT for requests that had
+        not arrived at the cost of 3-4x the fetch round trips for the
+        requests actually in flight (BENCH_r05: 507 ms completion p50 at
+        25 QPS against a ~100 ms TTFT floor). Demand-sized chunks finish
+        an 8-token completion in ~2 RTTs (prefill + one covering chunk);
+        a fresh arrival waits at most one chunk, and the collector's
+        prefill-priority jump still fetches its first token ahead of
+        queued chunk fetches. The saturated path is unchanged (full chunks
+        either way)."""
         with self._work_cv:
             snapshot = list(self._slot_req)
             active_n = sum(r is not None for r in snapshot)
-            quiet = active_n <= self.slots // 4 and not self._waiting
             k = (
                 self._chunk_short
-                if needed_steps <= self._chunk_short or quiet
+                if needed_steps <= self._chunk_short
                 else self.decode_chunk
             )
             toks, last, self.cache, self._rng = self._chunk_ops[k](
@@ -1125,12 +1256,16 @@ class ReplicatedLLMEngine:
         # would otherwise leak with no handle to free them.
         from concurrent.futures import ThreadPoolExecutor
 
+        kv_label = engine_kw.pop("kv_label", "llm")
         with ThreadPoolExecutor(max_workers=len(specs)) as pool:
             futures = [
+                # per-replica kv label: N replicas sharing one label set
+                # would clobber each other's resident-bytes gauges
                 pool.submit(
-                    LLMEngine, cfg, params, logger=logger, **spec, **engine_kw
+                    LLMEngine, cfg, params, logger=logger,
+                    kv_label=f"{kv_label}/r{i}", **spec, **engine_kw,
                 )
-                for spec in specs
+                for i, spec in enumerate(specs)
             ]
             engines, first_err = [], None
             for f in futures:
@@ -1177,7 +1312,7 @@ class ReplicatedLLMEngine:
 
     def stats(self) -> dict:
         per = [e.stats() for e in self.engines]
-        return {
+        out = {
             "replicas": len(per),
             "replicas_alive": sum(e.alive() for e in self.engines),
             "router": self.router,
@@ -1188,6 +1323,15 @@ class ReplicatedLLMEngine:
             "decode_chunk": per[0]["decode_chunk"],
             "per_replica": per,
         }
+        prefixes = [
+            s["kvcache"]["prefix"] for s in per if s["kvcache"].get("prefix")
+        ]
+        if prefixes:  # fleet-wide prefix-cache totals (per-replica in per_replica)
+            out["kvcache_prefix"] = {
+                key: sum(p[key] for p in prefixes)
+                for key in ("hits", "misses", "evictions", "resident_bytes")
+            }
+        return out
 
     def close(self) -> None:
         for e in self.engines:

@@ -453,6 +453,7 @@ def decode_chunk(
     n_steps: int,
     sample_fn,  # (logits [b, vocab] f32, temps [b], key) -> tokens [b] int32
     unroll: int = 1,  # outer-scan unroll (XLA overlaps step boundaries)
+    ring: int = 0,  # >0: cache is a rolling ring of this capacity (kvcache)
 ) -> tuple[jnp.ndarray, jnp.ndarray, KVCache, jax.Array]:
     """n_steps fused decode steps — the serving engine's hot loop.
 
@@ -471,6 +472,13 @@ def decode_chunk(
     garbage the host discards, and only active slots' lengths advance at
     the merge. Callers must guarantee active slots have n_steps of cache
     headroom (LLMEngine caps max_new_tokens at submit).
+
+    ring > 0 declares the cache a window-bounded ROLLING buffer of that
+    capacity (gofr_tpu.kvcache): attention masks derive from reconstructed
+    absolute positions, the end-of-chunk merge wraps modulo the capacity,
+    and lengths keep counting ABSOLUTE tokens (RoPE positions stay exact).
+    Requires cfg.sliding_window > 0 and ring >= sliding_window + n_steps
+    so a merge can never overwrite a row still inside any later window.
 
     Returns (tokens [n_steps, b], last [b], new cache, rng).
     """
@@ -511,6 +519,7 @@ def decode_chunk(
             attn = chunk_decode_attention(
                 q, kc_l, vc_l, kb_l, vb_l, cache.length, k_i,
                 logit_cap=cfg.attn_logit_cap, window=cfg.sliding_window,
+                ring=ring,
             )
             x = x + qmm(attn.reshape(b, 1, hq * hd), lp["wo"]).astype(x.dtype)
             h = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
@@ -531,6 +540,27 @@ def decode_chunk(
         step, (tokens, kb0, vb0), (jnp.arange(K, dtype=jnp.int32), keys),
         unroll=unroll,
     )
+
+    if ring > 0:
+        # rolling merge: the chunk's K rows land at (length + i) mod C —
+        # overwriting exactly the K OLDEST resident positions, which the
+        # capacity bound (C >= window + K) guarantees are already outside
+        # every later query's window. Indices are distinct (K <= C), so
+        # the scatter is order-independent. Garbage rows written for
+        # inactive slots are harmless: a free slot is rewritten wholesale
+        # at admission, and lengths (hence masks) never advance for them.
+        idx = jnp.mod(
+            cache.length[:, None] + jnp.arange(K, dtype=jnp.int32), ring
+        )  # [b, K]
+        merge = jax.vmap(
+            lambda c, u, ix: c.at[:, ix].set(u), in_axes=(1, 1, 0), out_axes=1
+        )
+        new_k = merge(cache.k, kb, idx)
+        new_v = merge(cache.v, vb, idx)
+        # lengths stay ABSOLUTE (positions/RoPE/window math need them);
+        # the engine's submit() cap bounds them by max_seq_len
+        new_len = jnp.where(active, cache.length + K, cache.length)
+        return toks, last, KVCache(k=new_k, v=new_v, length=new_len), rng
 
     # merge: one scatter per chunk. Inactive slots write garbage rows at a
     # clamped in-bounds start — harmless, their rows sit beyond the valid

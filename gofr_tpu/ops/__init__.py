@@ -14,6 +14,7 @@ from .attention import (
     flash_attention,
     mha_reference,
     multi_head_attention,
+    ring_positions,
 )
 from .norms import rms_norm
 from .rope import apply_rope, rope_frequencies
@@ -24,6 +25,7 @@ __all__ = [
     "flash_attention",
     "decode_attention",
     "chunk_decode_attention",
+    "ring_positions",
     "rms_norm",
     "apply_rope",
     "rope_frequencies",

@@ -296,6 +296,23 @@ def flash_attention(
 # ---------------------------------------------------------------------------
 
 
+def ring_positions(lengths: jnp.ndarray, capacity: int) -> jnp.ndarray:
+    """Absolute position held by each row of a window-bounded ROLLING
+    (ring) KV cache. A ring cache of `capacity` C stores position p at row
+    p mod C, overwriting as the sequence grows, so a slot costs O(window)
+    memory instead of O(max_len) (gofr_tpu.kvcache). Row j therefore holds
+    the LAST position congruent to j written so far:
+
+        p(j) = t-1 - ((t-1-j) mod C)    for t = lengths tokens written
+
+    p(j) < 0 marks a never-written row (including the whole cache at
+    t == 0, where t-1 = -1 makes every p negative). Returns [b, capacity]
+    int32."""
+    j = jnp.arange(capacity, dtype=jnp.int32)
+    t1 = lengths[:, None].astype(jnp.int32) - 1  # [b, 1]
+    return t1 - jnp.mod(t1 - j[None, :], capacity)
+
+
 def decode_attention(
     q: jnp.ndarray,  # [b, 1, hq, d]
     k_cache: jnp.ndarray,  # [b, max_len, hkv, d]
@@ -305,13 +322,20 @@ def decode_attention(
     scale: float | None = None,
     logit_cap: float = 0.0,
     window: int = 0,  # sliding window over absolute positions
+    ring: int = 0,  # >0: k/v_cache is a ring of this capacity (kvcache)
 ) -> jnp.ndarray:
     """Decode is HBM-bandwidth-bound, so the einsums read the cache at its
     STORED dtype (f32 accumulation via preferred_element_type) — routing
     through mha_reference cast the whole cache to f32 first, tripling the
     dominant KV stream (measured r3: 1-layer cost 3x). A hand kernel buys
     nothing beyond this at decode's arithmetic intensity; the
-    compiler-friendly einsum form lets XLA fuse the mask and softmax."""
+    compiler-friendly einsum form lets XLA fuse the mask and softmax.
+
+    ring > 0 declares the cache a window-bounded ROLLING buffer of that
+    capacity (row index = absolute position mod ring, ring == max_len):
+    masks are computed from each row's reconstructed absolute position
+    instead of its index. Requires window > 0 and ring >= window so every
+    in-window position is still resident."""
     b, sq, hq, d = q.shape
     hkv = k_cache.shape[2]
     group = hq // hkv
@@ -327,12 +351,24 @@ def decode_attention(
     )  # [b, hkv, group, sq, max_len]
     if logit_cap > 0.0:
         s = logit_cap * jnp.tanh(s / logit_cap)
-    kv_mask = jnp.arange(max_len)[None, :] < lengths[:, None]  # [b, max_len]
-    if window > 0:
-        # query sits at absolute position lengths-1: keep [lengths-window, ..)
-        kv_mask = kv_mask & (
-            jnp.arange(max_len)[None, :] >= lengths[:, None] - window
-        )
+    if ring > 0:
+        if window <= 0 or ring < window:
+            raise ValueError(
+                f"ring cache (capacity {ring}) requires 0 < window <= ring, "
+                f"got window {window}"
+            )
+        # ring row j holds absolute position p(j); valid iff ever written
+        # (p >= 0) and inside the window ending at the query (abs position
+        # lengths-1): p >= lengths - window
+        pos = ring_positions(lengths, max_len)  # [b, max_len]
+        kv_mask = (pos >= 0) & (pos >= lengths[:, None] - window)
+    else:
+        kv_mask = jnp.arange(max_len)[None, :] < lengths[:, None]  # [b, max_len]
+        if window > 0:
+            # query sits at absolute position lengths-1: keep [lengths-window, ..)
+            kv_mask = kv_mask & (
+                jnp.arange(max_len)[None, :] >= lengths[:, None] - window
+            )
     s = jnp.where(kv_mask[:, None, None, None, :], s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1).astype(v_cache.dtype)
     out = jnp.einsum(
@@ -353,6 +389,7 @@ def chunk_decode_attention(
     scale: float | None = None,
     logit_cap: float = 0.0,
     window: int = 0,  # sliding window over absolute positions
+    ring: int = 0,  # >0: main cache is a rolling ring of this capacity
 ) -> jnp.ndarray:
     """Decode attention over main cache + chunk ring buffer.
 
@@ -364,6 +401,12 @@ def chunk_decode_attention(
     and the buffer is merged into per-slot cursor positions ONCE per chunk.
     This function attends over both regions with one joint softmax:
     main positions masked to < lengths, buffer positions masked to <= step.
+
+    ring > 0 declares the MAIN cache a window-bounded rolling buffer of
+    that capacity (row index = absolute position mod ring — see
+    ring_positions / gofr_tpu.kvcache): main-cache masks derive from each
+    row's reconstructed absolute position. The chunk buffer is position-
+    indexed either way, so its masks are unchanged.
     """
     b, sq, hq, d = q.shape
     hkv = k_cache.shape[2]
@@ -382,14 +425,27 @@ def chunk_decode_attention(
     if logit_cap > 0.0:
         s_main = logit_cap * jnp.tanh(s_main / logit_cap)
         s_buf = logit_cap * jnp.tanh(s_buf / logit_cap)
-    main_mask = jnp.arange(max_len)[None, :] < lengths[:, None]  # [b, max_len]
+    if ring > 0:
+        if window <= 0 or ring < window:
+            raise ValueError(
+                f"ring cache (capacity {ring}) requires 0 < window <= ring, "
+                f"got window {window}"
+            )
+        # query's absolute position is lengths + step; ring row j holds
+        # absolute position pos(j) <= lengths-1 (causality is implied),
+        # valid iff ever written and inside the query's window
+        pos = ring_positions(lengths, max_len)  # [b, max_len]
+        main_mask = (pos >= 0) & (pos > lengths[:, None] + step - window)
+    else:
+        main_mask = jnp.arange(max_len)[None, :] < lengths[:, None]  # [b, max_len]
+        if window > 0:
+            # query's absolute position is lengths + step; main-cache rows
+            # live at absolute 0..lengths-1 and buffer row i at lengths + i
+            main_mask = main_mask & (
+                jnp.arange(max_len)[None, :] > lengths[:, None] + step - window
+            )
     buf_mask = jnp.arange(chunk)[None, :] <= step  # [1, chunk]
     if window > 0:
-        # query's absolute position is lengths + step; main-cache rows live
-        # at absolute 0..lengths-1 and buffer row i at lengths + i
-        main_mask = main_mask & (
-            jnp.arange(max_len)[None, :] > lengths[:, None] + step - window
-        )
         buf_mask = buf_mask & (jnp.arange(chunk)[None, :] > step - window)
     s_main = jnp.where(main_mask[:, None, None, None, :], s_main, NEG_INF)
     s_buf = jnp.where(buf_mask[:, None, None, None, :], s_buf, NEG_INF)

@@ -31,6 +31,13 @@ def _serving_result():
             },
             "subruns": {"greet_qps_cpu": 4050.0, "mlp_qps": 9100.0},
             "latency_vs_load": [{"offered_qps": 50, "p50_ms": 400.0}],
+            "long_context": {
+                "qps": 42.0, "window": 1024, "kv_slab_mb": 150.0,
+            },
+            "prefix_cache": {
+                "qps": 520.0, "hit_rate": 0.49,
+                "qps_vs_no_cache_ceiling": 1.37,
+            },
         },
     }
 
@@ -44,6 +51,10 @@ def test_summary_line_contains_all_headline_fields():
     assert s["slo_steady_qps"] == 294.8
     assert s["short_prompt_qps"] == 1069.0
     assert s["short_prompt_lowload_p50_ms"] == 93.0
+    assert s["long_context_qps"] == 42.0
+    assert s["long_context_kv_slab_mb"] == 150.0
+    assert s["prefix_cache_qps"] == 520.0
+    assert s["prefix_vs_ceiling"] == 1.37
     assert s["greet_qps"] == 4050.0
     assert s["mlp_qps"] == 9100.0
 
