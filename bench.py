@@ -200,6 +200,60 @@ def _raw_probes(eng, cfg, args, S: int, B: int) -> dict:
     }
 
 
+_PHASE_HISTS = {
+    # summary key -> app_llm_* histogram feeding it (bench.py satellite:
+    # BENCH_r06+ SLO points carry their own phase attribution)
+    "queue_wait_ms": "app_llm_queue_wait_seconds",
+    "ttft_ms": "app_llm_ttft_seconds",
+    "per_token_ms": "app_llm_time_per_output_token_seconds",
+    "decode_step_ms": "app_llm_decode_step_seconds",
+}
+
+
+def _phase_hist_counts(metrics) -> dict:
+    """Snapshot of per-bucket counts for every phase histogram, merged
+    across label sets (the bench engine emits one model label anyway)."""
+    out = {}
+    for key, name in _PHASE_HISTS.items():
+        h = metrics.histogram(name)
+        merged = None
+        for _lbl, (counts, _s, _n) in h.collect_histogram():
+            merged = counts if merged is None else [
+                a + b for a, b in zip(merged, counts)
+            ]
+        out[key] = (tuple(h.buckets), merged or [0] * (len(h.buckets) + 1))
+    return out
+
+
+def _phase_breakdown(before: dict, after: dict) -> dict:
+    """p50/p99 (ms) per phase from the histogram-count DELTAS between two
+    snapshots — attributes exactly the requests of the window in between
+    (the cumulative histograms also contain the warmup/probe traffic)."""
+
+    def pct(buckets, deltas, q):
+        total = sum(deltas)
+        if total == 0:
+            return 0.0
+        target, acc = q * total, 0
+        for i, c in enumerate(deltas):
+            acc += c
+            if acc >= target:
+                return buckets[min(i, len(buckets) - 1)] * 1e3
+        return buckets[-1] * 1e3
+
+    out = {}
+    for key in _PHASE_HISTS:
+        buckets, b0 = before[key]
+        _, b1 = after[key]
+        deltas = [max(0, a - b) for a, b in zip(b1, b0)]
+        out[key] = {
+            "p50": round(pct(buckets, deltas, 0.50), 2),
+            "p99": round(pct(buckets, deltas, 0.99), 2),
+            "n": sum(deltas),
+        }
+    return out
+
+
 def _closed_loop(eng, cfg, prompt_len, new_tokens: int, requests: int,
                  clients: int, seed: int = 0, shared_frac: float = 0.0) -> dict:
     """Closed-loop saturation: `clients` threads, each submit->drain.
@@ -408,12 +462,19 @@ def bench_serving(args) -> dict:
     S = args.prefill_len
     quantize = args.quantize and on_tpu
     t0 = time.time()
+    # metrics manager on the headline engine only: the SLO point's
+    # phase_breakdown is pulled from the app_llm_* histograms; the other
+    # operating-point engines stay uninstrumented so the short-prompt
+    # overhead-sensitive run measures the bare engine
+    from gofr_tpu.metrics import new_metrics_manager
+
+    metrics = new_metrics_manager()
     eng = LLMEngine(
         cfg, params, slots=args.batch,
         # prompts are S-8 long; leave new_tokens + 2 chunks of cap margin
         max_seq_len=S + args.new_tokens + 2 * args.decode_chunk,
         prefill_buckets=(S,), decode_chunk=args.decode_chunk,
-        admit_cap=args.admit_cap, quantize=quantize,
+        admit_cap=args.admit_cap, quantize=quantize, metrics=metrics,
     )
     engine_init_s = time.time() - t0
     n_params = sum(x.size for x in jax.tree.leaves(params))
@@ -464,6 +525,7 @@ def bench_serving(args) -> dict:
         eng.max_queue = 2 * args.batch
         slo_rate = round(0.9 * qps, 1)
         slo_runs = []
+        ph0 = _phase_hist_counts(metrics)
         for _ in range(3):
             st0 = eng.stats()
             point = _open_loop(
@@ -482,6 +544,10 @@ def bench_serving(args) -> dict:
                 key: _spread([pr[0][key] for pr in slo_runs], 1)
                 for key in ("p50_ms", "p99_ms", "steady_qps", "ttft_p50_ms")
             },
+            # self-attributing SLO point: queue-wait / TTFT / per-token
+            # p50+p99 from the engine's phase histograms, delta'd over the
+            # three SLO runs (bucket-upper-bound estimates, ms)
+            "phase_breakdown": _phase_breakdown(ph0, _phase_hist_counts(metrics)),
         }
     eng.close()
 
@@ -998,6 +1064,11 @@ def _summary_line(result: dict) -> dict:
     if d.get("slo_point"):
         s["slo_steady_qps"] = d["slo_point"].get("steady_qps")
         s["slo_p99_over_p50"] = d["slo_point"].get("p99_over_p50")
+        pb = d["slo_point"].get("phase_breakdown")
+        if pb:  # compact: {phase: [p50_ms, p99_ms]}
+            s["phase_breakdown"] = {
+                k: [v["p50"], v["p99"]] for k, v in pb.items()
+            }
     if d.get("short_prompt_8tok"):
         sp = d["short_prompt_8tok"]
         s["short_prompt_qps"] = sp.get("qps")

@@ -156,6 +156,12 @@ def build_app():
     build_engine(app)
     app.grpc_unary("Gemma", "Generate", generate)
     app.grpc_server_stream("Gemma", "Stream", stream)
+    # the same handler over HTTP: one POST /generate produces one trace
+    # (handler -> llm.request -> queue_wait/prefill/decode spans), one
+    # wide-event log line, and app_llm_* series on /metrics — see
+    # docs/advanced-guide/observability-serving.md. Live engine state:
+    # GET /.well-known/debug/engine.
+    app.post("/generate", generate)
     app.get("/stats", engine_stats)
     return app
 

@@ -20,7 +20,13 @@ from typing import Any, Callable
 from .config import Config, EnvConfig
 from .container import Container
 from .context import Context
-from .handler import favicon_wire_handler, health_handler, live_handler, wrap_handler
+from .handler import (
+    debug_engine_handler,
+    favicon_wire_handler,
+    health_handler,
+    live_handler,
+    wrap_handler,
+)
 from .http.middleware import (
     apikey_auth_middleware,
     basic_auth_middleware,
@@ -244,6 +250,7 @@ class App:
     def _register_well_known(self) -> None:
         self.get("/.well-known/health", health_handler)
         self.get("/.well-known/alive", live_handler)
+        self.get("/.well-known/debug/engine", debug_engine_handler)
         self.router.add("GET", "/favicon.ico", favicon_wire_handler)
         from .swagger import register_swagger_routes
 

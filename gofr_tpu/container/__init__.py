@@ -126,7 +126,10 @@ class Container:
             from ..datasource.tpu import TPURuntime
 
             self.tpu_runtime = TPURuntime(
-                self.config, self.logger, self.metrics_manager
+                self.config, self.logger, self.metrics_manager,
+                # the App sets container.tracer after create(); engines
+                # registered before that (rare) simply serve untraced
+                tracer=getattr(self, "tracer", None),
             )
         return self.tpu_runtime
 

@@ -98,6 +98,20 @@ class Context:
         span = self.request.context.get("span") if hasattr(self.request, "context") else None
         return span.trace_id if span else ""
 
+    @property
+    def traceparent(self) -> str:
+        """W3C traceparent of the active span (contextvar first, request
+        span as fallback). Attach it to work that crosses into threads the
+        contextvar does not reach — e.g. GenRequest(traceparent=...) when
+        submitting to the LLM engine from a custom thread — so the engine's
+        phase spans land in this request's trace."""
+        from .tracing import current_span
+
+        span = current_span()
+        if span is not None and span.end_ns == 0:
+            return span.traceparent
+        return self._span.traceparent if self._span is not None else ""
+
     # auth context populated by middleware
     @property
     def jwt_claims(self) -> dict | None:

@@ -231,11 +231,12 @@ class TPURuntime:
     """`ctx.tpu()` — constructed lazily by the Container (container seam:
     gofr_tpu/container/__init__.py Container.tpu)."""
 
-    def __init__(self, config=None, logger=None, metrics=None):
+    def __init__(self, config=None, logger=None, metrics=None, tracer=None):
         import jax
 
         self.logger = logger
         self.metrics = metrics
+        self.tracer = tracer  # engine request-lifecycle spans (register_llm)
         self.config = config
         get = (lambda k, d: config.get_or_default(k, d)) if config is not None else (lambda k, d: d)
         # TPU_PLATFORM=cpu|tpu pins the jax backend before first device touch
@@ -273,6 +274,17 @@ class TPURuntime:
                     metrics.new_histogram(name, desc, buckets)
         self.devices = jax.devices()
         self.platform = self.devices[0].platform if self.devices else "none"
+        # periodic HBM gauges (app_tpu_hbm_*); parks itself off-TPU.
+        # TPU_TELEMETRY_INTERVAL_S=0 disables the sampler thread.
+        self.telemetry = None
+        if metrics is not None:
+            from .telemetry import TPUTelemetry
+
+            self.telemetry = TPUTelemetry(
+                metrics, self.devices,
+                interval_s=float(get("TPU_TELEMETRY_INTERVAL_S", "10")),
+                logger=logger,
+            )
         if logger is not None:
             logger.info(
                 f"TPU runtime: {len(self.devices)} x {self.devices[0].device_kind}"
@@ -414,6 +426,7 @@ class TPURuntime:
 
         engine_kw.setdefault("prefix_cache_mb", self.default_llm_prefix_cache_mb)
         engine_kw.setdefault("kv_label", name)  # metric-series label
+        engine_kw.setdefault("tracer", self.tracer)  # lifecycle spans
         if not hasattr(self, "_llms"):
             self._llms: dict[str, Any] = {}
         if name in self._llms:
@@ -480,6 +493,8 @@ class TPURuntime:
             return health(STATUS_DOWN, error=str(e))
 
     def close(self) -> None:
+        if self.telemetry is not None:
+            self.telemetry.close()
         for m in self._models.values():
             m.batcher.close()
         self._models.clear()

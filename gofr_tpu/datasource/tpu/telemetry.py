@@ -1,0 +1,111 @@
+"""TPU device telemetry: periodic HBM/utilization gauges.
+
+The health endpoint samples device memory once per probe; dashboards and
+alerts want a continuously refreshed series instead. This sampler publishes
+
+- ``app_tpu_hbm_bytes{device, kind=in_use|limit}``
+- ``app_tpu_hbm_utilization{device}``  (in_use / limit, 0..1)
+
+from ``device.memory_stats()`` (the same PJRT source the TPU runtime's
+health check reads) on a daemon thread. Degrades gracefully off-TPU: when
+no device reports memory stats after the first sweep (the CPU backend
+raises / returns nothing), the thread parks itself instead of spinning —
+the gauges simply never appear, mirroring how the health check omits them.
+"""
+
+from __future__ import annotations
+
+import threading
+
+__all__ = ["TPUTelemetry"]
+
+
+class TPUTelemetry:
+    """Daemon sampler bound to a metrics Manager and a device list."""
+
+    def __init__(
+        self,
+        metrics,
+        devices,
+        *,
+        interval_s: float = 10.0,
+        logger=None,
+    ):
+        self.metrics = metrics
+        self.devices = list(devices or [])
+        self.interval = interval_s
+        self.logger = logger
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        if not metrics.has("app_tpu_hbm_bytes"):
+            metrics.new_gauge(
+                "app_tpu_hbm_bytes", "device HBM bytes (kind=in_use|limit)"
+            )
+        if not metrics.has("app_tpu_hbm_utilization"):
+            metrics.new_gauge(
+                "app_tpu_hbm_utilization", "device HBM in_use/limit (0..1)"
+            )
+        if self.devices and interval_s > 0:
+            self._thread = threading.Thread(
+                target=self._run, name="tpu-telemetry", daemon=True
+            )
+            self._thread.start()
+
+    def sample_once(self) -> int:
+        """Publish one sweep; returns how many devices yielded stats."""
+        published = 0
+        for d in self.devices:
+            try:
+                ms = d.memory_stats()
+            except Exception:  # noqa: BLE001 — unsupported backend (CPU)
+                continue
+            if not ms:
+                continue
+            in_use = ms.get("bytes_in_use")
+            limit = ms.get("bytes_limit")
+            if in_use is None:
+                continue
+            dev = str(getattr(d, "id", 0))
+            self.metrics.set_gauge(
+                "app_tpu_hbm_bytes", float(in_use), device=dev, kind="in_use"
+            )
+            if limit:
+                self.metrics.set_gauge(
+                    "app_tpu_hbm_bytes", float(limit), device=dev, kind="limit"
+                )
+                self.metrics.set_gauge(
+                    "app_tpu_hbm_utilization", float(in_use) / float(limit),
+                    device=dev,
+                )
+            published += 1
+        return published
+
+    _EMPTY_SWEEP_LIMIT = 3  # park only after consecutive empty sweeps
+
+    def _run(self) -> None:
+        # Park the thread when the backend reports nothing — but only
+        # after several consecutive empty sweeps: the FIRST sweep can race
+        # device initialization / engine warmup on a real TPU, and parking
+        # on that transient would silently lose HBM telemetry for the
+        # process lifetime. The CPU backend is empty every sweep and parks
+        # after _EMPTY_SWEEP_LIMIT tries.
+        empty = 0
+        while True:
+            if self.sample_once() > 0:
+                empty = 0
+            else:
+                empty += 1
+                if empty >= self._EMPTY_SWEEP_LIMIT:
+                    if self.logger is not None:
+                        self.logger.debug(
+                            "TPU telemetry: no device reported memory_stats "
+                            f"in {empty} sweeps; sampler idle"
+                        )
+                    return
+            if self._stop.wait(self.interval):
+                return
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)

@@ -117,10 +117,17 @@ class _Interceptor(grpc.ServerInterceptor):
         return handler  # client-streaming passthrough (rare; still served)
 
     # -- shared observation plumbing --------------------------------------
-    def _span(self, method: str):
+    def _span(self, method: str, grpc_ctx=None):
         if self.tracer is None:
             return None
-        return self.tracer.start_span(f"grpc{method}")
+        # W3C trace context rides gRPC metadata (lowercased on the wire);
+        # linking it here means engine/handler child spans join the
+        # caller's trace instead of starting a fresh one per RPC.
+        traceparent = None
+        if grpc_ctx is not None:
+            meta = dict(grpc_ctx.invocation_metadata() or [])
+            traceparent = meta.get("traceparent")
+        return self.tracer.start_span(f"grpc{method}", traceparent=traceparent)
 
     def _log(self, method: str, t0: float, code: str, rpc_id: str) -> None:
         logger = getattr(self.container, "logger", None)
@@ -137,7 +144,7 @@ class _Interceptor(grpc.ServerInterceptor):
     def _observed(self, behavior, request, ctx, method: str, stream: bool):
         t0 = time.perf_counter()
         rpc_id = uuid.uuid4().hex[:16]
-        span = self._span(method)
+        span = self._span(method, ctx)
         try:
             out = behavior(request, ctx)
             self._log(method, t0, "OK", rpc_id)
@@ -158,7 +165,7 @@ class _Interceptor(grpc.ServerInterceptor):
     def _observed_stream(self, behavior, request, ctx, method: str):
         t0 = time.perf_counter()
         rpc_id = uuid.uuid4().hex[:16]
-        span = self._span(method)
+        span = self._span(method, ctx)
         try:
             yield from behavior(request, ctx)
             self._log(method, t0, "OK", rpc_id)

@@ -83,5 +83,21 @@ def live_handler(_ctx: Context) -> Any:
     return {"status": "UP"}
 
 
+def debug_engine_handler(ctx: Context) -> Any:
+    """/.well-known/debug/engine — live serving-engine introspection:
+    slot table, in-flight device work, waiting requests, recent phase
+    p50/p99, kv-cache residency. Read-only and bounded; safe on a
+    saturated engine. Deliberately does NOT construct the TPU runtime:
+    a pure-web app probing this route must not initialize jax."""
+    rt = ctx.container.tpu_runtime
+    if rt is None:
+        return {"engines": {}, "note": "tpu runtime not initialized"}
+    llms = getattr(rt, "_llms", {})
+    return {
+        "platform": getattr(rt, "platform", None),
+        "engines": {name: eng.debug_state() for name, eng in llms.items()},
+    }
+
+
 async def favicon_wire_handler(_req: Request) -> Response:
     return Response(200, [("Content-Type", "image/png")], FAVICON)

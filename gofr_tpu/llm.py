@@ -42,6 +42,14 @@ Design (all shapes static; a bounded set of compiled executables):
   pushes per-chunk token LISTS as fetches complete; consumers iterate
   stream() (sync) or astream() (async) and detach by cancelling — a
   detached request just frees its slot, never stalling the batch.
+- **Observability.** With a tracer wired, submit() captures the caller's
+  trace context (the scheduler/collector threads break contextvar flow)
+  and the engine emits an llm.request span with queue_wait / prefill /
+  per-chunk decode / emit children; with metrics wired it records the
+  app_llm_* phase histograms and engine-state gauges; with a logger it
+  emits one JSON wide-event line per completed request. stats()["phases"]
+  and debug_state() expose recent-window p50/p99 and the live slot table
+  (docs/advanced-guide/observability-serving.md).
 
 Tensor parallelism: pass mesh + param_specs; the slot cache is resharded by
 GSPMD from the params' shardings (KV replicated under MQA, sharded when the
@@ -66,6 +74,39 @@ __all__ = ["LLMEngine", "ReplicatedLLMEngine", "GenRequest", "EngineOverloaded"]
 
 _EOS_DEFAULT = -1  # no EOS cut by default (random-weight models)
 
+# Serializes app_llm_* registration across engines (ReplicatedLLMEngine
+# builds N engines on parallel threads; same rationale as the kvcache
+# module's registration lock).
+_OBS_REG_LOCK = threading.Lock()
+
+
+def _register_phase_metrics(metrics) -> None:
+    """Engine phase-latency instruments, shared across engines/replicas
+    (series are separated by the model label). Histograms reuse
+    TPU_BUCKETS (100us..5s) — queue wait, TTFT, and per-token latencies
+    all live inside that envelope on every supported config."""
+    from .metrics import TPU_BUCKETS
+
+    with _OBS_REG_LOCK:
+        for name, desc in (
+            ("app_llm_queue_wait_seconds", "llm submit->slot admission wait s"),
+            ("app_llm_ttft_seconds", "llm submit->first emitted token s"),
+            ("app_llm_time_per_output_token_seconds",
+             "llm steady-state decode s/token (requests with >1 token)"),
+            ("app_llm_decode_step_seconds",
+             "llm decode dispatch->fetch s/step (chunk=len, wave=pow2 active)"),
+        ):
+            if not metrics.has(name):
+                metrics.new_histogram(name, desc, TPU_BUCKETS)
+        for name, desc in (
+            ("app_llm_slots_in_use", "llm decode slots holding a live request"),
+            ("app_llm_queue_depth", "llm requests waiting for a slot"),
+            ("app_llm_admission_backlog",
+             "llm requests mid-admission (pulled from queue, not yet slotted)"),
+        ):
+            if not metrics.has(name):
+                metrics.new_gauge(name, desc)
+
 
 class EngineOverloaded(RuntimeError):
     """Raised by submit() when the admission queue cap is hit — the
@@ -84,6 +125,10 @@ class GenRequest:
     max_new_tokens: int = 32
     temperature: float = 0.0
     eos_token: int = _EOS_DEFAULT
+    # Explicit W3C trace context for callers whose submitting thread the
+    # tracing contextvar does not reach (executor pools, user threads);
+    # submit() prefers the live contextvar span when one is active.
+    traceparent: str | None = None
     id: int = field(default_factory=itertools.count().__next__)
 
     def __post_init__(self):
@@ -93,6 +138,13 @@ class GenRequest:
         self.capped = False  # engine reduced max_new_tokens to fit the cache
         self.finish_reason: str | None = None  # "eos" | "length" | "cancelled"
         self.submitted_at: float | None = None
+        # -- observability (engine-maintained; read by debug/stats/traces) --
+        self.phase = "new"  # new -> queued -> prefill -> decode -> done
+        self.prefix_hit = False
+        self.admitted_at: float | None = None
+        self.first_token_at: float | None = None
+        self.span = None  # detached llm.request span (engine has a tracer)
+        self._observed = False  # terminal observability emitted (idempotence)
 
     # -- consumption ------------------------------------------------------
     def stream(self, timeout: float = 60.0) -> Iterator[int]:
@@ -149,6 +201,7 @@ class LLMEngine:
         ttft_deadline_ms: float | None = None,
         logger=None,
         metrics=None,
+        tracer=None,
         warmup: bool = True,
         quantize: bool = False,
         kv_window: int | None = None,
@@ -205,6 +258,23 @@ class LLMEngine:
         self.shed = 0  # deadline sheds at admission
         self.logger = logger
         self.metrics = metrics
+        self.tracer = tracer
+        # kv_label doubles as the engine's metric/trace label (register_llm
+        # passes the registered model name; replicas get a /rN suffix)
+        self.label = kv_label
+        if metrics is not None:
+            _register_phase_metrics(metrics)
+        # recent-window phase samples (seconds) for stats()/debug — exact
+        # p50/p99 over the last ~512 observations, deque-append cheap
+        from .metrics import RollingWindow
+
+        self._phases = {
+            "queue_wait": RollingWindow(),
+            "ttft": RollingWindow(),
+            "time_per_output_token": RollingWindow(),
+            "decode_step": RollingWindow(),
+        }
+        self._wide_events: list[dict] = []  # appended under _lock, drained outside
         # KV layout/residency/reuse policy lives in the kvcache subsystem:
         # rolling ring for sliding-window models (slot memory O(window)),
         # dense slab otherwise; optional prompt-prefix reuse at admission.
@@ -217,6 +287,7 @@ class LLMEngine:
             window=kv_window, prefix_cache_mb=prefix_cache_mb,
             metrics=metrics, model=kv_label,
         )
+        self._sharded = mesh is not None and param_specs is not None
         if mesh is not None and param_specs is not None:
             from .parallel.sharding import shard_params
 
@@ -433,6 +504,30 @@ class LLMEngine:
                 )
         now = time.perf_counter()
         req.submitted_at = now
+        req.phase = "queued"
+        if self.tracer is not None:
+            # Contextvar capture happens HERE, on the submitting thread —
+            # the scheduler/collector threads that serve the request never
+            # see the caller's context, so every later phase span is
+            # parented through the ids captured now. Fallback: an explicit
+            # traceparent on the request (callers submitting from threads
+            # the contextvar does not reach).
+            from .tracing import current_span, parse_traceparent
+
+            parent = current_span()
+            if parent is not None and parent.end_ns == 0:
+                link = (parent.trace_id, parent.span_id)
+            else:
+                link = parse_traceparent(req.traceparent)
+            req.span = self.tracer.start_detached_span(
+                "llm.request", parent=link,
+                attributes={
+                    "llm.model": self.label,
+                    "llm.request_id": req.id,
+                    "llm.prompt_tokens": plen,
+                    "llm.max_new_tokens": req.max_new_tokens,
+                },
+            )
         self.submitted += 1  # routing/diagnostic counter (GIL-atomic enough)
         with self._lock:
             # EMA update under the lock: concurrent submitters racing the
@@ -480,7 +575,83 @@ class LLMEngine:
                 "rejected": self.rejected,
                 "shed": self.shed,
                 "kvcache": self.kv.stats(),
+                # recent-window phase latencies (seconds): exact p50/p99
+                # over the last ~512 observations per phase
+                "phases": {k: w.summary() for k, w in self._phases.items()},
             }
+
+    def debug_state(self) -> dict:
+        """Live introspection for /.well-known/debug/engine: the slot
+        table, in-flight device work, waiting requests, recent phase
+        percentiles, and kv-cache residency. One lock acquisition; output
+        is bounded (slots + at most 32 waiting entries) so the endpoint is
+        safe to hit on a saturated engine."""
+        now = time.perf_counter()
+
+        def req_row(r: GenRequest, slot: int | None = None) -> dict:
+            row = {
+                "id": r.id,
+                "phase": r.phase,
+                "prompt_tokens": len(r.prompt_tokens),
+                "emitted": r.emitted,
+                "max_new_tokens": r.max_new_tokens,
+                "age_ms": (
+                    round((now - r.submitted_at) * 1e3, 1)
+                    if r.submitted_at is not None else None
+                ),
+                "prefix_hit": r.prefix_hit,
+                "trace_id": r.span.trace_id if r.span is not None else "",
+            }
+            if slot is not None:
+                row["slot"] = slot
+            return row
+
+        with self._lock:
+            slot_table = [
+                req_row(r, slot) if r is not None else None
+                for slot, r in enumerate(self._slot_req)
+            ]
+            inflight = []
+            entries = list(self._inflight)
+            if self._processing is not None:
+                entries.append(self._processing)
+            for e in entries:
+                if e[0] == "prefill":
+                    inflight.append({
+                        "kind": "prefill",
+                        "requests": [r.id for _, r in e[2]],
+                        "wave": e[3]["nb"] or len(e[2]),
+                        "bucket": e[3]["bucket"],
+                        "age_ms": round((now - e[3]["t0"]) * 1e3, 1),
+                    })
+                else:
+                    inflight.append({
+                        "kind": "chunk",
+                        "steps": e[3],
+                        "active": sum(r is not None for r in e[2]),
+                        "age_ms": round((now - e[4]) * 1e3, 1),
+                    })
+            waiting_total = self._admit_q.qsize() + len(self._waiting)
+            waiting = [req_row(r) for r in self._waiting[:32]]
+            phases = {k: w.summary() for k, w in self._phases.items()}
+        return {
+            "label": self.label,
+            "alive": self.alive(),
+            "slots": self.slots,
+            "active": sum(row is not None for row in slot_table),
+            "max_seq_len": self.max_seq_len,
+            "decode_chunk": self.decode_chunk,
+            "slot_table": slot_table,
+            "inflight": inflight,
+            "waiting_total": waiting_total,
+            "waiting": waiting,
+            "admitting": self._admitting,
+            "phases": phases,
+            "submitted": self.submitted,
+            "rejected": self.rejected,
+            "shed": self.shed,
+            "kvcache": self.kv.stats(),
+        }
 
     def load(self) -> int:
         """Cheap routing signal for the replica router: occupants plus
@@ -504,6 +675,20 @@ class LLMEngine:
             and self._collector.is_alive()
         )
 
+    def _zero_state_gauges(self) -> None:
+        """A stopped engine must not keep exporting its last live
+        occupancy/backlog — dashboards and autoscaling would read load
+        from an engine that no longer exists (same rationale as
+        CacheManager.close() zeroing its resident-bytes gauge)."""
+        if self.metrics is None:
+            return
+        for name in (
+            "app_llm_slots_in_use",
+            "app_llm_queue_depth",
+            "app_llm_admission_backlog",
+        ):
+            self.metrics.set_gauge(name, 0.0, model=self.label)
+
     def close(self) -> None:
         self._stop = True
         self._admit_q.put(None)
@@ -516,6 +701,7 @@ class LLMEngine:
         self._collector.join(timeout=15)
         self._abort_all()
         self._drain_pending()
+        self._zero_state_gauges()
         self.kv.close()  # drop retained prefix rows (device buffers)
 
     def _drain_pending(self) -> None:
@@ -524,9 +710,11 @@ class LLMEngine:
         'cancelled' finish instead of blocking until stream timeout."""
         with self._lock:
             waiting, self._waiting = self._waiting, []
+        now = time.perf_counter()
         for r in waiting:
             if r.finish_reason is None:
                 r.finish_reason = "cancelled"
+                self._observe_finish(r, now)
                 r.out.put(None)
         while True:
             try:
@@ -535,7 +723,10 @@ class LLMEngine:
                 break
             if r is not None and r.finish_reason is None:
                 r.finish_reason = "cancelled"
+                self._observe_finish(r, now)
                 r.out.put(None)
+        if self.logger is not None:
+            self._flush_wide_events()
 
     # -- engine internals -------------------------------------------------
     def _warm(self) -> None:
@@ -599,7 +790,17 @@ class LLMEngine:
         n_tasks = len(self.prefill_buckets) * len(nbs) + 1
         if self._hit_first_op is not None:
             n_tasks += len(nbs)
-        with ThreadPoolExecutor(max_workers=n_tasks) as pool:
+        # Sharded programs on the CPU backend (8-virtual-device test mesh)
+        # must warm SEQUENTIALLY: concurrent sharded executions deadlock on
+        # the per-device thread pool there (each execution parks waiting
+        # for device workers another execution holds). Live serving is
+        # unaffected — the scheduler is the only thread that executes
+        # programs. Real-TPU warms keep the full overlap.
+        workers = (
+            1 if self._sharded and self._jax.default_backend() == "cpu"
+            else n_tasks
+        )
+        with ThreadPoolExecutor(max_workers=workers) as pool:
             futs = [pool.submit(warm_cache_ops)]
             for b in self.prefill_buckets:
                 for nb in nbs:
@@ -726,6 +927,7 @@ class LLMEngine:
                 break
             if req.cancelled:
                 req.finish_reason = "cancelled"
+                self._observe_finish(req, time.perf_counter())
                 req.out.put(None)
                 continue
             self._waiting.append(req)
@@ -742,10 +944,32 @@ class LLMEngine:
                 ):
                     self.shed += 1
                     r.finish_reason = "shed"
+                    self._observe_finish(r, now_t)
                     r.out.put(None)
                 else:
                     kept.append(r)
             self._waiting = kept
+        if self.logger is not None:
+            # queue-side terminations (cancelled in the drain, shed above)
+            # have no collector iteration to flush them — do it here, on
+            # the scheduler thread, with no lock held
+            self._flush_wide_events()
+        if self.metrics is not None:
+            # engine-state gauges, refreshed once per scheduler pass —
+            # three lock-light sets, no device interaction
+            active_n = sum(r is not None for r in self._slot_req)
+            self.metrics.set_gauge(
+                "app_llm_slots_in_use", float(active_n), model=self.label
+            )
+            self.metrics.set_gauge(
+                "app_llm_queue_depth",
+                float(self._admit_q.qsize() + len(self._waiting)),
+                model=self.label,
+            )
+            self.metrics.set_gauge(
+                "app_llm_admission_backlog", float(self._admitting),
+                model=self.label,
+            )
         if not self._waiting or not free:
             return False
         # Rate-gated wave-fill hold: a prefill wave costs device time that
@@ -790,6 +1014,7 @@ class LLMEngine:
                 group = hits[i : i + self.admit_cap]
                 reqs = [r for r, _ in group]
                 nb = self._wave_width(len(reqs))
+                t0 = time.perf_counter()
                 new_cache, logits = self.kv.prefix.assemble(
                     [e for _, e in group], nb, self.kv.capacity
                 )
@@ -798,7 +1023,9 @@ class LLMEngine:
                 first_dev, self._rng = self._hit_first_op(
                     logits, jnp.asarray(temps), self._rng
                 )
-                self._slot_in(reqs, first_dev, new_cache, free)
+                for r in reqs:
+                    r.prefix_hit = True
+                self._slot_in(reqs, first_dev, new_cache, free, wave_t0=t0)
         finally:
             # unpin EVERY looked-up entry in all paths — including the
             # groups never reached when an earlier group's device call
@@ -848,7 +1075,10 @@ class LLMEngine:
                         new_cache.v[:, j : j + 1, :keep],
                         len(r.prompt_tokens), logits_dev[j : j + 1],
                     )
-            self._slot_in(reqs, first_dev, new_cache, free, wave_nb=nb)
+            self._slot_in(
+                reqs, first_dev, new_cache, free,
+                wave_nb=nb, wave_t0=t0, bucket=bucket,
+            )
         return True
 
     def _slot_in(
@@ -858,13 +1088,34 @@ class LLMEngine:
         new_cache,
         free: list[int],
         wave_nb: int | None = None,
+        wave_t0: float | None = None,
+        bucket: int | None = None,
     ) -> None:
         """Shared admission tail for prefilled waves and prefix-cache hit
         waves: copy KV rows into (virtually) free slots via ONE jitted
         insert-many, scatter first tokens into the on-device chain tail,
         and queue the entry for the collector. wave_nb records prefill wave
-        width telemetry (hit waves dispatched no prefill, so they don't)."""
+        width telemetry (hit waves dispatched no prefill, so they don't);
+        wave_t0/bucket feed the prefill phase span recorded at fetch."""
         jnp = self._jnp
+        now = time.perf_counter()
+        for r in reqs:
+            # queue_wait closes at admission (slot assigned, KV en route)
+            r.admitted_at = now
+            r.phase = "prefill"
+            if r.submitted_at is not None:
+                wait = now - r.submitted_at
+                self._phases["queue_wait"].observe(wait)
+                if self.metrics is not None:
+                    self.metrics.record_histogram(
+                        "app_llm_queue_wait_seconds", wait, model=self.label
+                    )
+                self._phase_span(r, "llm.queue_wait", r.submitted_at, now)
+        info = {
+            "t0": wave_t0 if wave_t0 is not None else now,
+            "nb": wave_nb or 0,
+            "bucket": bucket,
+        }
         with self._work_cv:
             meta = np.zeros((3, self.admit_cap), np.int32)
             taken: list[tuple[int, GenRequest]] = []
@@ -875,6 +1126,7 @@ class LLMEngine:
                     # a cancelled occupant may have no in-flight snapshot
                     # left to deliver its end-of-stream — close it here
                     old.finish_reason = "cancelled"
+                    self._observe_finish(old, now)
                     old.out.put(None)
                 taken.append((slot, r))
                 self._slot_req[slot] = r
@@ -889,7 +1141,7 @@ class LLMEngine:
                 self._tail, self._active, self._temps, first_dev, md
             )
             self._start_fetch(first_dev)
-            self._inflight.append(("prefill", first_dev, taken))
+            self._inflight.append(("prefill", first_dev, taken, info))
             self._admitting -= len(reqs)
             if wave_nb is not None:
                 # under the lock: stats() iterates _stat_waves concurrently
@@ -906,12 +1158,113 @@ class LLMEngine:
             except Exception:  # pragma: no cover — backend-dependent
                 pass
 
-    def _emit_to(self, r: GenRequest, slot: int, toks: list[int]) -> None:
+    # -- observability ----------------------------------------------------
+    def _phase_span(
+        self, r: GenRequest, name: str, t0: float, t1: float,
+        attrs: dict | None = None,
+    ) -> None:
+        """Retrospective phase span under the request's llm.request span.
+        No-op for untraced requests, so the hot loop pays one None check.
+        Timestamps anchor the monotonic interval [t0, t1] to a LIVE wall
+        clock read (end = now, start = now - elapsed): a fixed anchor pair
+        captured at engine construction would drift out of the parent
+        span's live-clock window after any NTP step."""
+        if r.span is None:
+            return
+        end_ns = time.time_ns() - int((time.perf_counter() - t1) * 1e9)
+        self.tracer.record_span(
+            name, trace_id=r.span.trace_id, parent_id=r.span.span_id,
+            start_ns=end_ns - int((t1 - t0) * 1e9), end_ns=end_ns,
+            attributes=attrs,
+        )
+
+    def _observe_finish(self, r: GenRequest, now: float, fetch_t: float | None = None) -> None:
+        """Terminal observability for one request: per-token histogram,
+        emit span, llm.request span closure, and the wide-event payload.
+        Idempotent (error paths and stale chunk overlap may race the
+        regular completion). Queues the wide event for logging OUTSIDE the
+        engine lock — the collector calls this under _lock, and a stdout
+        write there would serialize emission behind the logger. The whole
+        body runs under _lock (re-entrant for the already-locked callers):
+        the _observed check-then-set must be atomic against a concurrent
+        finisher — close() on a user thread races the scheduler's drain —
+        and the _wide_events append must not race _flush_wide_events'
+        swap, which would silently drop the line."""
+        with self._lock:
+            if r._observed:
+                return
+            r._observed = True
+            self._observe_finish_locked(r, now, fetch_t)
+
+    def _observe_finish_locked(self, r: GenRequest, now: float, fetch_t: float | None) -> None:
+        r.phase = "done"
+        total = None if r.submitted_at is None else now - r.submitted_at
+        queue_wait = (
+            None if r.admitted_at is None or r.submitted_at is None
+            else r.admitted_at - r.submitted_at
+        )
+        ttft = (
+            None if r.first_token_at is None or r.submitted_at is None
+            else r.first_token_at - r.submitted_at
+        )
+        tpot = None
+        if r.first_token_at is not None and r.emitted > 1:
+            tpot = (now - r.first_token_at) / (r.emitted - 1)
+            self._phases["time_per_output_token"].observe(tpot)
+            if self.metrics is not None:
+                self.metrics.record_histogram(
+                    "app_llm_time_per_output_token_seconds", tpot,
+                    model=self.label,
+                )
+        if r.span is not None:
+            if fetch_t is not None:
+                # host-side tail: final tokens fetched -> emitted to the
+                # consumer queue (detokenization happens at the consumer)
+                self._phase_span(r, "llm.emit", fetch_t, now)
+            r.span.set_attribute("llm.output_tokens", r.emitted)
+            r.span.set_attribute("llm.finish_reason", r.finish_reason)
+            if r.prefix_hit:
+                r.span.set_attribute("llm.prefix_hit", True)
+            if r.finish_reason in ("cancelled", "shed"):
+                r.span.set_status("ERROR")
+            r.span.end()
+        if self.logger is not None:
+            ms = lambda v: None if v is None else round(v * 1e3, 3)  # noqa: E731
+            self._wide_events.append({
+                "event": "llm_request",
+                "model": self.label,
+                "id": r.id,
+                "trace_id": r.span.trace_id if r.span is not None else "",
+                "prompt_tokens": len(r.prompt_tokens),
+                "output_tokens": r.emitted,
+                "finish_reason": r.finish_reason,
+                "queue_wait_ms": ms(queue_wait),
+                "ttft_ms": ms(ttft),
+                "per_token_ms": ms(tpot),
+                "total_ms": ms(total),
+                "prefix_hit": r.prefix_hit,
+                "capped": r.capped,
+            })
+
+    def _flush_wide_events(self) -> None:
+        """Emit queued wide-event lines. Called with the lock NOT held."""
+        if not self._wide_events:
+            return
+        with self._lock:
+            events, self._wide_events = self._wide_events, []
+        for ev in events:
+            self.logger.info(ev)
+
+    def _emit_to(self, r: GenRequest, slot: int, toks: list[int], now: float | None = None) -> None:
         """Append a request's next tokens, honoring max_new/eos/cancel.
         Frees the slot only if `r` still owns it (virtual-free admission
-        may already have handed the slot to a successor)."""
+        may already have handed the slot to a successor). `now` is the
+        fetch-completion time (phase attribution measures device+fetch,
+        not the emit loop's position within the batch)."""
         if r.finish_reason is not None:
             return  # already finished; stale chunk overlap
+        if now is None:
+            now = time.perf_counter()
         finish = None
         if r.cancelled:
             toks, finish = [], "cancelled"
@@ -921,17 +1274,26 @@ class LLMEngine:
             toks = toks[: toks.index(r.eos_token) + 1]
             finish = "eos"
         if toks:
-            if r.emitted == 0 and r.submitted_at is not None and self.metrics is not None:
-                self.metrics.record_histogram(
-                    "app_tpu_queue_wait", time.perf_counter() - r.submitted_at,
-                    model="llm", op="ttft",
-                )
+            if r.emitted == 0:
+                r.first_token_at = now
+                r.phase = "decode"
+                if r.submitted_at is not None:
+                    ttft = now - r.submitted_at
+                    self._phases["ttft"].observe(ttft)
+                    if self.metrics is not None:
+                        self.metrics.record_histogram(
+                            "app_llm_ttft_seconds", ttft, model=self.label
+                        )
+                        self.metrics.record_histogram(
+                            "app_tpu_queue_wait", ttft, model="llm", op="ttft",
+                        )
             r.out.put(toks)
             r.emitted += len(toks)
         if finish is None and r.emitted >= r.max_new_tokens:
             finish = "length"
         if finish is not None:
             r.finish_reason = finish
+            self._observe_finish(r, time.perf_counter(), fetch_t=now)
             r.out.put(None)
             if self._slot_req[slot] is r:
                 self._slot_req[slot] = None
@@ -961,12 +1323,13 @@ class LLMEngine:
                 if needed_steps <= self._chunk_short
                 else self.decode_chunk
             )
+            t0 = time.perf_counter()
             toks, last, self.cache, self._rng = self._chunk_ops[k](
                 self.params, self._tail, self.cache, self._active, self._temps, self._rng,
             )
             self._tail = last
             self._start_fetch(toks)
-            self._inflight.append(("chunk", toks, snapshot, k))
+            self._inflight.append(("chunk", toks, snapshot, k, t0))
             self._stat_chunks += 1
             self._stat_chunk_steps += k
             self._stat_active_sum += active_n
@@ -977,36 +1340,71 @@ class LLMEngine:
         """Fetch one device result (outside the lock — the blocking RTT
         must not stall the scheduler) and emit tokens (under the lock)."""
         if entry[0] == "prefill":
-            _, first_dev, taken = entry
+            _, first_dev, taken, info = entry
             first = np.asarray(first_dev)
+            now = time.perf_counter()
             with self._lock:
                 for j, (slot, r) in enumerate(taken):
-                    self._emit_to(r, slot, [int(first[j])])
+                    if r.span is not None and r.finish_reason is None:
+                        self._phase_span(
+                            r, "llm.prefill", info["t0"], now,
+                            attrs={
+                                "llm.wave": info["nb"] or len(taken),
+                                "llm.bucket": info["bucket"] or 0,
+                                "llm.prefix_hit": r.prefix_hit,
+                            },
+                        )
+                    self._emit_to(r, slot, [int(first[j])], now)
                 self._processing = None  # same acquisition as the emits —
                 # a separate clear would let the scheduler double-count
                 # this entry in _inflight_steps after emitted already grew
+            if self.logger is not None:
+                self._flush_wide_events()
             return
-        _, toks_dev, snapshot, _k = entry
+        _, toks_dev, snapshot, k, t_dispatch = entry
         t0 = time.perf_counter()
         toks = np.asarray(toks_dev)  # [K, S] — blocks; device runs next chunk
+        now = time.perf_counter()
         if self.metrics is not None:
             self.metrics.record_histogram(
-                "app_tpu_stats", time.perf_counter() - t0,
+                "app_tpu_stats", now - t0,
                 model="llm", op="decode_chunk",
+            )
+        # dispatch->fetch cost per decode step, attributed once per chunk
+        # (wave = active slots at dispatch, bucketed to a power of two so
+        # the label set stays bounded at log2(slots) values)
+        active_n = sum(r is not None for r in snapshot)
+        step_s = (now - t_dispatch) / k
+        self._phases["decode_step"].observe(step_s)
+        if self.metrics is not None:
+            wave = 1 << max(0, active_n - 1).bit_length() if active_n else 0
+            self.metrics.record_histogram(
+                "app_llm_decode_step_seconds", step_s,
+                model=self.label, chunk=str(k), wave=str(wave),
             )
         cols = toks.T  # [S, K]
         with self._lock:
             for slot, r in enumerate(snapshot):
                 if r is not None:
-                    self._emit_to(r, slot, cols[slot].tolist())
+                    if r.span is not None and r.finish_reason is None:
+                        self._phase_span(
+                            r, "llm.decode", t_dispatch, now,
+                            attrs={"llm.chunk": k, "llm.active": active_n,
+                                   "llm.slot": slot},
+                        )
+                    self._emit_to(r, slot, cols[slot].tolist(), now)
             self._processing = None
+        if self.logger is not None:
+            self._flush_wide_events()
 
     def _abort_all(self) -> None:
         jnp = self._jnp
         with self._lock:
+            now = time.perf_counter()
             for slot, r in enumerate(self._slot_req):
                 if r is not None and r.finish_reason is None:
                     r.finish_reason = "cancelled"
+                    self._observe_finish(r, now)
                     r.out.put(None)
                 self._slot_req[slot] = None
             self._active = jnp.zeros((self.slots,), bool)
@@ -1035,6 +1433,8 @@ class LLMEngine:
                     if self.logger is not None:
                         self.logger.error(f"LLM engine step failed: {e!r}")
                     self._recover_all()
+                    if self.logger is not None:
+                        self._flush_wide_events()
                     time.sleep(0.1)
         finally:
             # Anything that escapes the per-iteration handler (BaseException,
@@ -1057,6 +1457,7 @@ class LLMEngine:
         except Exception:  # noqa: BLE001 — draining must not re-raise
             pass
         self._drain_pending()
+        self._zero_state_gauges()
         self._kick.set()
         with self._work_cv:
             self._work_cv.notify_all()
@@ -1077,9 +1478,11 @@ class LLMEngine:
                 entries.append(self._processing)
             for entry in entries:
                 orphans.update(self._entry_requests(entry))
+            now = time.perf_counter()
             for r in orphans:
                 if r.finish_reason is None:
                     r.finish_reason = "cancelled"
+                    self._observe_finish(r, now)
                     r.out.put(None)
             self._inflight.clear()
             self._processing = None
@@ -1148,6 +1551,8 @@ class LLMEngine:
                 with self._lock:
                     self._processing = None
             self._kick.set()
+            if self.logger is not None:
+                self._flush_wide_events()
 
     @staticmethod
     def _entry_requests(entry: tuple):
@@ -1182,12 +1587,14 @@ class LLMEngine:
                 for r in self._entry_requests(e):
                     if r in lost:
                         cover[r] = cover.get(r, 0) + n
+            now = time.perf_counter()
             for r in lost:
                 if (
                     r.finish_reason is None
                     and r.emitted + cover.get(r, 0) < r.max_new_tokens
                 ):
                     r.finish_reason = "cancelled"
+                    self._observe_finish(r, now)
                     r.out.put(None)
 
 
@@ -1322,6 +1729,9 @@ class ReplicatedLLMEngine:
             "max_seq_len": per[0]["max_seq_len"],
             "decode_chunk": per[0]["decode_chunk"],
             "per_replica": per,
+            # fleet-wide phase percentiles: pooled raw windows, not an
+            # average of per-replica percentiles (which has no meaning)
+            "phases": self._merged_phases(),
         }
         prefixes = [
             s["kvcache"]["prefix"] for s in per if s["kvcache"].get("prefix")
@@ -1332,6 +1742,24 @@ class ReplicatedLLMEngine:
                 for key in ("hits", "misses", "evictions", "resident_bytes")
             }
         return out
+
+    def _merged_phases(self) -> dict:
+        from .metrics import summarize_window
+
+        merged: dict[str, list[float]] = {}
+        for e in self.engines:
+            for name, w in e._phases.items():
+                merged.setdefault(name, []).extend(w.values())
+        return {name: summarize_window(vs) for name, vs in merged.items()}
+
+    def debug_state(self) -> dict:
+        return {
+            "router": self.router,
+            "replicas": len(self.engines),
+            "replicas_alive": sum(e.alive() for e in self.engines),
+            "phases": self._merged_phases(),
+            "per_replica": [e.debug_state() for e in self.engines],
+        }
 
     def close(self) -> None:
         for e in self.engines:

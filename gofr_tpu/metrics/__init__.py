@@ -131,6 +131,47 @@ class Histogram:
         return self.buckets[-1]
 
 
+def summarize_window(values: Iterable[float]) -> dict:
+    """{count, p50, p99, max} over a sample list — exact order statistics,
+    unlike Histogram.percentile's bucket-midpoint approximation. Used for
+    the serving engine's recent-window phase summaries (stats()/debug)."""
+    xs = sorted(values)
+    if not xs:
+        return {"count": 0, "p50": 0.0, "p99": 0.0, "max": 0.0}
+    return {
+        "count": len(xs),
+        "p50": xs[len(xs) // 2],
+        "p99": xs[min(len(xs) - 1, int(0.99 * len(xs)))],
+        "max": xs[-1],
+    }
+
+
+class RollingWindow:
+    """Fixed-size window of recent observations with exact percentiles.
+
+    The Prometheus histograms are cumulative-forever; live debugging wants
+    "what do the LAST few hundred requests look like" — this keeps that
+    window in-process at deque-append cost (O(1), one small lock) so the
+    serving hot loop can afford one observe() per phase transition."""
+
+    def __init__(self, size: int = 512):
+        from collections import deque
+
+        self._lock = threading.Lock()
+        self._values: deque[float] = deque(maxlen=size)
+
+    def observe(self, value: float) -> None:
+        with self._lock:
+            self._values.append(value)
+
+    def values(self) -> list[float]:
+        with self._lock:
+            return list(self._values)
+
+    def summary(self) -> dict:
+        return summarize_window(self.values())
+
+
 class Manager:
     """Name->instrument registry. Parity: metrics/register.go + store.go."""
 

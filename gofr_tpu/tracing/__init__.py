@@ -297,6 +297,40 @@ class Tracer:
         span._token = _current_span.set(span)
         return span
 
+    def start_detached_span(
+        self, name: str, *, parent: tuple[str, str] | None = None,
+        attributes: dict | None = None,
+    ) -> Span:
+        """A span that does NOT become the active contextvar span. For work
+        that outlives the submitting call and ends on another thread (the
+        LLM engine's scheduler/collector): the caller captures its trace
+        context once — (trace_id, span_id) — and every later phase span is
+        parented explicitly instead of through the contextvar, which does
+        not flow across plain threads. end() still ships to the exporter."""
+        trace_id, parent_id = parent if parent else (_rand_hex(16), None)
+        span = Span(name, trace_id, _rand_hex(8), parent_id, self)
+        if attributes:
+            span.attributes.update(attributes)
+        return span
+
+    def record_span(
+        self, name: str, *, trace_id: str, parent_id: str | None,
+        start_ns: int, end_ns: int, attributes: dict | None = None,
+        status: str = "OK",
+    ) -> Span:
+        """Record an already-elapsed interval as a finished span — the
+        retrospective form the engine uses for phases it only measures
+        after the fact (a decode chunk's dispatch->fetch window is known
+        when the fetch completes, on a different thread from dispatch)."""
+        span = Span(name, trace_id, _rand_hex(8), parent_id, None)
+        span.start_ns = start_ns
+        span.end_ns = max(end_ns, start_ns)
+        if attributes:
+            span.attributes.update(attributes)
+        span.status = status
+        self._on_end(span)
+        return span
+
     def _on_end(self, span: Span) -> None:
         if self._processor is not None:
             self._processor.on_end(span)

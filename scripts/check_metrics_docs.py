@@ -1,0 +1,63 @@
+#!/usr/bin/env python
+"""Fail CI when a framework metric name is missing from the docs.
+
+Every ``app_*`` metric name that appears as a string literal under
+``gofr_tpu/`` (registration and record sites both count — a name that is
+recorded but never registered is still part of the exposition surface)
+must be mentioned somewhere under ``docs/``. The canonical reference list
+lives in docs/advanced-guide/observability-serving.md; any docs page
+satisfies the check so per-subsystem pages (kv-cache.md) keep documenting
+their own series.
+
+Exit codes: 0 clean, 1 undocumented names (listed on stderr).
+
+Usage: python scripts/check_metrics_docs.py
+"""
+
+from __future__ import annotations
+
+import pathlib
+import re
+import sys
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+NAME_RE = re.compile(r"""["'](app_[a-z][a-z0-9_]*)["']""")
+
+
+def metric_names_in_code() -> set[str]:
+    names: set[str] = set()
+    for path in sorted((ROOT / "gofr_tpu").rglob("*.py")):
+        names |= set(NAME_RE.findall(path.read_text(encoding="utf-8")))
+    return names
+
+
+def docs_text() -> str:
+    return "\n".join(
+        p.read_text(encoding="utf-8") for p in sorted((ROOT / "docs").rglob("*.md"))
+    )
+
+
+def main() -> int:
+    names = metric_names_in_code()
+    if not names:
+        print("check_metrics_docs: no app_* names found under gofr_tpu/ — "
+              "is the tree intact?", file=sys.stderr)
+        return 1
+    docs = docs_text()
+    missing = sorted(n for n in names if n not in docs)
+    if missing:
+        print(
+            "check_metrics_docs: metric names registered in code but "
+            "missing from docs/ (add them to "
+            "docs/advanced-guide/observability-serving.md):",
+            file=sys.stderr,
+        )
+        for n in missing:
+            print(f"  - {n}", file=sys.stderr)
+        return 1
+    print(f"check_metrics_docs: {len(names)} app_* metric names, all documented")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

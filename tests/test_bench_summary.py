@@ -92,3 +92,40 @@ def test_greet_subprocess_parses_full_result_not_summary():
     with mock.patch("subprocess.run", return_value=proc):
         got = bench._greet_subprocess()
     assert got == full
+
+
+def test_summary_line_carries_phase_breakdown():
+    """SLO points self-attribute: the compact summary carries queue-wait /
+    TTFT / per-token p50+p99 pulled from the phase histograms."""
+    r = _serving_result()
+    r["detail"]["slo_point"]["phase_breakdown"] = {
+        "queue_wait_ms": {"p50": 1.0, "p99": 5.0, "n": 900},
+        "ttft_ms": {"p50": 100.0, "p99": 250.0, "n": 900},
+        "per_token_ms": {"p50": 6.0, "p99": 11.0, "n": 900},
+    }
+    s = bench._summary_line(r)
+    assert s["phase_breakdown"]["ttft_ms"] == [100.0, 250.0]
+    assert s["phase_breakdown"]["queue_wait_ms"] == [1.0, 5.0]
+    # absent block (older results / --no-open-loop) must not crash or leak
+    assert "phase_breakdown" not in bench._summary_line(_serving_result())
+
+
+def test_phase_breakdown_from_histogram_deltas():
+    """p50/p99 come from the count DELTAS between two snapshots, so the
+    SLO window is attributed without the warmup/probe traffic that also
+    lives in the cumulative histograms."""
+    from gofr_tpu.llm import _register_phase_metrics
+    from gofr_tpu.metrics import new_metrics_manager
+
+    metrics = new_metrics_manager()
+    _register_phase_metrics(metrics)
+    metrics.record_histogram("app_llm_ttft_seconds", 9.0, model="llm")  # warmup
+    before = bench._phase_hist_counts(metrics)
+    metrics.record_histogram("app_llm_ttft_seconds", 0.12, model="llm")
+    metrics.record_histogram("app_llm_queue_wait_seconds", 0.001, model="llm")
+    after = bench._phase_hist_counts(metrics)
+    pb = bench._phase_breakdown(before, after)
+    # 0.12s falls in the (0.1, 0.25] bucket -> upper bound 250 ms
+    assert pb["ttft_ms"] == {"p50": 250.0, "p99": 250.0, "n": 1}
+    assert pb["queue_wait_ms"]["n"] == 1 and pb["queue_wait_ms"]["p50"] == 1.0
+    assert pb["per_token_ms"] == {"p50": 0.0, "p99": 0.0, "n": 0}

@@ -151,6 +151,8 @@ class TestCollectorFailure:
         fake = types.SimpleNamespace(
             _lock=threading.RLock(),
             _entry_requests=LLMEngine._entry_requests,
+            _observe_finish=lambda r, now: None,  # terminal observability
+            # is exercised end-to-end in test_llm_observability.py
             _processing=("chunk", None, [orphan, occupant, covered, None], 2),
             _inflight=collections.deque(
                 [("chunk", None, [None, None, orphan, covered], 2)]

@@ -1,0 +1,359 @@
+"""Serving-engine observability tests: request-lifecycle tracing across
+the submit->scheduler->collector thread handoff, phase-latency histograms
+in Prometheus exposition, /.well-known/debug/engine introspection, the
+wide-event completion log, and the TPU telemetry sampler's degrade path.
+
+One module-scoped engine carries every observability sink; tests snapshot
+the sinks (span list length, log buffer offset, histogram counts) before
+acting so they stay independent. Throwaway engines are built with
+warmup=False — lazy compilation only builds the widths a 2-request test
+actually touches."""
+
+import io
+import json
+import time
+
+import jax
+import pytest
+
+from gofr_tpu.config import new_mock_config
+from gofr_tpu.llm import GenRequest, LLMEngine
+from gofr_tpu.logging import Logger
+from gofr_tpu.metrics import RollingWindow, new_metrics_manager, summarize_window
+from gofr_tpu.models import TransformerConfig, init_params
+from gofr_tpu import tracing as gt
+
+CFG = TransformerConfig.tiny()
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(jax.random.PRNGKey(0), CFG)
+
+
+@pytest.fixture(scope="module")
+def observed(params):
+    """(engine, tracer, metrics, log buffer) with every sink attached."""
+    metrics = new_metrics_manager()
+    out = io.StringIO()
+    logger = Logger(out=out, err=out, pretty=False)
+    tracer = gt.new_tracer(new_mock_config({"TRACE_EXPORTER": "memory"}))
+    eng = LLMEngine(
+        CFG, params, slots=4, max_seq_len=64, prefill_buckets=(8, 16),
+        logger=logger, metrics=metrics, tracer=tracer,
+    )
+    yield eng, tracer, metrics, out
+    eng.close()
+    tracer.shutdown()
+
+
+def _new_spans(tracer, start: int, want: int, timeout: float = 5.0) -> list:
+    """Spans exported since index `start`, flushing until `want` arrive."""
+    deadline = time.time() + timeout
+    while time.time() < deadline and len(tracer.exporter.spans) - start < want:
+        tracer._processor._flush()
+        time.sleep(0.02)
+    return tracer.exporter.spans[start:]
+
+
+def _wide_events(out: io.StringIO, offset: int, timeout: float = 5.0) -> list[dict]:
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        lines = [ln for ln in out.getvalue()[offset:].splitlines()
+                 if "llm_request" in ln]
+        if lines:
+            return [json.loads(ln)["message"] for ln in lines]
+        time.sleep(0.02)
+    return []
+
+
+class TestLifecycleTracing:
+    def test_spans_survive_thread_handoff(self, observed):
+        """The caller's trace context (captured at submit) must parent
+        every phase span the scheduler/collector threads emit — equal
+        trace ids, llm.request parented under the caller, phases under
+        llm.request."""
+        eng, tracer, _, _ = observed
+        n0 = len(tracer.exporter.spans)
+        parent = tracer.start_span("handler POST /generate")
+        eng.submit(GenRequest([5, 9, 2], max_new_tokens=6)).tokens()
+        parent.end()
+
+        spans = [s for s in _new_spans(tracer, n0, want=5)
+                 if s.trace_id == parent.trace_id]
+        by_name = {}
+        for s in spans:
+            by_name.setdefault(s.name, []).append(s)
+        for name in ("llm.request", "llm.queue_wait", "llm.prefill",
+                     "llm.decode", "llm.emit"):
+            assert name in by_name, f"missing {name} in {sorted(by_name)}"
+        req_span = by_name["llm.request"][0]
+        assert req_span.parent_id == parent.span_id
+        for name in ("llm.queue_wait", "llm.prefill", "llm.decode", "llm.emit"):
+            for s in by_name[name]:
+                assert s.parent_id == req_span.span_id, name
+        # phase intervals are sane: ends never precede starts
+        for s in spans:
+            assert s.end_ns >= s.start_ns
+        assert req_span.attributes["llm.output_tokens"] == 6
+        assert req_span.attributes["llm.finish_reason"] == "length"
+
+    def test_prefill_span_carries_wave_attributes(self, observed):
+        eng, tracer, _, _ = observed
+        n0 = len(tracer.exporter.spans)
+        eng.generate([1, 2, 3, 4], max_new_tokens=2)
+        spans = _new_spans(tracer, n0, want=4)
+        pre = [s for s in spans if s.name == "llm.prefill"]
+        assert pre and pre[0].attributes["llm.bucket"] in (8, 16)
+        assert pre[0].attributes["llm.wave"] >= 1
+        dec = [s for s in spans if s.name == "llm.decode"]
+        assert dec and dec[0].attributes["llm.chunk"] >= 1
+
+    def test_explicit_traceparent_links_without_contextvar(self, observed):
+        """A request submitted with traceparent= (no live contextvar span)
+        must join that trace — the seam for threads the contextvar does
+        not reach."""
+        eng, tracer, _, _ = observed
+        n0 = len(tracer.exporter.spans)
+        trace_id, span_id = "ab" * 16, "cd" * 8
+        req = GenRequest([3, 1], max_new_tokens=2,
+                         traceparent=f"00-{trace_id}-{span_id}-01")
+        eng.submit(req).tokens()
+        spans = _new_spans(tracer, n0, want=4)
+        mine = [s for s in spans if s.trace_id == trace_id]
+        assert mine, "engine spans did not join the explicit trace"
+        req_span = [s for s in mine if s.name == "llm.request"][0]
+        assert req_span.parent_id == span_id
+
+    def test_untraced_engine_pays_no_span(self, params):
+        """tracer=None: no span objects on requests, serving unchanged."""
+        eng = LLMEngine(CFG, params, slots=2, max_seq_len=64,
+                        prefill_buckets=(8,), warmup=False)
+        try:
+            req = eng.submit(GenRequest([5, 9, 2], max_new_tokens=3))
+            assert len(req.tokens()) == 3
+            assert req.span is None
+        finally:
+            eng.close()
+
+
+class TestPhaseMetrics:
+    def test_histograms_visible_and_monotonic(self, observed):
+        eng, _, metrics, _ = observed
+
+        def counts():
+            return {
+                n: sum(c for _, (_, _, c) in
+                       metrics.histogram(n).collect_histogram())
+                for n in ("app_llm_queue_wait_seconds",
+                          "app_llm_ttft_seconds",
+                          "app_llm_time_per_output_token_seconds",
+                          "app_llm_decode_step_seconds")
+            }
+
+        eng.generate([5, 9, 2], max_new_tokens=6)
+        c1 = counts()
+        expo = metrics.render_prometheus()
+        for n, total in c1.items():
+            assert f"# TYPE {n} histogram" in expo, n
+            assert total >= 1, f"{n} recorded nothing"
+        eng.generate([1, 2], max_new_tokens=4)
+        for n, total in counts().items():
+            assert total >= c1[n], f"{n} count went backwards"
+
+    def test_engine_state_gauges_exposed(self, observed):
+        eng, _, metrics, _ = observed
+        eng.generate([5], max_new_tokens=2)
+        deadline = time.time() + 2
+        while time.time() < deadline:
+            expo = metrics.render_prometheus()
+            if "app_llm_slots_in_use" in expo:
+                break
+            time.sleep(0.02)
+        assert "app_llm_slots_in_use" in expo
+        assert "app_llm_queue_depth" in expo
+        assert "app_llm_admission_backlog" in expo
+
+    def test_stats_phase_summaries(self, observed):
+        eng, _, _, _ = observed
+        eng.generate([5, 9], max_new_tokens=6)
+        phases = eng.stats()["phases"]
+        for key in ("queue_wait", "ttft", "time_per_output_token", "decode_step"):
+            assert key in phases
+            assert phases[key]["count"] >= 1
+            assert phases[key]["p99"] >= phases[key]["p50"] >= 0.0
+
+
+class TestWideEvent:
+    def test_completion_line_parses_with_all_phase_keys(self, observed):
+        eng, _, _, out = observed
+        offset = len(out.getvalue())
+        eng.generate([5, 9, 2], max_new_tokens=6)
+        events = _wide_events(out, offset)
+        assert events, "no wide-event line emitted"
+        rec = events[-1]
+        for key in ("event", "model", "id", "trace_id", "prompt_tokens",
+                    "output_tokens", "finish_reason", "queue_wait_ms",
+                    "ttft_ms", "per_token_ms", "total_ms", "prefix_hit",
+                    "capped"):
+            assert key in rec, key
+        assert rec["event"] == "llm_request"
+        assert rec["finish_reason"] == "length"
+        assert rec["output_tokens"] == 6
+        assert rec["ttft_ms"] > 0 and rec["total_ms"] >= rec["ttft_ms"]
+
+    def test_cancel_still_emits_terminal_event(self, observed):
+        eng, _, _, out = observed
+        offset = len(out.getvalue())
+        req = eng.submit(GenRequest([5, 9], max_new_tokens=4))
+        req.cancel()
+        list(req.stream(timeout=10))
+        events = _wide_events(out, offset)
+        assert events
+        assert events[-1]["finish_reason"] in ("cancelled", "length")
+
+
+class TestDebugIntrospection:
+    def test_debug_state_idle_and_active(self, observed):
+        eng, _, _, _ = observed
+        idle = eng.debug_state()
+        assert idle["active"] == 0 and idle["alive"]
+        assert len(idle["slot_table"]) == eng.slots
+        assert all(row is None for row in idle["slot_table"])
+
+        req = eng.submit(GenRequest([5, 9, 2], max_new_tokens=24))
+        it = req.stream(timeout=30)
+        next(it)  # at least one token out: the request holds a slot
+        active = eng.debug_state()
+        rows = [r for r in active["slot_table"] if r is not None]
+        if rows:  # may already have drained on a fast box — idle is valid
+            assert rows[0]["id"] == req.id
+            assert rows[0]["phase"] in ("prefill", "decode")
+            assert rows[0]["prompt_tokens"] == 3
+        list(it)  # drain
+        done = eng.debug_state()
+        assert done["active"] == 0
+        assert done["phases"]["ttft"]["count"] >= 1
+
+    def test_http_debug_endpoint_idle_app(self):
+        """A pure-web app's debug endpoint answers without initializing
+        the TPU runtime (no jax device touch)."""
+        from gofr_tpu import App
+
+        app = App(config=new_mock_config({
+            "APP_NAME": "dbg", "HTTP_PORT": "0", "METRICS_PORT": "0",
+            "LOG_LEVEL": "ERROR",
+        }))
+        app.run_in_background()
+        try:
+            import urllib.request
+
+            port = app.http_server.port
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/.well-known/debug/engine", timeout=5
+            ) as r:
+                body = json.loads(r.read())
+            assert body["data"]["engines"] == {}
+            assert app.container.tpu_runtime is None
+        finally:
+            app.shutdown()
+
+    def test_http_debug_endpoint_with_engine(self, params):
+        """With a registered LLM the endpoint renders the live engine:
+        slot table sized to the engine, phase summaries present."""
+        from gofr_tpu import App
+
+        app = App(config=new_mock_config({
+            "APP_NAME": "dbg2", "HTTP_PORT": "0", "METRICS_PORT": "0",
+            "LOG_LEVEL": "ERROR", "TPU_TELEMETRY_INTERVAL_S": "0",
+        }))
+        app.container.tpu().register_llm(
+            "tiny", CFG, params, slots=2, max_seq_len=64,
+            prefill_buckets=(8,), warmup=False,
+        )
+        app.run_in_background()
+        try:
+            import urllib.request
+
+            app.container.tpu().llm("tiny").generate([5, 9], max_new_tokens=2)
+            port = app.http_server.port
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/.well-known/debug/engine", timeout=5
+            ) as r:
+                body = json.loads(r.read())
+            eng = body["data"]["engines"]["tiny"]
+            assert eng["slots"] == 2 and len(eng["slot_table"]) == 2
+            assert eng["label"] == "tiny"
+            assert eng["phases"]["ttft"]["count"] >= 1
+            assert eng["kvcache"]["layout"] in ("dense", "rolling")
+        finally:
+            app.shutdown()
+
+
+class TestReplicatedAggregation:
+    def test_fleet_phase_merge_and_debug(self, params):
+        from gofr_tpu.llm import ReplicatedLLMEngine
+
+        eng = ReplicatedLLMEngine(
+            CFG, params, replicas=2, slots=2, max_seq_len=64,
+            prefill_buckets=(8,), warmup=False,
+        )
+        try:
+            for _ in range(4):
+                eng.generate([5, 9], max_new_tokens=2)
+            stats = eng.stats()
+            assert stats["phases"]["ttft"]["count"] >= 4
+            dbg = eng.debug_state()
+            assert dbg["replicas"] == 2 and len(dbg["per_replica"]) == 2
+            assert dbg["replicas_alive"] == 2
+            for rep in dbg["per_replica"]:
+                assert len(rep["slot_table"]) == 2
+        finally:
+            eng.close()
+
+
+class TestTelemetry:
+    def test_sampler_publishes_from_fake_device(self):
+        from gofr_tpu.datasource.tpu.telemetry import TPUTelemetry
+
+        class FakeDev:
+            id = 3
+
+            def memory_stats(self):
+                return {"bytes_in_use": 1 << 30, "bytes_limit": 16 << 30}
+
+        metrics = new_metrics_manager()
+        tel = TPUTelemetry(metrics, [FakeDev()], interval_s=0, logger=None)
+        assert tel.sample_once() == 1
+        expo = metrics.render_prometheus()
+        assert 'app_tpu_hbm_bytes{device="3",kind="in_use"}' in expo
+        assert 'app_tpu_hbm_bytes{device="3",kind="limit"}' in expo
+        assert 'app_tpu_hbm_utilization{device="3"} 0.0625' in expo
+        tel.close()
+
+    def test_sampler_degrades_on_cpu_devices(self):
+        """CPU backend devices raise/return nothing from memory_stats:
+        the sampler parks after one empty sweep instead of spinning."""
+        from gofr_tpu.datasource.tpu.telemetry import TPUTelemetry
+
+        metrics = new_metrics_manager()
+        tel = TPUTelemetry(
+            metrics, jax.devices()[:1], interval_s=0.01, logger=None
+        )
+        time.sleep(0.1)
+        expo = metrics.render_prometheus()
+        assert "app_tpu_hbm_utilization{" not in expo
+        tel.close()
+        if tel._thread is not None:
+            assert not tel._thread.is_alive()
+
+
+def test_rolling_window_and_summary_helpers():
+    w = RollingWindow(size=4)
+    assert w.summary() == {"count": 0, "p50": 0.0, "p99": 0.0, "max": 0.0}
+    for v in (1.0, 2.0, 3.0, 4.0, 5.0):  # 1.0 rolls out
+        w.observe(v)
+    s = w.summary()
+    assert s["count"] == 4 and s["max"] == 5.0 and s["p50"] == 4.0
+    pooled = summarize_window(w.values() + [10.0])
+    assert pooled["count"] == 5 and pooled["max"] == 10.0

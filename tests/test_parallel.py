@@ -133,6 +133,7 @@ class TestDPServing:
         lens = jnp.asarray([len(prompt)], jnp.int32)
         return [int(t) for t in np.asarray(generate(params, cfg, toks, lens, n))[0]]
 
+    @pytest.mark.slow  # ~20s: builds 2 full engines + a reference decode
     def test_dp_replicas_match_single_engine(self):
         from gofr_tpu.llm import ReplicatedLLMEngine
 
@@ -348,6 +349,7 @@ class TestPipelineParallel:
         got = pp_loss(shard_fn(params), cfg, tokens, mask, pp_fn, 4)
         assert abs(float(ref) - float(got)) < 1e-5
 
+    @pytest.mark.slow  # ~17s: compiles grad-of-pp-scan over 8 stages
     def test_grads_match_single_device(self):
         (cfg, params, mesh, tokens, mask,
          shard_fn, _io, _st, pp_fn, pp_loss) = self._setup()
@@ -531,6 +533,7 @@ class TestRingPrefill:
             ring_prefill(params, cfg, toks, jnp.asarray([60]), mesh=mesh)
 
 
+@pytest.mark.slow  # ~40s: exhaustive window sweep, one compile per window
 def test_ring_attention_sliding_window_matches_reference():
     """Banded ring attention: chunk skipping + in-chunk band masks over
     global positions must equal the reference band mask, for windows
