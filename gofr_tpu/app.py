@@ -21,7 +21,9 @@ from .config import Config, EnvConfig
 from .container import Container
 from .context import Context
 from .handler import (
+    debug_compiles_handler,
     debug_engine_handler,
+    debug_profile_handler,
     favicon_wire_handler,
     health_handler,
     live_handler,
@@ -120,9 +122,18 @@ class App:
         return out
 
     # ---- route registration (gofr.go:234-256) ----
-    def _add(self, method: str, path: str, handler: Callable) -> None:
+    def _add(
+        self, method: str, path: str, handler: Callable,
+        timeout_s: float | None = None,
+    ) -> None:
         self._route_registered = True
-        self.router.add(method, path, wrap_handler(handler, self.container, self.request_timeout))
+        self.router.add(
+            method, path,
+            wrap_handler(
+                handler, self.container,
+                timeout_s if timeout_s is not None else self.request_timeout,
+            ),
+        )
 
     def get(self, path: str, handler: Callable) -> None:
         self._add("GET", path, handler)
@@ -251,6 +262,14 @@ class App:
         self.get("/.well-known/health", health_handler)
         self.get("/.well-known/alive", live_handler)
         self.get("/.well-known/debug/engine", debug_engine_handler)
+        self.get("/.well-known/debug/compiles", debug_compiles_handler)
+        # The profile route gets its own timeout budget: a capture costs
+        # its window (<=30 s) plus ~10 s of one-time profiler init, which
+        # must not be bounded by the API-SLO REQUEST_TIMEOUT (default 5 s).
+        self._add(
+            "POST", "/.well-known/debug/profile", debug_profile_handler,
+            timeout_s=max(60.0, self.request_timeout),
+        )
         self.router.add("GET", "/favicon.ico", favicon_wire_handler)
         from .swagger import register_swagger_routes
 

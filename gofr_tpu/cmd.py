@@ -78,6 +78,30 @@ class CMDRequest:
         raise TypeError("bind target must be a dataclass or None")
 
 
+def profile_command(ctx: Context) -> str:
+    """Built-in `profile` subcommand: run one jax.profiler capture window
+    and report where the trace landed. Flags: -seconds=N (default 2,
+    clamped 0.1..30), -dir=PATH (trace dir; default GOFR_PROFILE_DIR or
+    the tmpdir), -out=FILE.zip (also write the zipped archive there).
+    Parks with mode=fallback where the profiler is unavailable — the
+    archive then carries the park reason instead of a device trace."""
+    from .profiling.capture import profiler_capture
+
+    seconds = float(ctx.param("seconds") or 2.0)
+    trace_dir = ctx.param("dir") or None
+    res = profiler_capture().capture(seconds, trace_dir=trace_dir)
+    out = ctx.param("out")
+    if out:
+        with open(out, "wb") as f:
+            f.write(res["archive"])
+    parked = f" (parked: {res['parked']})" if res.get("parked") else ""
+    return (
+        f"profile mode={res['mode']}{parked} seconds={res['seconds']} "
+        f"files={len(res['files'])} dir={res['dir']}"
+        + (f" archive={out}" if out else "")
+    )
+
+
 class CMDApp:
     """App without servers; run() dispatches one subcommand (cmd.go:27-52)."""
 
@@ -86,6 +110,16 @@ class CMDApp:
         self.container = Container.create(self.config)
         self.logger = self.container.logger
         self._routes: list[tuple[re.Pattern, Callable, str]] = []
+        # Built-in subcommands, the CLI face of the profiler endpoint
+        # (GoFr ships pprof on by default; we ship the XLA capture).
+        # Dispatched AFTER user routes and anchored with \Z, so neither a
+        # user's own `profile` command nor a `profile-export`-style name
+        # is ever hijacked by the builtin.
+        self._builtins: list[tuple[re.Pattern, Callable, str]] = [(
+            re.compile(r"profile\Z"),
+            profile_command,
+            "capture a device profile (-seconds=N -dir=PATH -out=FILE.zip)",
+        )]
 
     def sub_command(self, pattern: str, handler: Callable, description: str = "") -> None:
         """Register a subcommand; pattern is a regex matched against the
@@ -97,7 +131,7 @@ class CMDApp:
 
     def _help_text(self) -> str:
         lines = ["Available commands:"]
-        for pat, _, desc in self._routes:
+        for pat, _, desc in self._routes + self._builtins:
             lines.append(f"  {pat.pattern}  {('- ' + desc) if desc else ''}")
         return "\n".join(lines)
 
@@ -107,7 +141,7 @@ class CMDApp:
         if not req.command or req.command in ("help", "--help"):
             print(self._help_text())
             return 0
-        for pattern, handler, _desc in self._routes:
+        for pattern, handler, _desc in self._routes + self._builtins:
             if pattern.fullmatch(req.command) or pattern.match(req.command):
                 ctx = Context(req, self.container)
                 try:

@@ -272,6 +272,9 @@ class TPURuntime:
             ):
                 if not metrics.has(name):
                     metrics.new_histogram(name, desc, buckets)
+            from ...profiling import register_compile_metrics
+
+            register_compile_metrics(metrics)  # app_jax_* observatory
         self.devices = jax.devices()
         self.platform = self.devices[0].platform if self.devices else "none"
         # periodic HBM gauges (app_tpu_hbm_*); parks itself off-TPU.
@@ -319,7 +322,14 @@ class TPURuntime:
         else:
             params = jax.device_put(params)
 
-        jitted = jax.jit(apply_fn)
+        # compile observatory: each batch bucket the batcher forms is a
+        # distinct signature — the registry shows one row per bucket with
+        # its compile time, so a mid-traffic compile stall is attributable
+        from ...profiling import instrument_jit
+
+        jitted = instrument_jit(
+            f"model:{name}", apply_fn, model=name, metrics=self.metrics
+        )
         max_batch = max_batch or self.default_max_batch
         max_delay_ms = (
             max_delay_ms if max_delay_ms is not None else self.default_max_delay_ms
@@ -495,8 +505,11 @@ class TPURuntime:
     def close(self) -> None:
         if self.telemetry is not None:
             self.telemetry.close()
+        from ...profiling import default_registry
+
         for m in self._models.values():
             m.batcher.close()
+            default_registry().remove_model(m.name)  # dead models unlisted
         self._models.clear()
         for eng in getattr(self, "_llms", {}).values():
             eng.close()

@@ -15,6 +15,7 @@ from __future__ import annotations
 import asyncio
 import contextvars
 import inspect
+import time
 from typing import Any, Callable
 
 from .container import Container
@@ -76,7 +77,34 @@ def wrap_handler(fn: Callable, container: Container, timeout_s: float | None) ->
 # -- built-in handlers (handler.go:78-113) --
 
 def health_handler(ctx: Context) -> Any:
-    return ctx.container.health()
+    """Aggregated health plus a top-level serving status. With the
+    HEALTH_DEGRADED_QUEUE_DEPTH / HEALTH_DEGRADED_ADMISSION_BACKLOG
+    thresholds configured, status flips to "degraded" (HTTP still 200 —
+    this is a shed-before-saturation signal for load balancers, not a
+    liveness failure) when the PR-2 engine gauges cross them. Unset
+    thresholds keep the legacy always-"UP" behavior."""
+    out = ctx.container.health()
+    out["status"] = _serving_status(ctx.container)
+    return out
+
+
+def _serving_status(container) -> str:
+    cfg = container.config
+    if cfg is None or container.metrics_manager is None:
+        return "UP"
+    try:
+        depth_max = cfg.get_float("HEALTH_DEGRADED_QUEUE_DEPTH", 0.0)
+        backlog_max = cfg.get_float("HEALTH_DEGRADED_ADMISSION_BACKLOG", 0.0)
+    except Exception:  # noqa: BLE001 — malformed config must not fail health
+        return "UP"
+    if depth_max <= 0 and backlog_max <= 0:
+        return "UP"
+    m = container.metrics_manager
+    if depth_max > 0 and m.gauge_total("app_llm_queue_depth") >= depth_max:
+        return "degraded"
+    if backlog_max > 0 and m.gauge_total("app_llm_admission_backlog") >= backlog_max:
+        return "degraded"
+    return "UP"
 
 
 def live_handler(_ctx: Context) -> Any:
@@ -97,6 +125,75 @@ def debug_engine_handler(ctx: Context) -> Any:
         "platform": getattr(rt, "platform", None),
         "engines": {name: eng.debug_state() for name, eng in llms.items()},
     }
+
+
+def debug_compiles_handler(_ctx: Context) -> Any:
+    """/.well-known/debug/compiles — the process compile registry: every
+    framework-owned jitted program (engine ops, batched models, train
+    steps) with its abstract arg shapes, compile/trace wall seconds,
+    cost_analysis FLOPs/bytes, recompile and trace-cache-hit counts,
+    plus jax.monitoring backend phase aggregates and per-engine warmup
+    records. jax-free import path: a pure-web app serves the (empty)
+    registry without initializing a backend."""
+    from .profiling import default_registry
+
+    return default_registry().snapshot()
+
+
+def debug_profile_handler(ctx: Context) -> Any:
+    """POST /.well-known/debug/profile — on-demand device profiler
+    capture (the GoFr-pprof analogue for XLA programs). Query params:
+    ``seconds`` (default 2, clamped 0.1..30 — must fit REQUEST_TIMEOUT),
+    ``steps`` (end early once the live engines have dispatched that many
+    further decode steps), ``download=0`` (JSON metadata instead of the
+    zip archive). One capture at a time: a concurrent request gets 409.
+    Where jax's profiler is unavailable the capture parks — the archive
+    then carries pure-Python engine samples plus the park reason, and
+    the JSON metadata says mode="fallback"."""
+    from .http.errors import ErrorInvalidParam
+    from .http.responder import FileResponse
+    from .profiling.capture import profiler_capture
+
+    import math
+
+    try:
+        seconds = float(ctx.param("seconds") or 2.0)
+        if not math.isfinite(seconds):
+            raise ValueError
+    except ValueError:
+        raise ErrorInvalidParam("seconds") from None
+    try:
+        steps = int(ctx.param("steps") or 0)
+    except ValueError:
+        raise ErrorInvalidParam("steps") from None
+    sample_fn = None
+    until = None
+    rt = ctx.container.tpu_runtime  # never construct: profile what runs
+    # snapshot the engine set at entry: a concurrent register_llm must not
+    # mutate the dict under the capture loop's sampling/until callbacks
+    llms = dict(getattr(rt, "_llms", {})) if rt is not None else {}
+    if llms:
+        def _sample():  # host-side view that makes the trace readable
+            return {
+                "t": time.time(),
+                "engines": {n: e.stats() for n, e in llms.items()},
+            }
+
+        sample_fn = _sample
+        if steps > 0:
+            replicas = [
+                rep for e in llms.values() for rep in getattr(e, "engines", [e])
+            ]
+
+            def _total_steps() -> int:
+                return sum(rep._stat_chunk_steps for rep in replicas)
+
+            start = _total_steps()
+            until = lambda: _total_steps() - start >= steps  # noqa: E731
+    res = profiler_capture().capture(seconds, sample_fn=sample_fn, until=until)
+    if ctx.param("download") == "0":
+        return {k: v for k, v in res.items() if k != "archive"}
+    return FileResponse(res["archive"], "application/zip")
 
 
 async def favicon_wire_handler(_req: Request) -> Response:

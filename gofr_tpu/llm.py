@@ -49,7 +49,13 @@ Design (all shapes static; a bounded set of compiled executables):
   app_llm_* phase histograms and engine-state gauges; with a logger it
   emits one JSON wide-event line per completed request. stats()["phases"]
   and debug_state() expose recent-window p50/p99 and the live slot table
-  (docs/advanced-guide/observability-serving.md).
+  (docs/advanced-guide/observability-serving.md). Every jitted program
+  goes through profiling.instrument_jit — per-shape compile wall time,
+  cost_analysis FLOPs, and cache-hit counts land in the process compile
+  registry (/.well-known/debug/compiles) — and each prefill wave /
+  decode chunk feeds analytic-FLOPs MFU, tokens/s/chip, and a roofline
+  compute-vs-HBM classification (stats()["mfu"], app_llm_mfu gauges;
+  docs/advanced-guide/profiling.md).
 
 Tensor parallelism: pass mesh + param_specs; the slot cache is resharded by
 GSPMD from the params' shardings (KV replicated under MQA, sharded when the
@@ -103,9 +109,20 @@ def _register_phase_metrics(metrics) -> None:
             ("app_llm_queue_depth", "llm requests waiting for a slot"),
             ("app_llm_admission_backlog",
              "llm requests mid-admission (pulled from queue, not yet slotted)"),
+            ("app_llm_mfu",
+             "model FLOPs utilization 0..1 per phase (analytic FLOPs / "
+             "measured wall / device peak)"),
+            ("app_llm_tokens_per_second_per_chip",
+             "llm decoded tokens per second per chip (last chunk)"),
+            ("app_llm_roofline_ratio",
+             "compute_time/memory_time per phase (>1 compute-bound, "
+             "<1 HBM-bandwidth-bound)"),
         ):
             if not metrics.has(name):
                 metrics.new_gauge(name, desc)
+    from .profiling import register_compile_metrics
+
+    register_compile_metrics(metrics)  # app_jax_* (own registration lock)
 
 
 class EngineOverloaded(RuntimeError):
@@ -214,6 +231,8 @@ class LLMEngine:
         from .kvcache import CacheManager
         from .models.transformer import decode_chunk as chunk_fn
         from .models.transformer import prefill
+        from .profiling import default_registry, instrument_jit
+        from .profiling import mfu as mfu_mod
         from .utils import enable_compilation_cache
 
         enable_compilation_cache(logger=logger)
@@ -231,7 +250,11 @@ class LLMEngine:
             # (VERDICT r2: 5.0 GB bf16 -> 2.5 GB); no-op if already quantized
             # (a jitted identity could still copy the tree in HBM, so skip).
             if not is_quantized(params):
-                params = jax.jit(lambda p: quantize_params(p, cfg.dtype))(params)
+                params = instrument_jit(
+                    "llm.quantize_params",
+                    lambda p: quantize_params(p, cfg.dtype),
+                    model=kv_label, metrics=metrics,
+                )(params)
             if param_specs is not None:
                 param_specs = quantize_param_specs(param_specs)
         self.quantized = quantize
@@ -274,6 +297,24 @@ class LLMEngine:
             "time_per_output_token": RollingWindow(),
             "decode_step": RollingWindow(),
         }
+        # MFU/roofline accounting: analytic model FLOPs computed ONCE from
+        # the architecture (gofr_tpu.profiling.mfu), combined per prefill
+        # wave / decode chunk with measured dispatch->fetch wall time and
+        # the device peak. Windows exist even without a metrics manager so
+        # stats()["mfu"] and bench.py work on bare engines.
+        self._mfu_mod = mfu_mod
+        self._costs = mfu_mod.model_costs(cfg, quantized=quantize)
+        _dev = jax.devices()[0] if jax.devices() else None
+        _platform = getattr(_dev, "platform", "")
+        _kind = getattr(_dev, "device_kind", "")
+        self._peak_flops = mfu_mod.device_peak_flops(_platform, _kind)
+        self._hbm_bw = mfu_mod.device_hbm_bandwidth(_platform, _kind)
+        self._n_chips = int(mesh.size) if mesh is not None else 1
+        self._mfu_windows = {"prefill": RollingWindow(), "decode": RollingWindow()}
+        self._roofline_windows = {"prefill": RollingWindow(), "decode": RollingWindow()}
+        self._tok_chip_window = RollingWindow()
+        self._registry = default_registry()
+        self.warmup_s: float | None = None
         self._wide_events: list[dict] = []  # appended under _lock, drained outside
         # KV layout/residency/reuse policy lives in the kvcache subsystem:
         # rolling ring for sliding-window models (slot memory O(window)),
@@ -354,7 +395,10 @@ class LLMEngine:
                     n_steps=K, sample_fn=_sample, ring=self.kv.ring,
                 )
 
-            return jax.jit(_chunk_op, donate_argnums=(2,))
+            return instrument_jit(
+                f"llm.decode_chunk{K}", _chunk_op, model=self.label,
+                metrics=metrics, donate_argnums=(2,),
+            )
 
         M = self.admit_cap
 
@@ -397,7 +441,14 @@ class LLMEngine:
             temps = temps.at[slot_idx].set(req_temps)
             return tail, active, temps
 
-        self._prefill_op = jax.jit(_prefill_op)
+        # Every serving executable goes through the compile observatory:
+        # per-signature compile wall time + cost_analysis into the process
+        # registry (/.well-known/debug/compiles), app_jax_* metrics when a
+        # manager is wired. Dispatch semantics (donation, shardings) are
+        # identical to the bare jax.jit these wrappers replace.
+        self._prefill_op = instrument_jit(
+            "llm.prefill", _prefill_op, model=self.label, metrics=metrics,
+        )
         # Two chunk lengths: the full chunk amortizes dispatch and is
         # chained eagerly to cover remaining demand (an 8-token completion
         # costs ~2 RTTs); the short variant (quarter length) only serves
@@ -407,9 +458,20 @@ class LLMEngine:
         self._chunk_ops = {decode_chunk: _make_chunk_op(decode_chunk)}
         if self._chunk_short != decode_chunk:
             self._chunk_ops[self._chunk_short] = _make_chunk_op(self._chunk_short)
-        self._insert_many = jax.jit(_insert_many, donate_argnums=(0,))
-        self._admit_update = jax.jit(_admit_update, donate_argnums=(0, 1, 2))
-        self._hit_first_op = jax.jit(_hit_first) if keep_logits else None
+        self._insert_many = instrument_jit(
+            "llm.insert_many", _insert_many, model=self.label,
+            metrics=metrics, donate_argnums=(0,),
+        )
+        self._admit_update = instrument_jit(
+            "llm.admit_update", _admit_update, model=self.label,
+            metrics=metrics, donate_argnums=(0, 1, 2),
+        )
+        self._hit_first_op = (
+            instrument_jit(
+                "llm.hit_first", _hit_first, model=self.label, metrics=metrics,
+            )
+            if keep_logits else None
+        )
         self._rng = jax.random.PRNGKey(0)
 
         self.cache = self.kv.init_cache(slots)
@@ -578,6 +640,10 @@ class LLMEngine:
                 # recent-window phase latencies (seconds): exact p50/p99
                 # over the last ~512 observations per phase
                 "phases": {k: w.summary() for k, w in self._phases.items()},
+                # utilization: analytic-FLOPs MFU + tokens/s/chip windows
+                # and the roofline verdict (profiling.mfu)
+                "mfu": self._mfu_summary(),
+                "warmup_s": self.warmup_s,
             }
 
     def debug_state(self) -> dict:
@@ -647,6 +713,11 @@ class LLMEngine:
             "waiting": waiting,
             "admitting": self._admitting,
             "phases": phases,
+            "mfu": self._mfu_summary(),
+            "warmup_s": self.warmup_s,
+            # this engine's rows from the process compile registry (the
+            # full cross-engine view lives at /.well-known/debug/compiles)
+            "compiles": self._registry.snapshot(model=self.label)["programs"],
             "submitted": self.submitted,
             "rejected": self.rejected,
             "shed": self.shed,
@@ -689,6 +760,25 @@ class LLMEngine:
         ):
             self.metrics.set_gauge(name, 0.0, model=self.label)
 
+    def _teardown_profiling(self) -> None:
+        """Compile-observatory teardown (close() and _die()): drop this
+        engine's registry rows and zero its utilization gauges — a dead
+        engine must neither list its programs at /debug/compiles nor keep
+        exporting its last MFU (the slot-gauge bug class all over again)."""
+        self._registry.remove_model(self.label)
+        if self.metrics is None:
+            return
+        for phase in ("prefill", "decode"):
+            self.metrics.set_gauge(
+                "app_llm_mfu", 0.0, model=self.label, phase=phase
+            )
+            self.metrics.set_gauge(
+                "app_llm_roofline_ratio", 0.0, model=self.label, phase=phase
+            )
+        self.metrics.set_gauge(
+            "app_llm_tokens_per_second_per_chip", 0.0, model=self.label
+        )
+
     def close(self) -> None:
         self._stop = True
         self._admit_q.put(None)
@@ -702,6 +792,7 @@ class LLMEngine:
         self._abort_all()
         self._drain_pending()
         self._zero_state_gauges()
+        self._teardown_profiling()
         self.kv.close()  # drop retained prefix rows (device buffers)
 
     def _drain_pending(self) -> None:
@@ -815,9 +906,15 @@ class LLMEngine:
         # the chain donated self.cache; adopt the output (zeros in, zeros
         # out — only length needs resetting)
         self.cache = cache._replace(length=jnp.zeros((self.slots,), jnp.int32))
+        # Warmup cost into the compile registry: this is the bill a cold
+        # restart pays before the first request, invisible in benches until
+        # BENCH_r07 (wall time — the pool overlaps compiles, so it is NOT
+        # the per-program sum the registry rows add up to).
+        self.warmup_s = time.perf_counter() - t0
+        self._registry.record_warmup(self.label, self.warmup_s, programs=n_tasks)
         if self.logger is not None:
             self.logger.info(
-                f"LLM engine warmed in {time.perf_counter() - t0:.1f}s "
+                f"LLM engine warmed in {self.warmup_s:.1f}s "
                 f"(buckets {self.prefill_buckets}, slots {self.slots}, "
                 f"chunk {self.decode_chunk})"
             )
@@ -1159,6 +1256,75 @@ class LLMEngine:
                 pass
 
     # -- observability ----------------------------------------------------
+    def _observe_mfu(
+        self, phase: str, tokens: int, flops: float, bytes_moved: float, dt: float,
+    ) -> None:
+        """One MFU/roofline observation for a finished device window.
+        dt is the dispatch->fetch wall interval; decode chunks PIPELINE
+        (up to `lookahead` in flight), so overlapping windows make this
+        an apparent utilization — read the window percentiles, never sum
+        them. Gauges carry the latest value; the rolling windows feed
+        stats()/debug/bench."""
+        if dt <= 0 or flops <= 0:
+            return
+        mfu = flops / dt / (self._peak_flops * self._n_chips)
+        ratio = self._mfu_mod.roofline_ratio(
+            flops, bytes_moved, self._peak_flops * self._n_chips,
+            self._hbm_bw * self._n_chips,
+        )
+        self._mfu_windows[phase].observe(mfu)
+        self._roofline_windows[phase].observe(ratio)
+        if phase == "decode":
+            self._tok_chip_window.observe(tokens / dt / self._n_chips)
+        if self.metrics is not None:
+            self.metrics.set_gauge(
+                "app_llm_mfu", mfu, model=self.label, phase=phase
+            )
+            self.metrics.set_gauge(
+                "app_llm_roofline_ratio", ratio, model=self.label, phase=phase
+            )
+            if phase == "decode":
+                self.metrics.set_gauge(
+                    "app_llm_tokens_per_second_per_chip",
+                    tokens / dt / self._n_chips, model=self.label,
+                )
+
+    def _mfu_summary(self) -> dict:
+        """The stats()/debug block: analytic constants + recent-window
+        utilization percentiles + the roofline verdict (median decode
+        ratio). Cheap: three window snapshots, no device interaction."""
+        decode_ratio = self._roofline_windows["decode"].summary()
+        return {
+            "peak_flops_per_chip": self._peak_flops,
+            "hbm_bw_per_chip": self._hbm_bw,
+            "chips": self._n_chips,
+            "params": self._costs.params,
+            "flops_per_token": self._costs.matmul_flops_per_token,
+            "prefill": self._mfu_windows["prefill"].summary(),
+            "decode": self._mfu_windows["decode"].summary(),
+            "tokens_per_second_per_chip": self._tok_chip_window.summary(),
+            "roofline": {
+                "prefill": self._roofline_windows["prefill"].summary(),
+                "decode": decode_ratio,
+                "bound": self._mfu_mod.classify_bound(decode_ratio["p50"]),
+            },
+        }
+
+    def _ctx_tokens(self, snapshot: list) -> tuple[int, int]:
+        """(active requests, summed attended context positions) for one
+        chunk step — per-slot context capped at the sliding window, since
+        the rolling ring never reads past it."""
+        w = self._costs.sliding_window
+        active = 0
+        ctx = 0
+        for r in snapshot:
+            if r is None:
+                continue
+            active += 1
+            c = len(r.prompt_tokens) + r.emitted
+            ctx += min(c, w) if w else c
+        return active, ctx
+
     def _phase_span(
         self, r: GenRequest, name: str, t0: float, t1: float,
         attrs: dict | None = None,
@@ -1343,6 +1509,19 @@ class LLMEngine:
             _, first_dev, taken, info = entry
             first = np.asarray(first_dev)
             now = time.perf_counter()
+            if info["bucket"] is not None:  # miss wave: a device prefill ran
+                # (prefix-hit waves dispatch no prefill — no MFU to claim)
+                seq_lens = [len(r.prompt_tokens) for _, r in taken]
+                self._observe_mfu(
+                    "prefill",
+                    tokens=sum(seq_lens),
+                    flops=self._mfu_mod.prefill_flops(self._costs, seq_lens),
+                    bytes_moved=(
+                        self._costs.params_bytes
+                        + sum(seq_lens) * self._costs.kv_bytes_per_ctx_token
+                    ),
+                    dt=now - info["t0"],
+                )
             with self._lock:
                 for j, (slot, r) in enumerate(taken):
                     if r.span is not None and r.finish_reason is None:
@@ -1373,9 +1552,24 @@ class LLMEngine:
         # dispatch->fetch cost per decode step, attributed once per chunk
         # (wave = active slots at dispatch, bucketed to a power of two so
         # the label set stays bounded at log2(slots) values)
-        active_n = sum(r is not None for r in snapshot)
+        active_n, ctx_sum = self._ctx_tokens(snapshot)
         step_s = (now - t_dispatch) / k
         self._phases["decode_step"].observe(step_s)
+        if active_n:
+            # each of the k steps decodes one token per active slot and
+            # re-streams the weights + the live KV prefix
+            self._observe_mfu(
+                "decode",
+                tokens=k * active_n,
+                flops=self._mfu_mod.decode_flops(
+                    self._costs, k * active_n, k * ctx_sum
+                ),
+                bytes_moved=k * (
+                    self._costs.params_bytes
+                    + ctx_sum * self._costs.kv_bytes_per_ctx_token
+                ),
+                dt=now - t_dispatch,
+            )
         if self.metrics is not None:
             wave = 1 << max(0, active_n - 1).bit_length() if active_n else 0
             self.metrics.record_histogram(
@@ -1458,6 +1652,7 @@ class LLMEngine:
             pass
         self._drain_pending()
         self._zero_state_gauges()
+        self._teardown_profiling()
         self._kick.set()
         with self._work_cv:
             self._work_cv.notify_all()
@@ -1732,6 +1927,7 @@ class ReplicatedLLMEngine:
             # fleet-wide phase percentiles: pooled raw windows, not an
             # average of per-replica percentiles (which has no meaning)
             "phases": self._merged_phases(),
+            "mfu": self._merged_mfu(),
         }
         prefixes = [
             s["kvcache"]["prefix"] for s in per if s["kvcache"].get("prefix")
@@ -1751,6 +1947,39 @@ class ReplicatedLLMEngine:
             for name, w in e._phases.items():
                 merged.setdefault(name, []).extend(w.values())
         return {name: summarize_window(vs) for name, vs in merged.items()}
+
+    def _merged_mfu(self) -> dict:
+        """Fleet utilization, same shape as LLMEngine.stats()['mfu'] so
+        consumers (bench's _mfu_block, dashboards) never branch on the
+        engine kind: pooled raw MFU/roofline/token-rate windows (the
+        no-averaging-percentiles rule of _merged_phases)."""
+        from .metrics import summarize_window
+
+        lead = self.engines[0]
+        out: dict = {
+            "chips": sum(e._n_chips for e in self.engines),
+            "peak_flops_per_chip": lead._peak_flops,
+            "hbm_bw_per_chip": lead._hbm_bw,
+            "params": lead._costs.params,
+            "flops_per_token": lead._costs.matmul_flops_per_token,
+        }
+        for key in ("prefill", "decode"):
+            out[key] = summarize_window(
+                [v for e in self.engines for v in e._mfu_windows[key].values()]
+            )
+        out["tokens_per_second_per_chip"] = summarize_window(
+            [v for e in self.engines for v in e._tok_chip_window.values()]
+        )
+        roofline = {
+            key: summarize_window([
+                v for e in self.engines
+                for v in e._roofline_windows[key].values()
+            ])
+            for key in ("prefill", "decode")
+        }
+        roofline["bound"] = lead._mfu_mod.classify_bound(roofline["decode"]["p50"])
+        out["roofline"] = roofline
+        return out
 
     def debug_state(self) -> dict:
         return {

@@ -243,6 +243,16 @@ class Manager:
     def histogram(self, name: str) -> Histogram | None:
         return self._get(name, Histogram)
 
+    def gauge_total(self, name: str) -> float:
+        """Sum of a gauge across its label sets (0.0 when unregistered).
+        Silent like has(): framework health probes read engine gauges
+        that only exist once an LLM is registered."""
+        with self._lock:
+            g = self._instruments.get(name)
+        if not isinstance(g, Gauge):
+            return 0.0
+        return sum(value for _name, _labels, value in g.collect())
+
     # -- exposition --
     def render_prometheus(self) -> str:
         """Prometheus text format 0.0.4."""

@@ -207,11 +207,9 @@ def make_pp_train_step(
         )
         return jax.device_put(params, shardings)
 
-    @jax.jit
     def init_opt_fn(params):
         return opt.init(params)
 
-    @jax.jit
     def step_fn(params, opt_state, tokens, mask):
         loss, grads = jax.value_and_grad(pp_lm_loss)(
             params, cfg, tokens, mask, pp_fn, n_micro
@@ -220,4 +218,11 @@ def make_pp_train_step(
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss
 
-    return shard_fn, init_opt_fn, step_fn
+    # same compile-observatory wrapping as parallel.train.make_train_step
+    from ..profiling import instrument_jit
+
+    return (
+        shard_fn,
+        instrument_jit("parallel.pp_init_opt", init_opt_fn, model="pipeline"),
+        instrument_jit("parallel.pp_train_step", step_fn, model="pipeline"),
+    )

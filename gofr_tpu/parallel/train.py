@@ -59,18 +59,25 @@ def make_train_step(
         specs = param_specs(cfg, mesh, untied="unembed" in params)
         return shard_params(params, mesh, specs)
 
-    @jax.jit
     def init_opt_fn(params):
         return opt.init(params)
 
-    @jax.jit
     def step_fn(params, opt_state, tokens, mask):
         loss, grads = jax.value_and_grad(lm_loss)(params, cfg, tokens, mask)
         updates, opt_state = opt.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss
 
-    return shard_fn, init_opt_fn, step_fn
+    # compile observatory (gofr_tpu.profiling): the train step is by far
+    # the process's largest compile — its registry row is how a dryrun or
+    # notebook attributes a multi-second stall to XLA, not the optimizer
+    from ..profiling import instrument_jit
+
+    return (
+        shard_fn,
+        instrument_jit("parallel.init_opt", init_opt_fn, model="train"),
+        instrument_jit("parallel.train_step", step_fn, model="train"),
+    )
 
 
 def place_batch(batch: Any, mesh) -> Any:

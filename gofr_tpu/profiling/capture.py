@@ -1,0 +1,176 @@
+"""On-demand device profiler capture with single-capture concurrency.
+
+The analogue of GoFr exposing pprof next to its metrics server: a
+running gofr_tpu server can hand back a device profile without a
+restart. ``POST /.well-known/debug/profile`` (and the ``profile`` CLI
+subcommand) drive :class:`ProfilerCapture`, which runs
+``jax.profiler.start_trace``/``stop_trace`` for N seconds — or until a
+caller-supplied condition (the engine handler uses "M decode steps
+dispatched") — and returns the trace directory zipped.
+
+Concurrency: the XLA profiler is a process-global singleton, so exactly
+ONE capture may run at a time; a second request while one is in flight
+fails fast with :class:`ProfileBusy` (HTTP 409 through the responder's
+status_code seam) instead of corrupting the live session.
+
+Parking: where ``jax.profiler`` is unavailable or refuses to start
+(stripped containers, backends without a profiler plugin), the capture
+*parks* — it still samples the engine/debug state at 10 Hz in pure
+Python, archives those samples with the park reason, and reports
+``mode="fallback"`` — so the endpoint, its tests, and the CI smoke stay
+meaningful on the CPU backend. Even in jax mode the samples ride along
+in the archive (``engine_samples.json``): the host-side view of slot
+occupancy over the capture window is what makes a device trace
+interpretable.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import math
+import os
+import tempfile
+import threading
+import time
+import zipfile
+from typing import Any, Callable
+
+__all__ = ["ProfileBusy", "ProfilerCapture", "profiler_capture"]
+
+_MAX_SECONDS = 30.0  # past this, use jax's own remote profiling tooling
+_SAMPLE_PERIOD_S = 0.1
+
+
+class ProfileBusy(RuntimeError):
+    """A capture is already running. The XLA profiler is process-global —
+    carries status_code so the HTTP responder maps it to 409 without a
+    handler-side catch (same seam as llm.EngineOverloaded -> 429)."""
+
+    status_code = 409
+
+
+class ProfilerCapture:
+    """One capture at a time; archives the trace dir to zip bytes."""
+
+    def __init__(self, base_dir: str | None = None):
+        self._busy = threading.Lock()
+        self.base_dir = base_dir
+
+    def _resolve_dir(self, trace_dir: str | None) -> str:
+        d = (
+            trace_dir
+            or self.base_dir
+            or os.environ.get("GOFR_PROFILE_DIR")
+            or os.path.join(tempfile.gettempdir(), "gofr-tpu-profiles")
+        )
+        run = os.path.join(d, time.strftime("%Y%m%d-%H%M%S") + f"-{os.getpid()}")
+        os.makedirs(run, exist_ok=True)
+        return run
+
+    def capture(
+        self,
+        seconds: float = 2.0,
+        *,
+        trace_dir: str | None = None,
+        sample_fn: Callable[[], Any] | None = None,
+        until: Callable[[], bool] | None = None,
+    ) -> dict:
+        """Run one capture window. `seconds` bounds the window (clamped to
+        0.1..30 — an HTTP capture must fit REQUEST_TIMEOUT); `until`
+        (e.g. "M decode steps dispatched") ends it early; `sample_fn` is
+        polled at 10 Hz and its samples archived alongside the trace.
+
+        Returns {mode, seconds, dir, files, archive, parked?}: `archive`
+        is the zip bytes of everything written under `dir`; `mode` is
+        "jax" for a real device trace, "fallback" for the parked
+        pure-Python capture (with `parked` carrying the reason)."""
+        seconds = float(seconds)
+        if not math.isfinite(seconds):
+            # NaN slips through min/max (all comparisons False) and would
+            # make the window infinite with the busy lock held forever
+            raise ValueError(f"seconds must be finite, got {seconds}")
+        seconds = min(max(seconds, 0.1), _MAX_SECONDS)
+        if not self._busy.acquire(blocking=False):
+            raise ProfileBusy(
+                "a profile capture is already running (the XLA profiler is "
+                "process-global; retry when the current capture finishes)"
+            )
+        try:
+            run_dir = self._resolve_dir(trace_dir)
+            mode, parked = "jax", None
+            try:
+                import jax
+
+                jax.profiler.start_trace(run_dir)
+            except Exception as e:  # noqa: BLE001 — park, don't fail
+                mode, parked = "fallback", f"{type(e).__name__}: {e}"
+            samples: list[Any] = []
+            t0 = time.perf_counter()
+            deadline = t0 + seconds
+            try:
+                while True:
+                    if sample_fn is not None:
+                        try:
+                            samples.append(sample_fn())
+                        except Exception:  # noqa: BLE001 — samples are best-effort
+                            pass
+                    if until is not None and until():
+                        break
+                    remaining = deadline - time.perf_counter()
+                    if remaining <= 0:
+                        break
+                    time.sleep(min(_SAMPLE_PERIOD_S, remaining))
+            finally:
+                # stop_trace runs even when until() (caller code) raises:
+                # the XLA profiler is process-global, and leaving it
+                # started would park every future capture until restart
+                if mode == "jax":
+                    try:
+                        import jax
+
+                        jax.profiler.stop_trace()
+                    except Exception as e:  # noqa: BLE001
+                        mode, parked = "fallback", f"stop_trace: {type(e).__name__}: {e}"
+            elapsed = time.perf_counter() - t0
+            meta = {
+                "mode": mode,
+                "seconds": round(elapsed, 3),
+                "requested_seconds": seconds,
+                "samples": len(samples),
+            }
+            if parked:
+                meta["parked"] = parked
+            with open(os.path.join(run_dir, "capture.json"), "w", encoding="utf-8") as f:
+                json.dump(meta, f, indent=1, default=str)
+            if samples:
+                with open(
+                    os.path.join(run_dir, "engine_samples.json"), "w", encoding="utf-8"
+                ) as f:
+                    json.dump(samples, f, default=str)
+            files, archive = _zip_dir(run_dir)
+            return {**meta, "dir": run_dir, "files": files, "archive": archive}
+        finally:
+            self._busy.release()
+
+
+def _zip_dir(run_dir: str) -> tuple[list[str], bytes]:
+    buf = io.BytesIO()
+    names: list[str] = []
+    with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as z:
+        for root, _dirs, files in os.walk(run_dir):
+            for name in sorted(files):
+                path = os.path.join(root, name)
+                rel = os.path.relpath(path, run_dir)
+                names.append(rel)
+                z.write(path, rel)
+    return names, buf.getvalue()
+
+
+_capturer = ProfilerCapture()
+
+
+def profiler_capture() -> ProfilerCapture:
+    """The process-wide capturer (the XLA profiler itself is one per
+    process, so the guard must be too)."""
+    return _capturer

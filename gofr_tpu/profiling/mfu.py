@@ -1,0 +1,195 @@
+"""Analytic model FLOPs, device peaks, and MFU/roofline classification.
+
+MFU (model FLOPs utilization, the PaLM-system-report framing) is the
+serving health signal the latency histograms cannot give: *useful* model
+FLOPs per second divided by the chip's peak. The analytic side is
+computed ONCE per registered model from the architecture — the standard
+2·params·tokens matmul count plus the attention correction (4·L·H·d per
+token per attended position, QKᵀ and AV) — and the engine combines it
+with measured phase wall time per prefill wave / decode chunk.
+
+Roofline classification compares the program's compute time at peak
+FLOPs against its memory time at peak HBM bandwidth: decode streams the
+whole weight set plus the live KV prefix per step, so it is
+memory-bound everywhere that matters; prefill at real batch widths is
+compute-bound. A phase whose measured ratio flips side is the first
+sign a kernel regressed.
+
+Peaks are tabulated per TPU device kind (bf16 dense MXU numbers, the
+convention MFU reports use even when serving int8). Off-TPU there is no
+honest peak: the CPU backend uses a nominal 1 TFLOP/s placeholder so
+the gauges stay finite and testable — override with the
+``TPU_PEAK_FLOPS`` / ``TPU_HBM_BW`` env knobs when you care about the
+absolute value.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+
+__all__ = [
+    "ModelCosts",
+    "model_costs",
+    "decode_flops",
+    "prefill_flops",
+    "device_peak_flops",
+    "device_hbm_bandwidth",
+    "roofline_ratio",
+    "classify_bound",
+]
+
+# bf16 dense peak FLOP/s and HBM bandwidth (B/s) by device-kind substring.
+# v5e numbers match bench.py's V5E_PEAK_BF16 / V5E_HBM_BW constants.
+_TPU_PEAKS: tuple[tuple[str, float, float], ...] = (
+    ("v5 lite", 197e12, 8.2e11),
+    ("v5e", 197e12, 8.2e11),
+    ("v5p", 459e12, 2.765e12),
+    ("v6 lite", 918e12, 1.64e12),
+    ("v6e", 918e12, 1.64e12),
+    ("v4", 275e12, 1.2e12),
+    ("v3", 123e12, 9.0e11),
+    ("v2", 45e12, 7.0e11),
+)
+
+# Off-TPU placeholder peak: keeps MFU/roofline math finite on the CPU
+# test backend without pretending to know the host's real roofline.
+_FALLBACK_PEAK_FLOPS = 1e12
+_FALLBACK_HBM_BW = 1e11
+
+
+def device_peak_flops(platform: str = "", device_kind: str = "") -> float:
+    """Peak dense FLOP/s per chip (bf16 convention). TPU_PEAK_FLOPS
+    overrides; unknown device kinds fall back to the nominal placeholder."""
+    env = os.environ.get("TPU_PEAK_FLOPS")
+    if env:
+        try:
+            return float(env)
+        except ValueError:
+            pass
+    kind = (device_kind or "").lower()
+    if platform == "tpu" or "tpu" in kind:
+        for sub, peak, _bw in _TPU_PEAKS:
+            if sub in kind:
+                return peak
+    return _FALLBACK_PEAK_FLOPS
+
+
+def device_hbm_bandwidth(platform: str = "", device_kind: str = "") -> float:
+    """Peak HBM bandwidth per chip in B/s (TPU_HBM_BW overrides)."""
+    env = os.environ.get("TPU_HBM_BW")
+    if env:
+        try:
+            return float(env)
+        except ValueError:
+            pass
+    kind = (device_kind or "").lower()
+    if platform == "tpu" or "tpu" in kind:
+        for sub, _peak, bw in _TPU_PEAKS:
+            if sub in kind:
+                return bw
+    return _FALLBACK_HBM_BW
+
+
+@dataclass(frozen=True)
+class ModelCosts:
+    """Per-model analytic constants, computed once at engine registration.
+
+    ``matmul_flops_per_token`` is the classic 2·params count over the
+    weight matmuls a decoded token touches (layer stack + the unembed
+    projection; the embedding *lookup* is a gather, not a matmul).
+    ``attn_flops_per_token_per_ctx`` is the attention correction per
+    attended position: QKᵀ and AV are each 2·H·d FLOPs per (token,
+    position) pair per layer."""
+
+    params: int  # total parameter count (embed counted once when tied)
+    layer_params: int  # weight params across the layer stack
+    embed_params: int  # vocab x d_model (the unembed matmul's matrix)
+    matmul_flops_per_token: int
+    attn_flops_per_token_per_ctx: int
+    kv_bytes_per_ctx_token: int  # bytes of K+V a step reads per attended position
+    params_bytes: int  # resident weight bytes (int8 when quantized)
+    sliding_window: int  # 0 = global attention
+
+
+def model_costs(cfg, *, quantized: bool = False) -> ModelCosts:
+    """Architecture-derived cost constants for a TransformerConfig.
+
+    Matches the parameter accounting bench.py's raw probes use (attention
+    projections with GQA, the 3-matrix gated MLP, one vocab x d embed
+    matrix) so the two never disagree about what "2·params" means."""
+    layer_params = (
+        cfg.d_model * (cfg.n_heads + 2 * cfg.n_kv_heads) * cfg.head_dim  # qkv
+        + cfg.n_heads * cfg.head_dim * cfg.d_model  # attention out
+        + 3 * cfg.d_model * cfg.d_ff  # gate/up/down
+    ) * cfg.n_layers
+    embed_params = cfg.vocab_size * cfg.d_model
+    itemsize = 1 if quantized else _dtype_itemsize(cfg.dtype)
+    kv_itemsize = _dtype_itemsize(cfg.dtype)  # KV cache stays cfg.dtype
+    return ModelCosts(
+        params=layer_params + embed_params,
+        layer_params=layer_params,
+        embed_params=embed_params,
+        matmul_flops_per_token=2 * (layer_params + embed_params),
+        attn_flops_per_token_per_ctx=4 * cfg.n_layers * cfg.n_heads * cfg.head_dim,
+        kv_bytes_per_ctx_token=2 * cfg.n_layers * cfg.n_kv_heads * cfg.head_dim * kv_itemsize,
+        params_bytes=(layer_params + embed_params) * itemsize,
+        sliding_window=int(getattr(cfg, "sliding_window", 0) or 0),
+    )
+
+
+def _dtype_itemsize(dtype) -> int:
+    try:
+        import numpy as np
+
+        return int(np.dtype(dtype).itemsize)
+    except Exception:  # noqa: BLE001 — bf16 has no numpy dtype pre-ml_dtypes
+        name = str(getattr(dtype, "__name__", dtype))
+        return 2 if "16" in name else 4
+
+
+def decode_flops(costs: ModelCosts, tokens: int, ctx_total: int) -> float:
+    """FLOPs for `tokens` decoded tokens attending over `ctx_total`
+    summed context positions (already window-capped by the caller)."""
+    return (
+        tokens * costs.matmul_flops_per_token
+        + costs.attn_flops_per_token_per_ctx * ctx_total
+    )
+
+
+def prefill_flops(costs: ModelCosts, seq_lens: list[int]) -> float:
+    """FLOPs for one prefill wave over the given actual prompt lengths.
+    Useful-work convention: padding rows and pad tail positions count
+    zero, so MFU reads as useful model FLOPs per peak — padding waste
+    shows up as LOW utilization rather than being flattered away. The
+    unembed matmul runs once per sequence (last position only) and
+    causal attention attends ~s/2 positions per token (window-capped)."""
+    total = 0.0
+    w = costs.sliding_window
+    for s in seq_lens:
+        if not w or s <= w:
+            attended = s * (s + 1) / 2  # full causal triangle
+        else:
+            # exact window cap: the first w tokens attend causally, every
+            # later token attends exactly w positions
+            attended = w * (w + 1) / 2 + (s - w) * w
+        total += (
+            2 * s * costs.layer_params
+            + 2 * costs.embed_params
+            + costs.attn_flops_per_token_per_ctx * attended
+        )
+    return total
+
+
+def roofline_ratio(flops: float, bytes_moved: float, peak_flops: float, hbm_bw: float) -> float:
+    """compute_time / memory_time for one program execution: > 1 means
+    the roofline predicts compute-bound, < 1 memory(HBM)-bound."""
+    if bytes_moved <= 0 or peak_flops <= 0 or hbm_bw <= 0:
+        return 0.0
+    return (flops / peak_flops) / (bytes_moved / hbm_bw)
+
+
+def classify_bound(ratio: float) -> str:
+    if ratio <= 0:
+        return "unknown"
+    return "compute" if ratio >= 1.0 else "memory"

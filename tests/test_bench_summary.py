@@ -21,7 +21,19 @@ def _serving_result():
             "engine_vs_ceiling": 0.951,
             "device_ceiling_sustained_qps": 379.0,
             "device": "TPU v5e",
-            "slo_point": {"steady_qps": 294.8, "p99_over_p50": 1.6},
+            "slo_point": {
+                "steady_qps": 294.8, "p99_over_p50": 1.6,
+                "mfu": {
+                    "decode_p50": 0.041, "prefill_p50": 0.39,
+                    "tokens_per_s_per_chip_p50": 5530.0, "bound": "memory",
+                    "roofline_decode_p50": 0.07,
+                    "peak_flops_per_chip": 197e12,
+                },
+            },
+            "warmup": {
+                "warmup_s": 14.2, "engine_init_s": 16.0,
+                "programs": 11, "compile_s_total": 38.5,
+            },
             "short_prompt_8tok": {
                 "qps": 1069.0,
                 "latency_vs_load": [
@@ -57,6 +69,15 @@ def test_summary_line_contains_all_headline_fields():
     assert s["prefix_vs_ceiling"] == 1.37
     assert s["greet_qps"] == 4050.0
     assert s["mlp_qps"] == 9100.0
+    # BENCH_r07+: the SLO point carries utilization, the line carries the
+    # cold-start bill — both compact blocks, not the full stats dump
+    assert s["mfu"] == {
+        "decode_p50": 0.041, "prefill_p50": 0.39,
+        "tokens_per_s_per_chip_p50": 5530.0, "bound": "memory",
+    }
+    assert s["warmup"] == {
+        "warmup_s": 14.2, "programs": 11, "compile_s_total": 38.5,
+    }
 
 
 def test_summary_line_fits_tail_capture():
