@@ -379,12 +379,14 @@ def _closed_loop(eng, cfg, prompt_len, new_tokens: int, requests: int,
     }
 
 
-def _open_loop(eng, cfg, prompt_len: int, new_tokens: int, rate: float,
+def _open_loop(eng, cfg, prompt_len, new_tokens: int, rate: float,
                duration_s: float, seed: int = 1) -> dict:
     """Open-loop Poisson arrivals at `rate` req/s: latency measured from
     the SCHEDULED arrival time, so queueing delay under overload is
     visible instead of being absorbed by client backpressure (the r2
-    bench's closed-loop p50 was a queueing artifact — VERDICT weak #5)."""
+    bench's closed-loop p50 was a queueing artifact — VERDICT weak #5).
+    prompt_len: int for fixed lengths, or a (choices...) tuple drawn
+    uniformly per request (the interactive-SLO mixed workload)."""
     from concurrent.futures import ThreadPoolExecutor
 
     from gofr_tpu.llm import EngineOverloaded, GenRequest
@@ -394,7 +396,11 @@ def _open_loop(eng, cfg, prompt_len: int, new_tokens: int, rate: float,
     n = max(1, int(rate * duration_s))
     gaps = rng_np.exponential(1.0 / rate, size=n)
     arrivals = np.cumsum(gaps)
-    prompts = [rng_np.integers(1, cfg.vocab_size, size=prompt_len).tolist() for _ in range(n)]
+    if isinstance(prompt_len, tuple):
+        lens = rng_np.choice(list(prompt_len), size=n)
+    else:
+        lens = [prompt_len] * n
+    prompts = [rng_np.integers(1, cfg.vocab_size, size=int(pl)).tolist() for pl in lens]
     lat: list[float] = []
     ttft: list[float] = []
     lock = threading.Lock()
@@ -456,6 +462,7 @@ def _open_loop(eng, cfg, prompt_len: int, new_tokens: int, rate: float,
         "p50_ms": round(_percentile(lat, 0.50) * 1e3, 1),
         "p99_ms": round(_percentile(lat, 0.99) * 1e3, 1),
         "ttft_p50_ms": round(_percentile(ttft, 0.50) * 1e3, 1),
+        "ttft_p99_ms": round(_percentile(ttft, 0.99) * 1e3, 1),
     }
     if rejected:
         out["rejected"] = rejected
@@ -710,6 +717,14 @@ def bench_serving(args) -> dict:
             args, cfg, eng.params if quantize else params, quantize
         )
 
+    # interactive-SLO point (BENCH_r08+): mixed 16/120-token prompts at a
+    # fixed offered load — the tail-latency view of the chunked-prefill
+    # scheduler (TTFT p99, p99/p50, per-step wall-time jitter)
+    if on_tpu and not args.no_interactive_slo and not args.no_open_loop:
+        detail["interactive_slo"] = _bench_interactive_slo(
+            args, cfg, eng.params if quantize else params, quantize
+        )
+
     # prefix-cache operating point: 50% shared-prefix traffic — hits skip
     # the prefill wave entirely, so the engine can exceed the NO-CACHE
     # device ceiling (per-request prefill is the larger serial share at
@@ -823,6 +838,67 @@ def _bench_prefix_cache(args, cfg, params, quantize: bool, ceiling_qps: float) -
             "prefix_resident_mb": round(kvp["resident_bytes"] / 2**20, 1),
             "no_cache_ceiling_qps": round(ceiling_qps, 0),
             "qps_vs_no_cache_ceiling": round(point["qps"] / ceiling_qps, 3),
+        })
+    finally:
+        eng.close()
+    return point
+
+
+def _bench_interactive_slo(args, cfg, params, quantize: bool) -> dict:
+    """Interactive-SLO point (BENCH_r08+): mixed 16/120-token prompts at a
+    FIXED offered load, reporting the tail metrics the chunked-prefill
+    scheduler exists to move — TTFT p99, completion p99/p50, and
+    per-step wall-time jitter. Fixed-rate (not capacity-relative) so
+    rounds compare apples-to-apples: BENCH_r05's mixed point showed
+    head-of-line TTFT (p50 804 ms) from bucket-padded monolithic waves;
+    this point watches that tail directly."""
+    from gofr_tpu.llm import LLMEngine
+
+    S = args.prefill_len
+    eng = LLMEngine(
+        cfg, params, slots=args.batch,
+        max_seq_len=S + args.new_tokens + 2 * args.decode_chunk,
+        prefill_buckets=(max(16, S // 4), S), decode_chunk=args.decode_chunk,
+        admit_cap=args.admit_cap, quantize=quantize,
+        max_queue=4 * args.batch,
+    )
+    try:
+        # floor the long length for tiny --prefill-len runs, but never
+        # beyond S: max_seq_len is sized for S-token prompts, so anything
+        # longer fails submit()'s decode-room check (ValueError, which
+        # _open_loop does not shield) instead of serving
+        long_len = min(max(24, S - 8), S)
+        mixed = (min(16, long_len), long_len)
+        # warm every step shape the mixed lengths touch
+        _open_loop(eng, cfg, mixed, args.new_tokens, 50.0, 2.0)
+        point = _open_loop(
+            eng, cfg, mixed, args.new_tokens, args.interactive_rate,
+            args.open_loop_s,
+        )
+        st = eng.stats()
+        steps = st["phases"].get("step", {})
+        decode = st["phases"].get("decode_step", {})
+        point.update({
+            "prompt_lens": list(mixed),
+            "p99_over_p50": round(
+                point["p99_ms"] / max(point["p50_ms"], 1e-9), 2
+            ),
+            "ttft_p99_over_p50": round(
+                point["ttft_p99_ms"] / max(point["ttft_p50_ms"], 1e-9), 2
+            ),
+            "scheduler": st.get("scheduler"),
+            "step_token_budget": st.get("step_token_budget"),
+            # per-step wall-time jitter: the bounded-step claim in one
+            # number — a monolithic wave path shows multi-ms spikes here
+            "step_jitter": {
+                "step_p50_ms": round(steps.get("p50", 0.0) * 1e3, 2),
+                "step_p99_ms": round(steps.get("p99", 0.0) * 1e3, 2),
+                "step_p99_over_p50": round(
+                    steps.get("p99", 0.0) / max(steps.get("p50", 0.0), 1e-9), 2
+                ) if steps.get("count") else 0.0,
+                "decode_step_p50_ms": round(decode.get("p50", 0.0) * 1e3, 2),
+                "decode_step_p99_ms": round(decode.get("p99", 0.0) * 1e3, 2),
+            },
         })
     finally:
         eng.close()
@@ -1054,6 +1130,11 @@ def main() -> None:
                     help="skip the 4k-prompt sliding-window operating point")
     ap.add_argument("--no-prefix-cache", action="store_true",
                     help="skip the 50%%-shared-prefix prefix-cache point")
+    ap.add_argument("--no-interactive-slo", action="store_true",
+                    help="skip the mixed-prompt interactive-SLO point")
+    ap.add_argument("--interactive-rate", type=float, default=250.0,
+                    help="fixed offered load (req/s) for the interactive-"
+                         "SLO point — fixed so rounds compare directly")
     ap.add_argument("--lc-prompt", type=int, default=4096,
                     help="long-context prompt bucket")
     ap.add_argument("--lc-window", type=int, default=1024,
@@ -1141,6 +1222,17 @@ def _summary_line(result: dict) -> dict:
         pc = d["prefix_cache"]
         s["prefix_cache_qps"] = pc.get("qps")
         s["prefix_vs_ceiling"] = pc.get("qps_vs_no_cache_ceiling")
+    if d.get("interactive_slo"):  # BENCH_r08+: chunked-prefill tail view
+        isl = d["interactive_slo"]
+        s["interactive_slo"] = {
+            "offered_qps": isl.get("offered_qps"),
+            "steady_qps": isl.get("steady_qps"),
+            "ttft_p99_ms": isl.get("ttft_p99_ms"),
+            "p99_over_p50": isl.get("p99_over_p50"),
+            "step_p99_over_p50": (isl.get("step_jitter") or {}).get(
+                "step_p99_over_p50"
+            ),
+        }
     if d.get("subruns"):
         s["greet_qps"] = d["subruns"].get("greet_qps_cpu")
         s["mlp_qps"] = d["subruns"].get("mlp_qps")

@@ -255,6 +255,10 @@ class TPURuntime:
         self.default_llm_prefix_cache_mb = float(
             get("TPU_LLM_PREFIX_CACHE_MB", "0")
         )
+        # token-budget step scheduler knobs (gofr_tpu.llm; "" = engine
+        # defaults, which also honor the same names as process env vars)
+        self.default_llm_step_budget = get("TPU_LLM_STEP_TOKEN_BUDGET", "")
+        self.default_llm_prefill_chunk = get("TPU_LLM_PREFILL_CHUNK", "")
         self._models: dict[str, _Model] = {}
         self._lock = threading.Lock()
         if metrics is not None:
@@ -431,10 +435,20 @@ class TPURuntime:
         a per-request router behind the same handle (SURVEY §2.8 row 1).
         KV layout/residency policy (rolling window caches, prefix reuse)
         comes from gofr_tpu.kvcache; `prefix_cache_mb` defaults to the
-        TPU_LLM_PREFIX_CACHE_MB config knob."""
+        TPU_LLM_PREFIX_CACHE_MB config knob, and the token-budget step
+        scheduler honors TPU_LLM_STEP_TOKEN_BUDGET / TPU_LLM_PREFILL_CHUNK
+        (docs/advanced-guide/scheduling.md)."""
         from ...llm import LLMEngine, ReplicatedLLMEngine
 
         engine_kw.setdefault("prefix_cache_mb", self.default_llm_prefix_cache_mb)
+        if self.default_llm_step_budget != "":
+            engine_kw.setdefault(
+                "step_token_budget", int(self.default_llm_step_budget)
+            )
+        if self.default_llm_prefill_chunk != "":
+            engine_kw.setdefault(
+                "prefill_chunk", int(self.default_llm_prefill_chunk)
+            )
         engine_kw.setdefault("kv_label", name)  # metric-series label
         engine_kw.setdefault("tracer", self.tracer)  # lifecycle spans
         if not hasattr(self, "_llms"):

@@ -38,7 +38,7 @@ end-to-end, prefix caching — VERDICT r5 levers #1 and #9).
 from __future__ import annotations
 
 import threading
-from collections import OrderedDict
+from collections import Counter, OrderedDict
 from typing import Any
 
 import numpy as np
@@ -115,9 +115,15 @@ class PrefixCache:
         self.metrics = metrics
         self.model = model
         self._entries: OrderedDict[bytes, _Entry] = OrderedDict()
+        # distinct stored lengths, refcounted — lookup_longest probes per
+        # DISTINCT length, and rebuilding this set by scanning every
+        # entry would put an O(entries) walk on the scheduler thread for
+        # each exact-miss admission
+        self._lengths: Counter[int] = Counter()
         self._lock = threading.Lock()
         self.hits = 0
         self.misses = 0
+        self.partial_hits = 0  # prefix-of-prompt hits (lookup_longest)
         self.evictions = 0
         self.stores = 0
         self.resident_bytes = 0
@@ -156,6 +162,50 @@ class PrefixCache:
         self._count("hit")
         return e
 
+    def lookup_longest(
+        self, tokens, *, allow_partial: bool = True
+    ) -> tuple["_Entry | None", bool]:
+        """(entry, exact) for the longest stored prompt that PREFIXES
+        `tokens` — the chunked-prefill seam: an exact hit (exact=True)
+        skips prefill entirely (stored last-token logits included); a
+        partial hit returns a shorter prompt's entry whose KV rows seed
+        the slot mid-prompt, so the engine's prefill cursor starts at
+        entry.length instead of 0 and only the unshared chunks run.
+        allow_partial=False restricts to the exact probe — callers that
+        cannot consume a partial (rolling-layout engines, whose ring rows
+        are laid out for the entry's own final length) must not pin
+        entries, bump their LRU position, or count partial hits they
+        will immediately discard.
+
+        Works on the key bytes alone: key_for is the int32 token bytes,
+        so the key of tokens[:L] is key[:4L] — one dict probe per
+        DISTINCT stored prompt length (a handful), longest first. The
+        full-prompt miss is counted exactly as lookup() counts it;
+        partial hits land in their own counter so hit-rate math stays
+        exact-hit-only."""
+        key = self.key_for(tokens)
+        e = self.lookup(key)  # counts the exact hit/miss
+        if e is not None:
+            return e, True
+        if not allow_partial:
+            return None, False
+        n = len(key) // 4
+        with self._lock:
+            lengths = sorted(
+                (ln for ln in self._lengths if ln < n), reverse=True
+            )
+        for length in lengths:
+            with self._lock:
+                e = self._entries.get(key[: 4 * length])
+                if e is None:
+                    continue
+                self._entries.move_to_end(e.key)
+                e.refs += 1
+                self.partial_hits += 1
+            self._count("partial_hit")
+            return e, False
+        return None, False
+
     def release(self, entry: _Entry) -> None:
         with self._lock:
             entry.refs -= 1
@@ -168,6 +218,7 @@ class PrefixCache:
             if key in self._entries or nbytes > self.capacity_bytes:
                 return False
             self._entries[key] = _Entry(key, k, v, int(length), logits, nbytes)
+            self._lengths[int(length)] += 1
             self.resident_bytes += nbytes
             self.stores += 1
             evicted = 0
@@ -177,7 +228,11 @@ class PrefixCache:
                 )
                 if victim is None:  # everything pinned: over budget, wait
                     break
-                self.resident_bytes -= self._entries.pop(victim).nbytes
+                ve = self._entries.pop(victim)
+                self.resident_bytes -= ve.nbytes
+                self._lengths[ve.length] -= 1
+                if not self._lengths[ve.length]:
+                    del self._lengths[ve.length]
                 self.evictions += 1
                 evicted += 1
         self._count("store")
@@ -216,6 +271,7 @@ class PrefixCache:
     def clear(self) -> None:
         with self._lock:
             self._entries.clear()
+            self._lengths.clear()
             self.resident_bytes = 0
         self._gauge()
 
@@ -224,6 +280,7 @@ class PrefixCache:
             return {
                 "hits": self.hits,
                 "misses": self.misses,
+                "partial_hits": self.partial_hits,
                 "evictions": self.evictions,
                 "stores": self.stores,
                 "entries": len(self._entries),
@@ -237,14 +294,19 @@ class CacheManager:
 
     Layout decision (static, at engine build): a model with a sliding
     window smaller than the sequence budget gets a ROLLING slot cache of
-    capacity `window + decode_chunk` — the window itself plus one chunk of
-    merge slack, so an end-of-chunk merge only ever overwrites rows
-    already behind every window (models.transformer.decode_chunk). Global-
-    attention models (or window >= max_seq_len) keep the dense slab; the
-    engine code is identical either way, only shapes and masks differ.
+    capacity `window + max(decode_chunk, prefill_chunk)` — the window
+    itself plus one chunk of merge/append slack, so an end-of-chunk merge
+    (models.transformer.decode_chunk) or a chunked-prefill append
+    (models.transformer.prefill_append) only ever overwrites rows already
+    behind every window. Global-attention models (or window >=
+    max_seq_len) keep the dense slab; the engine code is identical either
+    way, only shapes and masks differ.
 
     `window=None` auto-adopts cfg.sliding_window; `window=0` forces the
-    dense layout (the A/B lever the equality tests use).
+    dense layout (the A/B lever the equality tests use). `prefill_chunk`
+    is the largest prefill-chunk shape the token-budget step scheduler
+    will append (0 under the monolithic wave path, where prefill rows
+    arrive ring-packed and never append in place).
     """
 
     def __init__(
@@ -255,6 +317,7 @@ class CacheManager:
         decode_chunk: int,
         *,
         window: int | None = None,
+        prefill_chunk: int = 0,
         prefix_cache_mb: float = 0.0,
         metrics=None,
         model: str = "llm",
@@ -271,8 +334,9 @@ class CacheManager:
                 f"{cfg.sliding_window} (attention masks use the config)"
             )
         self.window = int(w or 0)
-        self.rolling = 0 < self.window and self.window + decode_chunk < max_seq_len
-        self.capacity = self.window + decode_chunk if self.rolling else max_seq_len
+        slack = max(decode_chunk, int(prefill_chunk or 0))
+        self.rolling = 0 < self.window and self.window + slack < max_seq_len
+        self.capacity = self.window + slack if self.rolling else max_seq_len
         # static arg for decode_chunk/attention: ring capacity, 0 = dense
         self.ring = self.capacity if self.rolling else 0
         itemsize = jnp.dtype(cfg.dtype).itemsize

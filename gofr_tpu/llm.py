@@ -27,14 +27,30 @@ Design (all shapes static; a bounded set of compiled executables):
   engine keeps up to `lookahead` chunks in flight, chaining each chunk's
   input tokens from the previous chunk's on-device output so the device
   never waits for host readback.
-- **Admission without stalling decode.** Prefill waves dispatch
-  asynchronously BETWEEN decode chunks; the first sampled token is merged
-  into the on-device tail vector by a jitted scatter (no host round trip),
-  and prefilled KV rows are copied into free slots via ONE jitted
-  insert-many. Decode chunks already in flight keep streaming — their
-  tokens for a reused slot are dropped on host via per-slot generation
-  tags, never by draining the pipeline (the r2 engine's flush-before-admit
-  barrier cost 72% of raw decode throughput).
+- **Chunked prefill under a token budget (default).** Prompts are split
+  into fixed-shape prefill chunks (TPU_LLM_PREFILL_CHUNK, default 64;
+  the configured prefill_buckets survive only as the available chunk
+  compile shapes) that append into the slot's KV cache incrementally via
+  a per-request `prefill_pos` cursor — a partial-prefill slot is
+  resident but not decoding. Each device step packs up to
+  TPU_LLM_STEP_TOKEN_BUDGET (default 256) tokens of pending prefill
+  chunks COALESCED with the active slots' decode chunk into one jitted
+  unified-step program, so no request ever waits behind more than one
+  bounded step (Sarathi-style chunked prefill + piggybacked decode; the
+  monolithic path held the chip for admit_cap x bucket tokens per wave
+  and starved decode — BENCH_r05's 1.46 SLO p99/p50 was that
+  head-of-line wait). A prompt whose PREFIX is already in the prefix
+  cache seeds `prefill_pos` mid-prompt and only the unshared chunks run.
+  step_token_budget=0 restores the monolithic wave path (the A/B lever
+  the equality tests drive).
+- **Admission without stalling decode.** Monolithic-path prefill waves
+  dispatch asynchronously BETWEEN decode chunks; the first sampled token
+  is merged into the on-device tail vector by a jitted scatter (no host
+  round trip), and prefilled KV rows are copied into free slots via ONE
+  jitted insert-many. Decode chunks already in flight keep streaming —
+  their tokens for a reused slot are dropped on host via per-slot
+  generation tags, never by draining the pipeline (the r2 engine's
+  flush-before-admit barrier cost 72% of raw decode throughput).
 - **On-device sampling.** Greedy or temperature sampling happens inside the
   chunk; the host syncs one [K, S] int32 array per chunk (started with
   copy_to_host_async at dispatch) instead of logits.
@@ -104,11 +120,30 @@ def _register_phase_metrics(metrics) -> None:
         ):
             if not metrics.has(name):
                 metrics.new_histogram(name, desc, TPU_BUCKETS)
+        if not metrics.has("app_llm_step_seconds"):
+            # unified-step dispatch->fetch wall time (chunked scheduler)
+            metrics.new_histogram(
+                "app_llm_step_seconds",
+                "llm unified step dispatch->fetch s (prefill chunks + "
+                "piggybacked decode)", TPU_BUCKETS,
+            )
+        if not metrics.has("app_llm_step_tokens"):
+            metrics.new_histogram(
+                "app_llm_step_tokens",
+                "llm tokens packed per unified step (prefill chunk tokens "
+                "+ decode steps x active slots)",
+                (8.0, 16.0, 32.0, 64.0, 128.0, 256.0, 512.0, 1024.0,
+                 2048.0, 4096.0, 8192.0),
+            )
         for name, desc in (
             ("app_llm_slots_in_use", "llm decode slots holding a live request"),
             ("app_llm_queue_depth", "llm requests waiting for a slot"),
             ("app_llm_admission_backlog",
              "llm requests mid-admission (pulled from queue, not yet slotted)"),
+            ("app_llm_step_budget_utilization",
+             "tokens packed into the last unified step / step token "
+             "budget (can exceed 1: decode always rides and a step "
+             "always carries at least one chunk)"),
             ("app_llm_mfu",
              "model FLOPs utilization 0..1 per phase (analytic FLOPs / "
              "measured wall / device peak)"),
@@ -155,6 +190,13 @@ class GenRequest:
         self.capped = False  # engine reduced max_new_tokens to fit the cache
         self.finish_reason: str | None = None  # "eos" | "length" | "cancelled"
         self.submitted_at: float | None = None
+        # -- chunked-prefill scheduler state (engine-maintained) --
+        self.prefill_pos = 0  # prompt tokens already appended to slot KV
+        self.prefill_done = False  # all prompt tokens resident; decoding
+        self.slot: int | None = None  # slot index while resident
+        self._rows_hi = 0  # highest slot row ever written (prefix trim)
+        self._prefill_t0: float | None = None  # first chunk dispatch time
+        self._load_acct = 0  # outstanding token estimate (router weighting)
         # -- observability (engine-maintained; read by debug/stats/traces) --
         self.phase = "new"  # new -> queued -> prefill -> decode -> done
         self.prefix_hit = False
@@ -208,6 +250,8 @@ class LLMEngine:
         max_seq_len: int = 512,
         prefill_buckets: tuple[int, ...] = (16, 64, 128),
         decode_chunk: int = 8,
+        prefill_chunk: int | None = None,
+        step_token_budget: int | None = None,
         lookahead: int = 3,
         admit_cap: int = 8,
         admit_delay_ms: float = 40.0,
@@ -267,6 +311,32 @@ class LLMEngine:
         self.lookahead = max(1, lookahead)
         self.admit_cap = min(admit_cap, slots)
         self.admit_delay = admit_delay_ms / 1000.0
+        # -- token-budget step scheduler (chunked prefill) ----------------
+        # step_token_budget bounds the TOTAL tokens packed into one device
+        # step: the active slots' decode chunk is charged first (decode
+        # always rides — it is the latency-critical work the budget
+        # exists to protect) and prefill chunks coalesce into whatever
+        # remains, floored at one chunk so a step always makes progress;
+        # 0 restores the monolithic wave scheduler. prefill_chunk caps
+        # the chunk compile shape; the configured buckets survive only as
+        # the available chunk shapes, so short prompts keep their tight
+        # compile shapes.
+        import os as _os
+
+        if step_token_budget is None:
+            step_token_budget = int(
+                _os.environ.get("TPU_LLM_STEP_TOKEN_BUDGET", "256")
+            )
+        if prefill_chunk is None:
+            prefill_chunk = int(_os.environ.get("TPU_LLM_PREFILL_CHUNK", "64"))
+        self.prefill_chunk = max(1, int(prefill_chunk))
+        self.step_token_budget = max(0, int(step_token_budget))
+        self.chunked = self.step_token_budget > 0
+        shapes = {min(b, self.prefill_chunk) for b in self.prefill_buckets}
+        shapes.discard(0)
+        self.chunk_shapes = tuple(sorted(shapes)) or (
+            min(self.prefill_chunk, max_seq_len),
+        )
         # SLO-aware overload control (both optional, both mutable at
         # runtime): max_queue bounds requests waiting for a slot — beyond
         # it submit() raises EngineOverloaded (-> 429) instead of letting
@@ -296,6 +366,8 @@ class LLMEngine:
             "ttft": RollingWindow(),
             "time_per_output_token": RollingWindow(),
             "decode_step": RollingWindow(),
+            # unified-step dispatch->fetch wall (chunked scheduler only)
+            "step": RollingWindow(),
         }
         # MFU/roofline accounting: analytic model FLOPs computed ONCE from
         # the architecture (gofr_tpu.profiling.mfu), combined per prefill
@@ -326,6 +398,7 @@ class LLMEngine:
         self.kv = CacheManager(
             cfg, slots, max_seq_len, decode_chunk,
             window=kv_window, prefix_cache_mb=prefix_cache_mb,
+            prefill_chunk=max(self.chunk_shapes) if self.chunked else 0,
             metrics=metrics, model=kv_label,
         )
         self._sharded = mesh is not None and param_specs is not None
@@ -472,6 +545,92 @@ class LLMEngine:
             )
             if keep_logits else None
         )
+
+        # -- unified step programs (token-budget scheduler) ---------------
+        # ONE jitted program per chunk shape: gather the prefilling
+        # slots' KV rows, append one chunk per row
+        # (models.transformer.prefill_append), scatter the rows back,
+        # activate rows whose prompt just completed (their first token
+        # sampled from the chunk's last-token logits, merged into the
+        # on-device tail — no host round trip), then, in the SAME
+        # program, advance every active slot one decode chunk. Decode is
+        # ALWAYS fused — rows that finish this step decode immediately
+        # (no extra dispatch for the first chunk), and an all-inactive
+        # decode part costs one bounded masked chunk during cold prefill
+        # ramp only. Executable count: shapes x pow2-widths — it replaces
+        # the monolithic path's buckets x widths prefill family plus its
+        # separate insert/admit programs on the miss path.
+        from .models.transformer import prefill_append
+
+        _slots_oob = slots  # out-of-range slot index: scatters are dropped
+
+        def _make_step_op(shape: int):
+            K = decode_chunk
+
+            def _step(params, cache, tail, active, temps, pack, meta, rng):
+                """pack [nb, shape+3] int32: tokens | cursor | n_new |
+                temp-bits. meta [2, nb] int32: slot (= `slots` for inert
+                padding lanes) | finish flag. One packed h2d per step."""
+                tokens = pack[:, :shape]
+                cursors = pack[:, shape]
+                n_new = pack[:, shape + 1]
+                req_temps = jax.lax.bitcast_convert_type(
+                    pack[:, shape + 2], jnp.float32
+                )
+                slot_idx, finish = meta[0], meta[1]
+                # gather the target slots' resident rows (padding lanes
+                # clip to a real slot but never write back)
+                sub = cache._replace(
+                    k=jnp.take(cache.k, slot_idx, axis=1, mode="clip"),
+                    v=jnp.take(cache.v, slot_idx, axis=1, mode="clip"),
+                    length=cursors,
+                )
+                logits, sub = prefill_append(
+                    params, cfg, tokens, sub, cursors, n_new,
+                    ring=self.kv.ring,
+                )
+                cache = cache._replace(
+                    k=cache.k.at[:, slot_idx].set(sub.k, mode="drop"),
+                    v=cache.v.at[:, slot_idx].set(sub.v, mode="drop"),
+                    length=cache.length.at[slot_idx].set(
+                        cursors + n_new, mode="drop"
+                    ),
+                )
+                rng, sub_rng = jax.random.split(rng)
+                first = _sample(logits, req_temps, sub_rng)
+                fin_slot = jnp.where(finish == 1, slot_idx, _slots_oob)
+                # Mid-prefill rows must deactivate their slot: the device
+                # flag may still be True from the slot's PREVIOUS occupant
+                # (nothing clears it at finish), and the decode merge
+                # advances length for active slots — on a rolling ring the
+                # stale advance between two appends can wrap past the
+                # capacity slack and overwrite this prompt's in-window
+                # rows. (Writes BEFORE the first chunk are harmless: the
+                # first append resets length, and rows beyond it are
+                # position-masked.) Disjoint from fin_slot — a pack row
+                # either finishes or not.
+                mid_slot = jnp.where(finish == 1, _slots_oob, slot_idx)
+                active = active.at[mid_slot].set(False, mode="drop")
+                tail = tail.at[fin_slot].set(first, mode="drop")
+                active = active.at[fin_slot].set(True, mode="drop")
+                temps = temps.at[fin_slot].set(req_temps, mode="drop")
+                kept = logits if keep_logits else None
+                toks, last, cache, rng = chunk_fn(
+                    params, cfg, tail, cache, active, temps, rng,
+                    n_steps=K, sample_fn=_sample, ring=self.kv.ring,
+                )
+                return first, kept, toks, last, cache, active, temps, rng
+
+            name = f"llm.step_p{shape}_d{K}"
+            return instrument_jit(
+                name, _step, model=self.label, metrics=metrics,
+                donate_argnums=(1, 2, 3, 4),
+            )
+
+        self._step_ops: dict[int, Any] = {}
+        if self.chunked:
+            for shape in self.chunk_shapes:
+                self._step_ops[shape] = _make_step_op(shape)
         self._rng = jax.random.PRNGKey(0)
 
         self.cache = self.kv.init_cache(slots)
@@ -500,6 +659,10 @@ class LLMEngine:
         self._stat_active_sum = 0  # sum of active slots at chunk dispatch
         self._stat_waves: dict[int, int] = {}  # prefill wave width -> count
         self._stat_wave_reqs = 0  # requests admitted via waves
+        self._stat_steps = 0  # unified steps dispatched (chunked scheduler)
+        self._stat_step_tokens = 0  # tokens packed into unified steps
+        self._prefilling: deque[GenRequest] = deque()  # resident, not decoding
+        self._load_tokens = 0  # outstanding token estimate (router weighting)
         self._last_submit_t: float | None = None
         self._ema_gap: float | None = None  # EMA inter-arrival (rate estimate)
         self._stop = False
@@ -592,6 +755,11 @@ class LLMEngine:
             )
         self.submitted += 1  # routing/diagnostic counter (GIL-atomic enough)
         with self._lock:
+            # outstanding-token estimate for the replica router: prompt
+            # remainder + expected decode, credited back as chunks append
+            # and tokens emit (load_tokens())
+            req._load_acct = plen + req.max_new_tokens
+            self._load_tokens += req._load_acct
             # EMA update under the lock: concurrent submitters racing the
             # read-modify-write could blend NEGATIVE gaps into the estimate
             # and spuriously hold low-rate traffic for admit_delay
@@ -634,6 +802,14 @@ class LLMEngine:
                 ),
                 "prefill_waves": dict(sorted(self._stat_waves.items())),
                 "wave_reqs": self._stat_wave_reqs,
+                # token-budget step scheduler telemetry
+                "scheduler": "chunked" if self.chunked else "wave",
+                "steps": self._stat_steps,
+                "step_tokens": self._stat_step_tokens,
+                "step_token_budget": self.step_token_budget,
+                "chunk_shapes": list(self.chunk_shapes),
+                "prefilling": len(self._prefilling),
+                "load_tokens": self.load_tokens(),
                 "rejected": self.rejected,
                 "shed": self.shed,
                 "kvcache": self.kv.stats(),
@@ -659,6 +835,7 @@ class LLMEngine:
                 "id": r.id,
                 "phase": r.phase,
                 "prompt_tokens": len(r.prompt_tokens),
+                "prefill_pos": r.prefill_pos,
                 "emitted": r.emitted,
                 "max_new_tokens": r.max_new_tokens,
                 "age_ms": (
@@ -690,6 +867,16 @@ class LLMEngine:
                         "bucket": e[3]["bucket"],
                         "age_ms": round((now - e[3]["t0"]) * 1e3, 1),
                     })
+                elif e[0] == "step":
+                    inflight.append({
+                        "kind": "step",
+                        "chunk_shape": e[6]["shape"],
+                        "prefill_tokens": e[6]["prefill_tokens"],
+                        "finishing": [r.id for _j, _s, r in e[2]],
+                        "decode_steps": e[5],
+                        "active": e[6]["active"],
+                        "age_ms": round((now - e[6]["t0"]) * 1e3, 1),
+                    })
                 else:
                     inflight.append({
                         "kind": "chunk",
@@ -707,6 +894,10 @@ class LLMEngine:
             "active": sum(row is not None for row in slot_table),
             "max_seq_len": self.max_seq_len,
             "decode_chunk": self.decode_chunk,
+            "scheduler": "chunked" if self.chunked else "wave",
+            "step_token_budget": self.step_token_budget,
+            "chunk_shapes": list(self.chunk_shapes),
+            "prefilling": len(self._prefilling),
             "slot_table": slot_table,
             "inflight": inflight,
             "waiting_total": waiting_total,
@@ -736,6 +927,25 @@ class LLMEngine:
             + self._admitting
         )
 
+    def load_tokens(self) -> int:
+        """Token-weighted routing signal: the estimated device work still
+        owed to every live request — prompt remainder plus expected decode
+        — maintained as a counter (submit adds prompt + max_new; prefill
+        chunks and emitted tokens credit it back; terminal paths flush the
+        residue). A 128-token prompt weighs 16x an 8-token prompt here
+        where load() weighs them identically, which is what the replica
+        router actually needs to balance. Lock-free read of a single int
+        (torn reads cost at most one stale request)."""
+        return max(0, self._load_tokens)
+
+    def _load_credit(self, r: GenRequest, n: int) -> None:
+        """Retire `n` tokens of r's outstanding-work estimate (bounded by
+        what it still owes). Call with the lock held."""
+        n = min(n, r._load_acct)
+        if n > 0:
+            r._load_acct -= n
+            self._load_tokens -= n
+
     def alive(self) -> bool:
         """Health signal for the replica router: the engine accepts work
         only while both its threads run and neither close() nor a terminal
@@ -757,6 +967,7 @@ class LLMEngine:
             "app_llm_slots_in_use",
             "app_llm_queue_depth",
             "app_llm_admission_backlog",
+            "app_llm_step_budget_utilization",
         ):
             self.metrics.set_gauge(name, 0.0, model=self.label)
 
@@ -854,12 +1065,19 @@ class LLMEngine:
         nbs.append(self.admit_cap)
 
         def warm_cache_ops():
-            """insert + admit_update at every admission width, then the
-            decode chunk — CHAINED through the real slot cache by
-            donation, exactly like live serving, so warm's peak memory
-            never holds a second full-size cache and no two ops donate
-            the same buffer."""
+            """insert + admit_update at every admission width, the
+            unified-step programs at every (chunk shape, width,
+            piggyback) combination, then the decode chunk — CHAINED
+            through the real slot cache by donation, exactly like live
+            serving, so warm's peak memory never holds a second full-size
+            cache and no two ops donate the same buffer. (The chain also
+            serializes the step-program compiles; the wave path's
+            prefill-family overlap does not apply here and the cost lands
+            in warmup_s.)"""
             cache = self.cache
+            tail = jnp.zeros((self.slots,), jnp.int32)
+            active = jnp.zeros((self.slots,), bool)
+            temps = jnp.zeros((self.slots,), jnp.float32)
             for nb in nbs:
                 scratch = self.kv.init_cache(nb)
                 cache = self._insert_many(cache, scratch, meta)
@@ -869,16 +1087,29 @@ class LLMEngine:
                     jnp.zeros((self.slots,), jnp.float32),
                     jnp.zeros((nb,), jnp.int32), meta,
                 )
+            for shape, op in sorted(self._step_ops.items()):
+                for nb in nbs:
+                    pack = jnp.zeros((nb, shape + 3), jnp.int32)
+                    smeta = jnp.full((2, nb), self.slots, jnp.int32).at[1].set(0)
+                    _f, _kept, _toks, tail, cache, active, temps, _ = op(
+                        self.params, cache, tail, active, temps,
+                        pack, smeta, zero_rng,
+                    )
             for op in self._chunk_ops.values():
                 toks, last, cache, _ = op(
-                    self.params,
-                    jnp.zeros((self.slots,), jnp.int32), cache,
-                    jnp.zeros((self.slots,), bool),
-                    jnp.zeros((self.slots,), jnp.float32), zero_rng,
+                    self.params, tail, cache, active, temps, zero_rng,
                 )
             return last, cache
 
-        n_tasks = len(self.prefill_buckets) * len(nbs) + 1
+        n_step_tasks = len(self._step_ops) * len(nbs)
+        if self.chunked:
+            # chunked mode: the monolithic prefill family exists (bench
+            # probes and the A/B lever call it) but is compiled lazily —
+            # warming it would double the cold-start bill for programs
+            # live traffic never dispatches
+            n_tasks = 1 + n_step_tasks
+        else:
+            n_tasks = len(self.prefill_buckets) * len(nbs) + 1
         if self._hit_first_op is not None:
             n_tasks += len(nbs)
         # Sharded programs on the CPU backend (8-virtual-device test mesh)
@@ -893,9 +1124,10 @@ class LLMEngine:
         )
         with ThreadPoolExecutor(max_workers=workers) as pool:
             futs = [pool.submit(warm_cache_ops)]
-            for b in self.prefill_buckets:
-                for nb in nbs:
-                    futs.append(pool.submit(warm_prefill, nb, b))
+            if not self.chunked:
+                for b in self.prefill_buckets:
+                    for nb in nbs:
+                        futs.append(pool.submit(warm_prefill, nb, b))
             if self._hit_first_op is not None:
                 for nb in nbs:
                     futs.append(pool.submit(warm_hit_first, nb))
@@ -913,10 +1145,15 @@ class LLMEngine:
         self.warmup_s = time.perf_counter() - t0
         self._registry.record_warmup(self.label, self.warmup_s, programs=n_tasks)
         if self.logger is not None:
+            sched = (
+                f"chunk shapes {self.chunk_shapes}, "
+                f"step budget {self.step_token_budget}"
+                if self.chunked else f"buckets {self.prefill_buckets}"
+            )
             self.logger.info(
                 f"LLM engine warmed in {self.warmup_s:.1f}s "
-                f"(buckets {self.prefill_buckets}, slots {self.slots}, "
-                f"chunk {self.decode_chunk})"
+                f"({sched}, slots {self.slots}, "
+                f"decode chunk {self.decode_chunk})"
             )
 
     def _bucket_for(self, n: int) -> int:
@@ -952,6 +1189,18 @@ class LLMEngine:
                 for slot, r in e[2]:
                     if r is not None and r is self._slot_req[slot]:
                         steps[slot] = steps.get(slot, 0) + 1
+                continue
+            if e[0] == "step":
+                # unified step: each finishing row carries its first token,
+                # and the piggybacked decode part carries k per snapshot slot
+                _, _first, finishes, _toks, snapshot, k, _info = e
+                for _j, slot, r in finishes:
+                    if r is self._slot_req[slot]:
+                        steps[slot] = steps.get(slot, 0) + 1
+                if k and snapshot is not None:
+                    for slot, r in enumerate(snapshot):
+                        if r is not None and r is self._slot_req[slot]:
+                            steps[slot] = steps.get(slot, 0) + k
                 continue
             snapshot, k = e[2], e[3]
             for slot, r in enumerate(snapshot):
@@ -989,30 +1238,21 @@ class LLMEngine:
         steps = self._inflight_steps()
         worst = 0
         for i, r in enumerate(self._slot_req):
-            if r is None or r.cancelled:
+            if r is None or r.cancelled or not r.prefill_done:
+                # a partial-prefill slot is resident but not decoding: its
+                # demand starts when its last chunk activates it (counting
+                # it here would dispatch decode chunks that only advance
+                # garbage for it)
                 continue
             remaining = r.max_new_tokens - r.emitted - steps.get(i, 0)
             if remaining > worst:
                 worst = remaining
         return worst
 
-    def _admit(self) -> bool:
-        """Pull waiting requests into (virtually) free slots, prefilling
-        per bucket. Purely dispatch-side: decode chunks in flight are
-        untouched, and the first sampled tokens merge into the device tail
-        without a host round trip.
-
-        Admission BATCHING: a prefill wave costs roughly the same device
-        time at nb=1 as at nb=admit_cap, so firing a wave per trickle
-        arrival melts throughput at mid load (measured open-loop: 200 QPS
-        offered -> 138 achieved). While decode is active and a partial
-        wave's oldest request is younger than admit_delay, hold admission
-        to let the wave fill; an idle device admits immediately."""
-        jnp = self._jnp
-        with self._lock:
-            free = self._free_slots()
-            busy = self._any_active() or self._inflight or self._processing is not None
-        # drain the submit queue into the internal waiting list
+    def _drain_and_observe(self, busy: bool) -> None:
+        """Shared admission head (wave and chunked schedulers): drain the
+        submit queue into the waiting list, shed requests past their TTFT
+        deadline, flush queue-side terminations, refresh the state gauges."""
         while True:
             try:
                 block = not busy and not self._waiting
@@ -1067,6 +1307,31 @@ class LLMEngine:
                 "app_llm_admission_backlog", float(self._admitting),
                 model=self.label,
             )
+
+    def _admit(self) -> bool:
+        """Admission entry, called once per scheduler pass (THE seam:
+        tests wedge it to freeze admission). Dispatches to the
+        token-budget scheduler's immediate slot assignment or the
+        monolithic path's wave batching."""
+        return self._admit_chunked() if self.chunked else self._admit_wave()
+
+    def _admit_wave(self) -> bool:
+        """Pull waiting requests into (virtually) free slots, prefilling
+        per bucket. Purely dispatch-side: decode chunks in flight are
+        untouched, and the first sampled tokens merge into the device tail
+        without a host round trip.
+
+        Admission BATCHING: a prefill wave costs roughly the same device
+        time at nb=1 as at nb=admit_cap, so firing a wave per trickle
+        arrival melts throughput at mid load (measured open-loop: 200 QPS
+        offered -> 138 achieved). While decode is active and a partial
+        wave's oldest request is younger than admit_delay, hold admission
+        to let the wave fill; an idle device admits immediately."""
+        jnp = self._jnp
+        with self._lock:
+            free = self._free_slots()
+            busy = self._any_active() or self._inflight or self._processing is not None
+        self._drain_and_observe(busy)
         if not self._waiting or not free:
             return False
         # Rate-gated wave-fill hold: a prefill wave costs device time that
@@ -1107,22 +1372,20 @@ class LLMEngine:
                 e = self.kv.prefix.lookup(self.kv.prefix.key_for(r.prompt_tokens))
                 (misses.append(r) if e is None else hits.append((r, e)))
         try:
-            for i in range(0, len(hits), self.admit_cap):
-                group = hits[i : i + self.admit_cap]
-                reqs = [r for r, _ in group]
-                nb = self._wave_width(len(reqs))
-                t0 = time.perf_counter()
-                new_cache, logits = self.kv.prefix.assemble(
-                    [e for _, e in group], nb, self.kv.capacity
-                )
-                temps = np.zeros((nb,), np.float32)
-                temps[: len(reqs)] = [r.temperature for r in reqs]
-                first_dev, self._rng = self._hit_first_op(
-                    logits, jnp.asarray(temps), self._rng
-                )
-                for r in reqs:
-                    r.prefix_hit = True
-                self._slot_in(reqs, first_dev, new_cache, free, wave_t0=t0)
+            return self._admit_waves(hits, misses, free)
+        except BaseException:
+            self._requeue_stranded(pulled)
+            raise
+
+    def _admit_waves(
+        self,
+        hits: list[tuple[GenRequest, Any]],
+        misses: list[GenRequest],
+        free: list[int],
+    ) -> bool:
+        jnp = self._jnp
+        try:
+            self._admit_exact_hits(hits, free)
         finally:
             # unpin EVERY looked-up entry in all paths — including the
             # groups never reached when an earlier group's device call
@@ -1178,6 +1441,182 @@ class LLMEngine:
             )
         return True
 
+    def _admit_exact_hits(
+        self, hits: list[tuple[GenRequest, Any]], free: list[int]
+    ) -> None:
+        """Dispatch exact prefix-cache hits (both schedulers share this):
+        per admit_cap group, assemble the pinned entries' rows into one
+        insert wave, re-sample each request's first token from the stored
+        last-token logits at its own temperature, and slot the group in.
+        Callers own the pins — their finally releases EVERY looked-up
+        entry, including groups never reached when a device call escapes
+        to the scheduler's recovery."""
+        jnp = self._jnp
+        for i in range(0, len(hits), self.admit_cap):
+            group = hits[i : i + self.admit_cap]
+            reqs = [r for r, _ in group]
+            nb = self._wave_width(len(reqs))
+            t0 = time.perf_counter()
+            new_cache, logits = self.kv.prefix.assemble(
+                [e for _, e in group], nb, self.kv.capacity
+            )
+            temps = np.zeros((nb,), np.float32)
+            temps[: len(reqs)] = [r.temperature for r in reqs]
+            first_dev, self._rng = self._hit_first_op(
+                logits, jnp.asarray(temps), self._rng
+            )
+            for r in reqs:
+                r.prefix_hit = True
+            self._slot_in(reqs, first_dev, new_cache, free, wave_t0=t0)
+
+    def _requeue_stranded(self, pulled: list[GenRequest]) -> None:
+        """An escaping admission error strands requests already sliced out
+        of _waiting but never slotted: they appear in no in-flight entry
+        and own no slot, so _recover_all/_close_unreachable walk right
+        past them and their consumers would hang until the stream timeout.
+        Put exactly those back at the head of _waiting — recovery leaves
+        the queue intact, so the next scheduler pass retries them (and
+        _die's drain closes them if the engine is lost). Slotted members
+        of a failed group stay out: _abort_all reaches them via the slot
+        table."""
+        with self._lock:
+            stranded = [
+                r for r in pulled
+                if r.finish_reason is None
+                and (r.slot is None or self._slot_req[r.slot] is not r)
+            ]
+            self._waiting = stranded + self._waiting
+            self._admitting -= len(stranded)
+
+    def _observe_admission(self, r: GenRequest, now: float) -> None:
+        """queue_wait closes at admission (slot assigned, KV en route)."""
+        r.admitted_at = now
+        r.phase = "prefill"
+        if r.submitted_at is not None:
+            wait = now - r.submitted_at
+            self._phases["queue_wait"].observe(wait)
+            if self.metrics is not None:
+                self.metrics.record_histogram(
+                    "app_llm_queue_wait_seconds", wait, model=self.label
+                )
+            self._phase_span(r, "llm.queue_wait", r.submitted_at, now)
+
+    def _assign_slot(self, r: GenRequest, slot: int, now: float) -> None:
+        """Make r the slot's occupant (call with the lock held). A
+        cancelled previous occupant may have no in-flight snapshot left
+        to deliver its end-of-stream — close it here (same contract as
+        the wave path's _slot_in)."""
+        old = self._slot_req[slot]
+        if old is not None and old.cancelled and old.finish_reason is None:
+            old.finish_reason = "cancelled"
+            self._observe_finish(old, now)
+            old.out.put(None)
+        self._slot_req[slot] = r
+        r.slot = slot
+
+    def _admit_chunked(self) -> bool:
+        """Chunked-scheduler admission: assign waiting requests to
+        (virtually) free slots IMMEDIATELY — no wave-fill hold, because
+        per-step packing replaces wave batching — and classify each
+        against the prefix cache: an exact hit skips prefill entirely
+        (stored last-token logits, the wave path's machinery); a partial
+        hit seeds the slot's KV with the shared prefix and starts the
+        prefill cursor mid-prompt; a miss starts at 0. Misses and
+        partials do no prefill compute here — their chunks are packed
+        into unified steps by _dispatch_step."""
+        jnp = self._jnp
+        with self._lock:
+            free = self._free_slots()
+            busy = (
+                self._any_active() or bool(self._prefilling)
+                or bool(self._inflight) or self._processing is not None
+            )
+        self._drain_and_observe(busy)
+        if not self._waiting or not free:
+            return False
+        pulled = self._waiting[: len(free)]
+        self._waiting = self._waiting[len(free):]
+        self._admitting += len(pulled)
+        hits: list[tuple[GenRequest, Any]] = []
+        partials: list[tuple[GenRequest, Any]] = []
+        rest: list[GenRequest] = pulled
+        if self.kv.prefix is not None:
+            rest = []
+            for r in pulled:
+                # mid-prompt seeding is a dense-layout move: a rolling
+                # entry's ring rows are laid out for ITS final length and
+                # cannot serve a shorter prefix — the cache skips the
+                # partial probe entirely (no pin/LRU-bump/counter for
+                # hits we would discard)
+                e, exact = self.kv.prefix.lookup_longest(
+                    r.prompt_tokens, allow_partial=not self.kv.rolling
+                )
+                if e is None:
+                    rest.append(r)
+                elif exact:
+                    hits.append((r, e))
+                else:
+                    partials.append((r, e))
+        try:
+            # exact hits ride the wave path's machinery unchanged: stored
+            # logits -> first token, rows -> insert_many, slot activated
+            self._admit_exact_hits(hits, free)
+            # partial hits: one insert wave seeds the shared rows, the
+            # cursor starts at the entry's length, remaining chunks run
+            now = time.perf_counter()
+            for i in range(0, len(partials), self.admit_cap):
+                group = partials[i : i + self.admit_cap]
+                nb = self._wave_width(len(group))
+                new_cache, _logits = self.kv.prefix.assemble(
+                    [e for _, e in group], nb, self.kv.capacity
+                )
+                with self._work_cv:
+                    meta = np.zeros((3, self.admit_cap), np.int32)
+                    for j, (r, e) in enumerate(group):
+                        slot = free.pop(0)
+                        self._assign_slot(r, slot, now)
+                        r.prefix_hit = True
+                        r.prefill_pos = e.length
+                        r._rows_hi = e.length
+                        self._load_credit(r, e.length)
+                        meta[0, j], meta[1, j] = slot, j
+                    for j in range(len(group), self.admit_cap):
+                        meta[:, j] = meta[:, 0]
+                    self.cache = self._insert_many(
+                        self.cache, new_cache, jnp.asarray(meta)
+                    )
+                    for r, _e in group:
+                        self._observe_admission(r, now)
+                        self._prefilling.append(r)
+                    self._admitting -= len(group)
+        except BaseException:
+            # pulled-but-unslotted requests (later groups, the whole miss
+            # list) are otherwise unreachable from recovery — see
+            # _requeue_stranded
+            self._requeue_stranded(pulled)
+            raise
+        finally:
+            # unpin EVERY looked-up entry in all paths — including groups
+            # never reached when an earlier group's device call escapes to
+            # the scheduler's recovery. A pin that never drops makes its
+            # entry uneviction-able forever.
+            for _r, e in hits:
+                self.kv.prefix.release(e)
+            for _r, e in partials:
+                self.kv.prefix.release(e)
+        # misses: slot residency only; chunks flow through unified steps
+        if rest:
+            now = time.perf_counter()
+            with self._work_cv:
+                for r in rest:
+                    slot = free.pop(0)
+                    self._assign_slot(r, slot, now)
+                    self._observe_admission(r, now)
+                    self._prefilling.append(r)
+                self._admitting -= len(rest)
+        self._kick.set()
+        return True
+
     def _slot_in(
         self,
         reqs: list[GenRequest],
@@ -1197,17 +1636,7 @@ class LLMEngine:
         jnp = self._jnp
         now = time.perf_counter()
         for r in reqs:
-            # queue_wait closes at admission (slot assigned, KV en route)
-            r.admitted_at = now
-            r.phase = "prefill"
-            if r.submitted_at is not None:
-                wait = now - r.submitted_at
-                self._phases["queue_wait"].observe(wait)
-                if self.metrics is not None:
-                    self.metrics.record_histogram(
-                        "app_llm_queue_wait_seconds", wait, model=self.label
-                    )
-                self._phase_span(r, "llm.queue_wait", r.submitted_at, now)
+            self._observe_admission(r, now)
         info = {
             "t0": wave_t0 if wave_t0 is not None else now,
             "nb": wave_nb or 0,
@@ -1218,15 +1647,12 @@ class LLMEngine:
             taken: list[tuple[int, GenRequest]] = []
             for j, r in enumerate(reqs):
                 slot = free.pop(0)
-                old = self._slot_req[slot]
-                if old is not None and old.cancelled and old.finish_reason is None:
-                    # a cancelled occupant may have no in-flight snapshot
-                    # left to deliver its end-of-stream — close it here
-                    old.finish_reason = "cancelled"
-                    self._observe_finish(old, now)
-                    old.out.put(None)
+                self._assign_slot(r, slot, now)
                 taken.append((slot, r))
-                self._slot_req[slot] = r
+                # wave admission covers the whole prompt in one dispatch
+                r.prefill_pos = len(r.prompt_tokens)
+                r.prefill_done = True
+                self._load_credit(r, len(r.prompt_tokens))
                 meta[0, j], meta[1, j] = slot, j
                 meta[2, j] = np.float32(r.temperature).view(np.int32)
             # pad entries duplicate entry 0 (idempotent)
@@ -1364,6 +1790,9 @@ class LLMEngine:
 
     def _observe_finish_locked(self, r: GenRequest, now: float, fetch_t: float | None) -> None:
         r.phase = "done"
+        # flush the outstanding-work residue (cancel/shed/eos leave some)
+        self._load_tokens -= r._load_acct
+        r._load_acct = 0
         total = None if r.submitted_at is None else now - r.submitted_at
         queue_wait = (
             None if r.admitted_at is None or r.submitted_at is None
@@ -1455,6 +1884,7 @@ class LLMEngine:
                         )
             r.out.put(toks)
             r.emitted += len(toks)
+            self._load_credit(r, len(toks))
         if finish is None and r.emitted >= r.max_new_tokens:
             finish = "length"
         if finish is not None:
@@ -1482,7 +1912,14 @@ class LLMEngine:
         queued chunk fetches. The saturated path is unchanged (full chunks
         either way)."""
         with self._work_cv:
-            snapshot = list(self._slot_req)
+            # partial-prefill occupants are resident but NOT decoding:
+            # the chunk's tokens for their slots are garbage (device
+            # active mask is off), so they are snapshot-excluded exactly
+            # like free slots
+            snapshot = [
+                r if (r is not None and r.prefill_done) else None
+                for r in self._slot_req
+            ]
             active_n = sum(r is not None for r in snapshot)
             k = (
                 self._chunk_short
@@ -1502,9 +1939,176 @@ class LLMEngine:
             self._work_cv.notify()
             return k
 
+    def _chunk_shape_for(self, n: int) -> int:
+        """Compile shape for a chunk covering n pending tokens: the
+        smallest available shape that fits, else the largest (the prompt
+        then takes multiple chunks). The configured prefill buckets
+        survive exactly here — as chunk shapes — so short prompts keep
+        their tight compile shapes instead of padding to prefill_chunk."""
+        for s in self.chunk_shapes:
+            if n <= s:
+                return s
+        return self.chunk_shapes[-1]
+
+    def _dispatch_step(self) -> bool:
+        """Pack one unified device step: one decode chunk for the active
+        slots fused with up to admit_cap pending prefill chunks. The
+        decode tokens are charged against step_token_budget first and
+        prefill coalescing fills what remains, floored at one chunk — the
+        budget bounds the step, it is never a stall gate. Decode rides
+        EVERY step unconditionally: it is exactly the work whose
+        starvation the budget exists to prevent, its per-step cost is one
+        bounded chunk, and rows whose prompt completes this step decode
+        immediately in the same program (an all-inactive decode part is
+        masked work that only occurs during cold prefill ramp). Returns
+        False when every queued prefill row turned out stale
+        (reassigned/cancelled)."""
+        jnp = self._jnp
+        with self._work_cv:
+            # purge stale prefill rows (cancelled, or slot reassigned)
+            rows: list[tuple[GenRequest, int]] = []  # (request, n_new)
+            K = self.decode_chunk
+            active_n = sum(
+                1 for r in self._slot_req if r is not None and r.prefill_done
+            )
+            shape = 0
+            budget_left = 0
+            keep: deque[GenRequest] = deque()
+            while self._prefilling:
+                r = self._prefilling.popleft()
+                if (
+                    r.slot is None
+                    or self._slot_req[r.slot] is not r
+                    or r.prefill_done
+                ):
+                    continue  # slot lost (recovery) or already finished
+                if r.cancelled:
+                    if r.finish_reason is None:
+                        r.finish_reason = "cancelled"
+                        self._observe_finish(r, time.perf_counter())
+                        r.out.put(None)
+                    self._slot_req[r.slot] = None
+                    continue
+                rem = len(r.prompt_tokens) - r.prefill_pos
+                if not rows:
+                    # first row fixes the step's compile shape and the
+                    # prefill allowance: total budget minus the decode
+                    # tokens riding this step, floored at one chunk
+                    shape = self._chunk_shape_for(rem)
+                    budget_left = max(
+                        min(rem, shape), self.step_token_budget - K * active_n
+                    )
+                n = min(shape, rem)
+                if len(rows) == self.admit_cap or n > budget_left:
+                    keep.append(r)  # head-of-line stays FIFO for next step
+                    break
+                rows.append((r, n))
+                budget_left -= n
+                if r.prefill_pos + n < len(r.prompt_tokens):
+                    keep.append(r)  # more chunks to come
+            keep.extend(self._prefilling)
+            self._prefilling = keep
+            if not rows:
+                return False
+            now = time.perf_counter()
+            nb = self._wave_width(len(rows))
+            pack = np.zeros((nb, shape + 3), np.int32)
+            meta = np.zeros((2, nb), np.int32)
+            meta[0, :] = self.slots  # pad lanes: inert (scatters dropped)
+            finishes: list[tuple[int, int, GenRequest]] = []
+            prefill_tokens = 0
+            spans: list[tuple[int, int]] = []  # (cursor, n) for MFU
+            for j, (r, n) in enumerate(rows):
+                pos = r.prefill_pos
+                pack[j, :n] = r.prompt_tokens[pos : pos + n]
+                pack[j, shape] = pos
+                pack[j, shape + 1] = n
+                pack[j, shape + 2] = np.float32(r.temperature).view(np.int32)
+                meta[0, j] = r.slot
+                done = pos + n >= len(r.prompt_tokens)
+                meta[1, j] = 1 if done else 0
+                if r._prefill_t0 is None:
+                    r._prefill_t0 = now
+                r.prefill_pos = pos + n
+                # rows actually written: the append scatter drops indices
+                # at i >= n, so padding past the valid count never lands —
+                # retaining pos + shape would store garbage rows in the
+                # prefix cache and bill them against its byte budget
+                r._rows_hi = max(r._rows_hi, pos + n)
+                self._load_credit(r, n)
+                prefill_tokens += n
+                spans.append((pos, n))
+                if done:
+                    r.prefill_done = True
+                    finishes.append((j, r.slot, r))
+            op = self._step_ops[shape]
+            t0 = time.perf_counter()
+            first_dev, logits_dev, toks_dev, last, cache, active, temps, rng = op(
+                self.params, self.cache, self._tail, self._active,
+                self._temps, jnp.asarray(pack), jnp.asarray(meta),
+                self._rng,
+            )
+            self._tail = last
+            self.cache, self._active, self._temps, self._rng = (
+                cache, active, temps, rng,
+            )
+            if finishes:
+                self._start_fetch(first_dev)
+            self._start_fetch(toks_dev)
+            # retain finished prompts for prefix reuse: rows sliced from
+            # the slot cache AFTER the append (device-ordered before any
+            # later mutation), trimmed to the rows actually written
+            if self.kv.prefix is not None and logits_dev is not None:
+                for j, slot, r in finishes:
+                    keep_rows = (
+                        self.kv.capacity if self.kv.rolling
+                        else min(r._rows_hi, self.kv.capacity)
+                    )
+                    self.kv.prefix.put(
+                        self.kv.prefix.key_for(r.prompt_tokens),
+                        cache.k[:, slot : slot + 1, :keep_rows],
+                        cache.v[:, slot : slot + 1, :keep_rows],
+                        len(r.prompt_tokens), logits_dev[j : j + 1],
+                    )
+            # snapshot AFTER the rows loop: rows finishing this step have
+            # prefill_done set and their decode runs in this program
+            snapshot = [
+                r if (r is not None and r.prefill_done) else None
+                for r in self._slot_req
+            ]
+            decode_n = active_n + len(finishes)
+            step_tokens = prefill_tokens + K * decode_n
+            info = {
+                "t0": t0, "shape": shape, "nb": nb,
+                "prefill_tokens": prefill_tokens, "spans": spans,
+                "active": active_n,
+            }
+            self._inflight.append(
+                ("step", first_dev, finishes, toks_dev, snapshot, K, info)
+            )
+            self._stat_steps += 1
+            self._stat_step_tokens += step_tokens
+            if decode_n:
+                self._stat_chunks += 1
+                self._stat_chunk_steps += K
+                self._stat_active_sum += decode_n
+            if self.metrics is not None:
+                self.metrics.record_histogram(
+                    "app_llm_step_tokens", float(step_tokens), model=self.label
+                )
+                self.metrics.set_gauge(
+                    "app_llm_step_budget_utilization",
+                    step_tokens / self.step_token_budget, model=self.label,
+                )
+            self._work_cv.notify()
+            return True
+
     def _process_entry(self, entry: tuple) -> None:
         """Fetch one device result (outside the lock — the blocking RTT
         must not stall the scheduler) and emit tokens (under the lock)."""
+        if entry[0] == "step":
+            self._process_step_entry(entry)
+            return
         if entry[0] == "prefill":
             _, first_dev, taken, info = entry
             first = np.asarray(first_dev)
@@ -1574,7 +2178,7 @@ class LLMEngine:
             wave = 1 << max(0, active_n - 1).bit_length() if active_n else 0
             self.metrics.record_histogram(
                 "app_llm_decode_step_seconds", step_s,
-                model=self.label, chunk=str(k), wave=str(wave),
+                model=self.label, chunk=str(k), wave=str(wave), fused="0",
             )
         cols = toks.T  # [S, K]
         with self._lock:
@@ -1588,6 +2192,106 @@ class LLMEngine:
                         )
                     self._emit_to(r, slot, cols[slot].tolist(), now)
             self._processing = None
+        if self.logger is not None:
+            self._flush_wide_events()
+
+    def _process_step_entry(self, entry: tuple) -> None:
+        """Fetch and emit one unified step: first tokens for rows whose
+        prompt completed this step (their llm.prefill span closes here),
+        then the piggybacked decode chunk's columns. MFU accounting is
+        per-step — one prefill observation over the chunk spans and one
+        decode observation over the chunk, both against the step's
+        dispatch->fetch wall (they share the device window; read the
+        window percentiles, never sum them)."""
+        _, first_dev, finishes, toks_dev, snapshot, k, info = entry
+        t0 = time.perf_counter()
+        first = np.asarray(first_dev) if finishes else None
+        toks = np.asarray(toks_dev)
+        decoded = any(r is not None for r in snapshot)
+        now = time.perf_counter()
+        step_s = now - info["t0"]
+        self._phases["step"].observe(step_s)
+        if self.metrics is not None:
+            self.metrics.record_histogram(
+                "app_llm_step_seconds", step_s, model=self.label
+            )
+            if decoded:
+                self.metrics.record_histogram(
+                    "app_tpu_stats", now - t0, model="llm", op="decode_chunk",
+                )
+        if info["prefill_tokens"]:
+            ctx_read = sum(
+                min(pos, self._costs.sliding_window) if self._costs.sliding_window
+                else pos
+                for pos, _n in info["spans"]
+            )
+            self._observe_mfu(
+                "prefill",
+                tokens=info["prefill_tokens"],
+                flops=self._mfu_mod.chunk_prefill_flops(
+                    self._costs, info["spans"]
+                ),
+                bytes_moved=(
+                    self._costs.params_bytes
+                    + (info["prefill_tokens"] + ctx_read)
+                    * self._costs.kv_bytes_per_ctx_token
+                ),
+                dt=step_s,
+            )
+        if decoded:
+            active_n, ctx_sum = self._ctx_tokens(snapshot)
+            # per-token cadence requests actually experience: a fused
+            # step's wall includes its prefill-append compute (a short
+            # request may complete entirely inside its own step, so
+            # skipping fused steps would leave the series empty for it)
+            self._phases["decode_step"].observe(step_s / k)
+            if active_n:
+                self._observe_mfu(
+                    "decode",
+                    tokens=k * active_n,
+                    flops=self._mfu_mod.decode_flops(
+                        self._costs, k * active_n, k * ctx_sum
+                    ),
+                    bytes_moved=k * (
+                        self._costs.params_bytes
+                        + ctx_sum * self._costs.kv_bytes_per_ctx_token
+                    ),
+                    dt=step_s,
+                )
+            if self.metrics is not None:
+                # fused="1" marks walls that include prefill-append compute
+                # — filter to fused="0" for decode cost comparable 1:1 with
+                # the wave scheduler's pure-decode dispatches
+                wave = 1 << max(0, active_n - 1).bit_length() if active_n else 0
+                self.metrics.record_histogram(
+                    "app_llm_decode_step_seconds", step_s / k,
+                    model=self.label, chunk=str(k), wave=str(wave),
+                    fused="1" if info["prefill_tokens"] else "0",
+                )
+        with self._lock:
+            for j, slot, r in finishes:
+                if r.span is not None and r.finish_reason is None:
+                    self._phase_span(
+                        r, "llm.prefill", r._prefill_t0 or info["t0"], now,
+                        attrs={
+                            "llm.wave": info["nb"],
+                            "llm.bucket": info["shape"],
+                            "llm.prefix_hit": r.prefix_hit,
+                        },
+                    )
+                self._emit_to(r, slot, [int(first[j])], now)
+            if decoded:
+                cols = toks.T  # [S, K]
+                for slot, r in enumerate(snapshot):
+                    if r is not None:
+                        if r.span is not None and r.finish_reason is None:
+                            self._phase_span(
+                                r, "llm.decode", info["t0"], now,
+                                attrs={"llm.chunk": k, "llm.active":
+                                       info["active"], "llm.slot": slot},
+                            )
+                        self._emit_to(r, slot, cols[slot].tolist(), now)
+            self._processing = None  # same acquisition as the emits
         if self.logger is not None:
             self._flush_wide_events()
 
@@ -1613,14 +2317,33 @@ class LLMEngine:
                     if self._stop:
                         break
                     with self._lock:
-                        depth = sum(1 for e in self._inflight if e[0] == "chunk")
-                        if self._processing is not None and self._processing[0] == "chunk":
+                        depth = sum(
+                            1 for e in self._inflight
+                            if e[0] in ("chunk", "step")
+                        )
+                        if (
+                            self._processing is not None
+                            and self._processing[0] in ("chunk", "step")
+                        ):
                             depth += 1
                         needed = self._needed_steps()
-                        want = min(-(-needed // self.decode_chunk), self.lookahead - depth)
+                        prefilling = bool(self._prefilling)
+                    stepped = False
+                    if prefilling and depth < self.lookahead:
+                        # one unified step per pass: prefill chunks packed
+                        # to the token budget, decode riding along — the
+                        # loop comes straight back for the next step
+                        stepped = self._dispatch_step()
+                        if stepped:
+                            depth += 1
+                            needed = max(0, needed - self.decode_chunk)
+                    want = min(
+                        -(-needed // self.decode_chunk),
+                        self.lookahead - depth,
+                    )
                     for _ in range(max(0, want)):
                         needed = max(0, needed - self._dispatch(needed))
-                    if not did and want <= 0:
+                    if not did and not stepped and want <= 0:
                         self._kick.wait(timeout=0.005)
                         self._kick.clear()
                 except Exception as e:  # noqa: BLE001 — engine must not die silently
@@ -1681,6 +2404,7 @@ class LLMEngine:
                     r.out.put(None)
             self._inflight.clear()
             self._processing = None
+            self._prefilling.clear()  # occupants are closed by _abort_all
             self._fetch_fail_streak = 0  # fresh state deserves a fresh count
             self._admitting = 0  # an aborted wave never reaches its slots
             self._tail = self._jnp.zeros((self.slots,), self._jnp.int32)
@@ -1714,7 +2438,10 @@ class LLMEngine:
                 idx = 0
                 if not self._jumped:
                     idx = next(
-                        (i for i, e in enumerate(self._inflight) if e[0] == "prefill"),
+                        (
+                            i for i, e in enumerate(self._inflight)
+                            if self._jump_safe(e)
+                        ),
                         0,
                     )
                 if idx:
@@ -1723,7 +2450,9 @@ class LLMEngine:
                     self._jumped = True
                 else:
                     entry = self._inflight.popleft()
-                    if entry[0] == "chunk":
+                    if entry[0] == "chunk" or (
+                        entry[0] == "step" and entry[5]
+                    ):
                         self._jumped = False
                 self._processing = entry
             try:
@@ -1750,10 +2479,35 @@ class LLMEngine:
                 self._flush_wide_events()
 
     @staticmethod
+    def _jump_safe(entry: tuple) -> bool:
+        """May the collector serve this entry ahead of older in-flight
+        entries? Prefill waves always: they carry ONLY fresh requests'
+        first tokens, and a request's prefill precedes its chunks in the
+        deque. A step entry with finishing rows carries first tokens too
+        — but ALSO the piggybacked decode chunk for every already-active
+        slot, and those slots' earlier tokens may sit in the bypassed
+        entries; jumping it would permute an active request's stream. So
+        a step jumps only when its decode part serves no one beyond its
+        own finishing rows (cold prefill ramp — exactly when TTFT-jumping
+        pays; finishing rows can't appear in older entries because they
+        were not prefill_done at those dispatches)."""
+        if entry[0] == "prefill":
+            return True
+        if entry[0] != "step" or not entry[2]:
+            return False
+        fin = {r for _j, _s, r in entry[2]}
+        return all(r is None or r in fin for r in entry[4])
+
+    @staticmethod
     def _entry_requests(entry: tuple):
-        """Requests carried by an in-flight entry (both entry kinds)."""
+        """Requests carried by an in-flight entry (all entry kinds)."""
         if entry[0] == "prefill":
             return [r for _, r in entry[2] if r is not None]
+        if entry[0] == "step":
+            out = [r for _j, _s, r in entry[2]]
+            if entry[4] is not None:
+                out.extend(r for r in entry[4] if r is not None)
+            return out
         return [r for r in entry[2] if r is not None]
 
     def _close_unreachable(self, failed: tuple) -> None:
@@ -1778,6 +2532,23 @@ class LLMEngine:
                 return
             cover: dict = {}
             for e in self._inflight:
+                if e[0] == "step":
+                    # mirror _inflight_steps (finishes and snapshot
+                    # iterated SEPARATELY — a finishing row appears in
+                    # both, and visiting it twice would credit 2K+2
+                    # instead of K+1, spuriously skipping the close and
+                    # hanging the consumer): a finishing row carries its
+                    # first token plus the piggybacked decode; a
+                    # snapshot-only rider carries the decode steps alone
+                    fin = {r for _j, _s, r in e[2]}
+                    for r in fin:
+                        if r in lost:
+                            cover[r] = cover.get(r, 0) + e[5] + 1
+                    if e[4] is not None:
+                        for r in e[4]:
+                            if r is not None and r in lost and r not in fin:
+                                cover[r] = cover.get(r, 0) + e[5]
+                    continue
                 n = 1 if e[0] == "prefill" else e[3]
                 for r in self._entry_requests(e):
                     if r in lost:
@@ -1806,9 +2577,12 @@ class ReplicatedLLMEngine:
     pass `meshes=[(mesh, param_specs), ...]` and each replica runs
     tensor-parallel over its own submesh — dp x tp serving from one API.
 
-    Routing: "least_loaded" (default) sends each request to the replica
-    with the fewest occupants+queued — robust when request durations vary;
-    "round_robin" is stateless and optimal for uniform work.
+    Routing: "least_loaded" (default) weighs each replica by its QUEUED
+    TOKENS (prompt remainder + expected decode, LLMEngine.load_tokens) —
+    a 128-token prompt is 16x the device work of an 8-token prompt, and
+    counting requests instead piles long-prompt traffic onto one replica;
+    occupant/queue count breaks ties. "round_robin" is stateless and
+    optimal for uniform work.
 
     The public surface mirrors LLMEngine (submit/generate/stats/close), so
     ctx.tpu().llm(name) callers cannot tell one replica from many.
@@ -1891,7 +2665,10 @@ class ReplicatedLLMEngine:
             raise RuntimeError("all replicas dead")
         if self.router == "round_robin" or len(live) == 1:
             return live[next(self._rr) % len(live)]
-        return min(live, key=lambda e: e.load())
+        # token-weighted least-loaded: queued device work, not request
+        # count — load() breaks ties so an idle replica still wins when
+        # token estimates momentarily agree
+        return min(live, key=lambda e: (e.load_tokens(), e.load()))
 
     # -- LLMEngine surface -------------------------------------------------
     def submit(self, req: GenRequest) -> GenRequest:
@@ -1911,6 +2688,9 @@ class ReplicatedLLMEngine:
 
     def load(self) -> int:
         return sum(e.load() for e in self.engines)
+
+    def load_tokens(self) -> int:
+        return sum(e.load_tokens() for e in self.engines)
 
     def stats(self) -> dict:
         per = [e.stats() for e in self.engines]
@@ -1934,8 +2714,9 @@ class ReplicatedLLMEngine:
         ]
         if prefixes:  # fleet-wide prefix-cache totals (per-replica in per_replica)
             out["kvcache_prefix"] = {
-                key: sum(p[key] for p in prefixes)
-                for key in ("hits", "misses", "evictions", "resident_bytes")
+                key: sum(p.get(key, 0) for p in prefixes)
+                for key in ("hits", "misses", "partial_hits", "evictions",
+                            "resident_bytes")
             }
         return out
 

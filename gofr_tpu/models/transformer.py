@@ -32,6 +32,7 @@ import jax.numpy as jnp
 from ..ops import (
     apply_rope,
     chunk_decode_attention,
+    chunk_prefill_attention,
     decode_attention,
     multi_head_attention,
     rms_norm,
@@ -574,6 +575,90 @@ def decode_chunk(
     new_v = merge(cache.v, vb, start)
     new_len = jnp.where(active, jnp.minimum(cache.length + K, max_len), cache.length)
     return toks, last, KVCache(k=new_k, v=new_v, length=new_len), rng
+
+
+def prefill_append(
+    params: dict,
+    cfg: TransformerConfig,
+    tokens: jnp.ndarray,  # [b, c] — one prefill chunk per sequence
+    cache: KVCache,  # [L, b, capacity, hkv, hd] slot rows (gathered)
+    cursors: jnp.ndarray,  # [b] int32 — prompt tokens already resident
+    n_new: jnp.ndarray,  # [b] int32 — valid tokens in this chunk (<= c)
+    *,
+    ring: int = 0,  # >0: cache is a rolling ring of this capacity
+) -> tuple[jnp.ndarray, KVCache]:
+    """Append one prefill chunk into an existing per-slot KV cache.
+
+    The chunked-prefill half of the serving engine's token-budget step
+    (gofr_tpu.llm): instead of prefilling a whole prompt in one
+    bucket-padded wave, prompts advance `n_new` tokens per step through a
+    fixed [b, c] chunk shape. Each layer writes the chunk's K/V rows at
+    the per-sequence cursor (dense: row index = absolute position; ring:
+    position mod capacity) via a masked scatter — indices for i >= n_new
+    are pushed out of bounds and DROPPED, so padding lanes never write —
+    then attends with ops.chunk_prefill_attention (all resident keys +
+    the chunk's causal triangle). Token-equality with the monolithic
+    prefill path holds because every (query, key) pair sees exactly the
+    same dot products and mask set, only batched differently.
+
+    Unlike decode_chunk there is no per-step ring buffer: the whole chunk
+    is one forward pass (c token rows, MXU-bound like prefill), so the
+    scatter amortizes over c tokens and the cache restack through the
+    layer scan costs what the gather already paid.
+
+    Returns (last-valid-token logits [b, vocab] f32, updated cache with
+    length = cursors + n_new). Rows with n_new == 0 return garbage logits
+    (callers only read logits for rows whose prompt just completed).
+    """
+    b, c = tokens.shape
+    hq, hkv, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    capacity = cache.k.shape[2]
+    positions = cursors[:, None] + jnp.arange(c, dtype=jnp.int32)[None, :]
+    i = jnp.arange(c, dtype=jnp.int32)[None, :]
+    idx = positions if ring <= 0 else jnp.mod(positions, ring)
+    # out-of-bounds scatter indices are dropped (jax .at[] default), which
+    # both masks the padding lanes and makes an overfull dense cache
+    # impossible to corrupt
+    idx = jnp.where(i < n_new[:, None], idx, capacity)
+    mm = qmm_a8  # many token rows, MXU-bound: W8A8 like monolithic prefill
+
+    x = _embed_tokens(params, cfg, tokens)
+
+    def layer(x, xs):
+        lp, kc, vc = xs  # [b, capacity, hkv, hd]
+        h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
+        q = mm(h, lp["wq"])
+        if cfg.qkv_bias:
+            q = q + lp["bq"].astype(q.dtype)
+        q = q.reshape(b, c, hq, hd)
+        kv = mm(h, lp["wkv"])
+        if cfg.qkv_bias:
+            kv = kv + lp["bkv"].astype(kv.dtype)
+        kv = kv.reshape(b, c, hkv, 2, hd)
+        k_new, v_new = kv[:, :, :, 0], kv[:, :, :, 1]
+        q = apply_rope(q, positions, cfg.rope_theta)
+        k_new = apply_rope(k_new, positions, cfg.rope_theta)
+        write = jax.vmap(lambda cb, ub, ib: cb.at[ib].set(ub))
+        kc = write(kc, k_new.astype(kc.dtype), idx)
+        vc = write(vc, v_new.astype(vc.dtype), idx)
+        attn = chunk_prefill_attention(
+            q, kc, vc, cursors,
+            logit_cap=cfg.attn_logit_cap, window=cfg.sliding_window,
+            ring=ring,
+        )
+        x = x + mm(attn.reshape(b, c, hq * hd), lp["wo"]).astype(x.dtype)
+        h = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
+        x = x + mm(
+            _act_fn(cfg)(mm(h, lp["w_gate"])) * mm(h, lp["w_up"]), lp["w_down"]
+        )
+        return x, (kc, vc)
+
+    x, (ks, vs) = jax.lax.scan(layer, x, (params["layers"], cache.k, cache.v))
+    last = jnp.clip(n_new - 1, 0, c - 1)
+    x_last = jnp.take_along_axis(x, last[:, None, None].astype(jnp.int32), axis=1)
+    logits = _unembed_last(params, cfg, x_last)  # [b, vocab] f32
+    new_cache = KVCache(k=ks, v=vs, length=cursors + n_new)
+    return logits, new_cache
 
 
 def generate(

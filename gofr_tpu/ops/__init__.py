@@ -10,6 +10,7 @@ this package exists for the TPU north star (BASELINE.json).
 
 from .attention import (
     chunk_decode_attention,
+    chunk_prefill_attention,
     decode_attention,
     flash_attention,
     mha_reference,
@@ -25,6 +26,7 @@ __all__ = [
     "flash_attention",
     "decode_attention",
     "chunk_decode_attention",
+    "chunk_prefill_attention",
     "ring_positions",
     "rms_norm",
     "apply_rope",

@@ -99,14 +99,7 @@ def mha_reference(
 
 
 def _flash_kernel(
-    q_ref,  # [block_q, d]
-    k_ref,  # [block_k, d]
-    v_ref,  # [block_k, d]
-    o_ref,  # [block_q, d]
-    m_scratch,  # [block_q, 128] f32  (lane-replicated running max)
-    l_scratch,  # [block_q, 128] f32  (lane-replicated running denom)
-    acc_scratch,  # [block_q, d] f32
-    *,
+    *refs,  # [off_ref?, q_ref, k_ref, v_ref, o_ref, m, l, acc]
     causal: bool,
     scale: float,
     logit_cap: float,
@@ -114,7 +107,17 @@ def _flash_kernel(
     block_q: int,
     block_k: int,
     num_k_blocks: int,
+    offset: bool = False,
 ):
+    # Ref layout: inputs (optionally led by the per-batch query-offset
+    # scalar in SMEM — the chunk-append prefill path), then the output,
+    # then VMEM scratch: running max / denom (lane-replicated) + f32
+    # accumulator, persistent across the sequential k iterations.
+    if offset:
+        off_ref, q_ref, k_ref, v_ref, o_ref, m_scratch, l_scratch, acc_scratch = refs
+    else:
+        off_ref = None
+        q_ref, k_ref, v_ref, o_ref, m_scratch, l_scratch, acc_scratch = refs
     qi = pl.program_id(2)
     ki_raw = pl.program_id(3)
     grid_k = pl.num_programs(3)
@@ -141,14 +144,22 @@ def _flash_kernel(
         ki = ki_raw
         in_range = True
 
+    # Per-batch query offset (chunk-append prefill): query row i sits at
+    # absolute position off + i while key block positions stay absolute
+    # cache row indices. The offset is a traced value, so block liveness
+    # is decided compute-side (pl.when takes dynamic predicates); the
+    # banded-grid DMA skip stays disabled on this path (flash_attention
+    # never requests both).
+    off = off_ref[0, 0] if offset else 0
+
     # Causal: block is live iff some query position >= some key position,
     # i.e. block_q_end >= block_k_start. Sliding window additionally kills
     # blocks entirely BEHIND the band (block_k_end <= block_q_start -
     # window) — with the banded grid those blocks aren't even fetched;
     # without it (non-causal or tiny seq) they are skipped compute-side.
-    live = (qi + 1) * block_q - 1 >= ki * block_k if causal else True
+    live = off + (qi + 1) * block_q - 1 >= ki * block_k if causal else True
     if window > 0:
-        band_live = (ki + 1) * block_k - 1 > qi * block_q - window
+        band_live = (ki + 1) * block_k - 1 > off + qi * block_q - window
         live = jnp.logical_and(live, band_live) if causal else band_live
     live = jnp.logical_and(live, in_range) if banded else live
 
@@ -162,7 +173,7 @@ def _flash_kernel(
         if logit_cap > 0.0:
             s = logit_cap * jnp.tanh(s / logit_cap)
         if causal or window > 0:
-            qpos = qi * block_q + jax.lax.broadcasted_iota(
+            qpos = off + qi * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0
             )
             kpos = ki * block_k + jax.lax.broadcasted_iota(
@@ -207,8 +218,18 @@ def flash_attention(
     window: int = 0,
     block_q: int = 128,
     block_k: int = 128,
+    q_offsets: jnp.ndarray | None = None,  # [b] int32 per-batch query offset
     interpret: bool = False,
 ) -> jnp.ndarray:
+    """Blockwise online-softmax attention on the Pallas TPU kernel.
+
+    q_offsets (chunk-append prefill): query row i of batch b sits at
+    absolute position q_offsets[b] + i while key positions stay absolute
+    cache row indices — a query block attends all prior keys already
+    resident in the cache plus its own chunk's causal triangle. Offsets
+    are traced values, so block liveness is decided in-kernel and the
+    banded-grid DMA skip is disabled on this path (every k block is
+    fetched; masked blocks are skipped compute-side)."""
     if not _HAS_PLTPU:
         raise RuntimeError(
             "flash_attention requires jax.experimental.pallas.tpu (scratch "
@@ -223,6 +244,7 @@ def flash_attention(
     group = hq // hkv
     scale = scale if scale is not None else 1.0 / math.sqrt(d)
     num_k_blocks = sk // block_k
+    offset = q_offsets is not None
 
     # BHSD layout inside the kernel: contiguous [seq, d] slabs per head.
     qt = q.transpose(0, 2, 1, 3)  # [b, hq, sq, d]
@@ -236,8 +258,9 @@ def flash_attention(
     # the q-block start mod block_k (plus a ramp while the band clips at
     # 0), so take the true max over one ramp + one period of q blocks —
     # a closed-form bound over-fetches one dead block per q block at the
-    # shipped aligned 128/128 config.
-    if causal and window > 0:
+    # shipped aligned 128/128 config. Dynamic q_offsets make the band
+    # data-dependent, so the offset path keeps the full k grid.
+    if causal and window > 0 and not offset:
         nqb = sq // block_q
         limit = min(
             nqb, (window - 1) // block_q + math.lcm(block_q, block_k) // block_q + 1
@@ -268,15 +291,25 @@ def flash_attention(
         block_q=block_q,
         block_k=block_k,
         num_k_blocks=num_k_blocks,
+        offset=offset,
     )
+    in_specs = [
+        pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+        pl.BlockSpec((1, 1, block_k, d), kv_index),
+        pl.BlockSpec((1, 1, block_k, d), kv_index),
+    ]
+    operands = [qt, kt, vt]
+    if offset:
+        # per-batch scalar in SMEM, one (1, 1) cell per grid batch index
+        in_specs.insert(0, pl.BlockSpec(
+            (1, 1), lambda bi, hi, qi, ki: (bi, 0),
+            memory_space=pltpu.SMEM,
+        ))
+        operands.insert(0, q_offsets.astype(jnp.int32).reshape(b, 1))
     out = pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
-            pl.BlockSpec((1, 1, block_k, d), kv_index),
-            pl.BlockSpec((1, 1, block_k, d), kv_index),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec(
             (1, 1, block_q, d), lambda bi, hi, qi, ki: (bi, hi, qi, 0)
         ),
@@ -287,7 +320,7 @@ def flash_attention(
             pltpu.VMEM((block_q, d), jnp.float32),
         ],
         interpret=interpret,
-    )(qt, kt, vt)
+    )(*operands)
     return out.transpose(0, 2, 1, 3)
 
 
@@ -467,6 +500,94 @@ def chunk_decode_attention(
         "bhgqk,bkhd->bqhgd", p_buf, v_buf, preferred_element_type=jnp.float32
     )
     return out.reshape(b, sq, hq, d).astype(q.dtype)
+
+
+def chunk_prefill_attention(
+    q: jnp.ndarray,  # [b, c, hq, d] — one prefill chunk's queries
+    k_cache: jnp.ndarray,  # [b, capacity, hkv, d] — chunk rows ALREADY written
+    v_cache: jnp.ndarray,  # [b, capacity, hkv, d]
+    cursors: jnp.ndarray,  # [b] int32 — tokens resident BEFORE this chunk
+    *,
+    scale: float | None = None,
+    logit_cap: float = 0.0,
+    window: int = 0,  # sliding window over absolute positions
+    ring: int = 0,  # >0: cache is a rolling ring of this capacity (kvcache)
+) -> jnp.ndarray:
+    """Chunked-prefill attention: a query block at absolute positions
+    [cursors, cursors + c) attends every prior key resident in the slot
+    cache plus this chunk's own causal triangle — the device-side core of
+    the token-budget step scheduler (gofr_tpu.llm), which appends prompts
+    into slot KV incrementally instead of prefilling them in one
+    monolithic wave.
+
+    The chunk's K/V rows are written into the cache BEFORE this call
+    (write-then-attend), so one einsum over the capacity axis covers both
+    regions and the softmax needs no two-region merge. Masks are purely
+    positional: row p is attended by query i iff p <= cursors + i (causal
+    — this also hides any stale rows a previous slot occupant left above
+    the cursor) and p > cursors + i - window when windowed. Queries
+    beyond the chunk's valid token count produce garbage the engine
+    discards; their key rows were never written (the engine drops those
+    scatter indices), and causality hides whatever sits there.
+
+    ring > 0 declares the cache a window-bounded rolling buffer of that
+    capacity: row positions are reconstructed via ring_positions at the
+    post-chunk length (cursors + c), never-written rows come back
+    negative, and the same positional masks apply. Requires
+    0 < window <= ring - c so a chunk append can never overwrite a row
+    still inside any query's window.
+
+    Dots run at the cache's stored dtype with f32 accumulation (the
+    decode_attention convention); on the TPU backend with cleanly tiling
+    shapes the dense path lowers to the Pallas flash kernel via
+    q_offsets.
+    """
+    b, c, hq, d = q.shape
+    hkv = k_cache.shape[2]
+    group = hq // hkv
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    capacity = k_cache.shape[1]
+
+    qpos = cursors[:, None] + jnp.arange(c, dtype=jnp.int32)[None, :]  # [b, c]
+    if ring > 0:
+        if window <= 0 or ring - c < window:
+            # window <= ring - c (the docstring precondition): appending a
+            # c-row chunk must never overwrite a row still inside any
+            # query's window — violations vanish into the mask silently
+            raise ValueError(
+                f"ring cache (capacity {ring}) requires 0 < window <= "
+                f"ring - chunk ({ring - c}), got window {window}"
+            )
+        pos = ring_positions(cursors + c, capacity)  # [b, capacity]
+        mask = (pos[:, None, :] >= 0) & (pos[:, None, :] <= qpos[:, :, None])
+        mask = mask & (pos[:, None, :] > qpos[:, :, None] - window)
+    else:
+        if _flash_ok(q, k_cache, min(128, c), 128) and c % min(128, c) == 0:
+            # dense path on TPU: the flash kernel accepts the query block
+            # via per-batch offsets (block_q clamped to the chunk length)
+            return flash_attention(
+                q, k_cache, v_cache, causal=True, scale=scale,
+                logit_cap=logit_cap, window=window,
+                block_q=min(128, c), q_offsets=cursors,
+            )
+        kpos = jnp.arange(capacity, dtype=jnp.int32)[None, None, :]
+        mask = kpos <= qpos[:, :, None]
+        if window > 0:
+            mask = mask & (kpos > qpos[:, :, None] - window)
+
+    qg = (q.astype(jnp.float32) * scale).astype(q.dtype)
+    qg = qg.reshape(b, c, hkv, group, d)
+    s = jnp.einsum(
+        "bqhgd,bkhd->bhgqk", qg, k_cache, preferred_element_type=jnp.float32
+    )  # [b, hkv, group, c, capacity]
+    if logit_cap > 0.0:
+        s = logit_cap * jnp.tanh(s / logit_cap)
+    s = jnp.where(mask[:, None, None, :, :], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1).astype(v_cache.dtype)
+    out = jnp.einsum(
+        "bhgqk,bkhd->bqhgd", p, v_cache, preferred_element_type=jnp.float32
+    )
+    return out.reshape(b, c, hq, d).astype(q.dtype)
 
 
 # ---------------------------------------------------------------------------

@@ -33,6 +33,7 @@ __all__ = [
     "model_costs",
     "decode_flops",
     "prefill_flops",
+    "chunk_prefill_flops",
     "device_peak_flops",
     "device_hbm_bandwidth",
     "roofline_ratio",
@@ -175,6 +176,35 @@ def prefill_flops(costs: ModelCosts, seq_lens: list[int]) -> float:
             attended = w * (w + 1) / 2 + (s - w) * w
         total += (
             2 * s * costs.layer_params
+            + 2 * costs.embed_params
+            + costs.attn_flops_per_token_per_ctx * attended
+        )
+    return total
+
+
+def chunk_prefill_flops(costs: ModelCosts, spans: list[tuple[int, int]]) -> float:
+    """FLOPs for one chunked-prefill step over `spans` of (cursor, n_new):
+    n_new tokens appended at absolute positions [cursor, cursor + n_new).
+    Same useful-work convention as prefill_flops (padding lanes count
+    zero), but attention is position-exact — token at position p attends
+    min(p + 1, window) keys — and the unembed matmul bills once per span
+    (the step op computes last-token logits every chunk, which is the
+    chunked path's extra cost over one-shot prefill)."""
+    total = 0.0
+    w = costs.sliding_window
+
+    def attended_below(p: int) -> float:
+        # sum over positions 0..p-1 of min(pos + 1, window or inf)
+        if not w or p <= w:
+            return p * (p + 1) / 2
+        return w * (w + 1) / 2 + (p - w) * w
+
+    for cursor, n in spans:
+        if n <= 0:
+            continue
+        attended = attended_below(cursor + n) - attended_below(cursor)
+        total += (
+            2 * n * costs.layer_params
             + 2 * costs.embed_params
             + costs.attn_flops_per_token_per_ctx * attended
         )

@@ -1,0 +1,120 @@
+#!/usr/bin/env python
+"""CI smoke: the token-budget step scheduler end-to-end over real sockets.
+
+Boots a tiny-model app on the CPU backend with a small prefill chunk so a
+short prompt needs MULTIPLE chunks, serves it, and asserts the surfaces
+the chunked scheduler added:
+
+- completion is exact (matches the monolithic-path engine's tokens),
+- app_llm_step_tokens / app_llm_step_seconds histograms and the
+  app_llm_step_budget_utilization gauge are live on /metrics,
+- the compile registry lists the unified-step program rows
+  (llm.step_p*), and the engine debug endpoint reports the chunked
+  scheduler with its step telemetry.
+
+Usage: JAX_PLATFORMS=cpu python scripts/smoke_chunked.py
+Exit codes: 0 clean, non-zero assertion failure (message on stderr).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    import jax
+
+    from gofr_tpu import App
+    from gofr_tpu.config import new_mock_config
+    from gofr_tpu.llm import LLMEngine
+    from gofr_tpu.models import TransformerConfig, init_params
+
+    cfg = TransformerConfig.tiny()
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    app = App(config=new_mock_config({
+        "APP_NAME": "chunked-smoke", "HTTP_PORT": "0", "METRICS_PORT": "0",
+        "LOG_LEVEL": "ERROR", "TPU_TELEMETRY_INTERVAL_S": "0",
+    }))
+    app.container.tpu().register_llm(
+        "tiny", cfg, params, slots=2, max_seq_len=64, prefill_buckets=(8,),
+        prefill_chunk=8, step_token_budget=16,
+    )
+    app.run_in_background()
+    base = f"http://127.0.0.1:{app.http_server.port}"
+    try:
+        eng = app.container.tpu().llm("tiny")
+        prompt = list(range(1, 18))  # 17 tokens -> 3 chunks of shape 8
+        toks = eng.generate(prompt, max_new_tokens=4)
+        assert len(toks) == 4, f"short completion: {toks}"
+
+        # token equality vs the monolithic wave path (step_token_budget=0)
+        mono = LLMEngine(
+            cfg, params, slots=2, max_seq_len=64, prefill_buckets=(8,),
+            step_token_budget=0, warmup=False,
+        )
+        try:
+            want = mono.generate(prompt, max_new_tokens=4)
+        finally:
+            mono.close()
+        assert toks == want, f"chunked {toks} != monolithic {want}"
+        print(f"token equality: chunked == monolithic == {toks}")
+
+        st = eng.stats()
+        assert st["scheduler"] == "chunked", st["scheduler"]
+        assert st["steps"] >= 2, st["steps"]  # 17 tokens / 16-token budget
+        assert st["step_tokens"] >= len(prompt), st["step_tokens"]
+        print(f"steps={st['steps']} step_tokens={st['step_tokens']} "
+              f"budget={st['step_token_budget']}")
+
+        # budget-utilisation gauge + step histograms on /metrics
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{app.metrics_server.port}/metrics", timeout=15
+        ) as r:
+            expo = r.read().decode()
+        for name in ("app_llm_step_budget_utilization",
+                     "app_llm_step_tokens", "app_llm_step_seconds"):
+            assert name in expo, f"{name} missing from /metrics"
+        util = [
+            ln for ln in expo.splitlines()
+            if ln.startswith("app_llm_step_budget_utilization{")
+        ]
+        assert util and float(util[0].rsplit(" ", 1)[1]) > 0, util
+        print(f"metrics: step series present, utilization line {util[0]!r}")
+
+        # compile registry lists the unified-step program rows
+        with urllib.request.urlopen(
+            f"{base}/.well-known/debug/compiles", timeout=15
+        ) as r:
+            body = json.loads(r.read())["data"]
+        step_rows = [
+            e for e in body["programs"] if e["program"].startswith("llm.step_p")
+        ]
+        assert step_rows, {e["program"] for e in body["programs"]}
+        assert all(e["compiles"] >= 1 for e in step_rows)
+        print(f"compile registry: {len(step_rows)} step-program rows "
+              f"({sorted({e['program'] for e in step_rows})})")
+
+        # engine debug endpoint reports the chunked scheduler
+        with urllib.request.urlopen(
+            f"{base}/.well-known/debug/engine", timeout=15
+        ) as r:
+            dbg = json.loads(r.read())["data"]["engines"]["tiny"]
+        assert dbg["scheduler"] == "chunked" and dbg["step_token_budget"] == 16
+        print("smoke_chunked: OK")
+        return 0
+    finally:
+        app.shutdown()
+
+
+if __name__ == "__main__":
+    rc = main()
+    sys.stdout.flush()
+    sys.stderr.flush()
+    # _exit skips interpreter teardown (see smoke_profiling.py: XLA
+    # destructors intermittently abort after all work completed)
+    os._exit(rc)

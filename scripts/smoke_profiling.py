@@ -50,7 +50,8 @@ def main() -> int:
             body = json.loads(r.read())["data"]
         programs = {e["program"] for e in body["programs"]}
         assert body["totals"]["programs"] >= 4, body["totals"]
-        assert "llm.prefill" in programs, programs
+        # chunked scheduler: prompts run through the unified-step family
+        assert any(p.startswith("llm.step_p") for p in programs), programs
         assert any(p.startswith("llm.decode_chunk") for p in programs), programs
         assert body["warmup"].get("tiny", {}).get("seconds", 0) > 0, body["warmup"]
         print(f"compile registry: {body['totals']} programs={sorted(programs)}")

@@ -131,6 +131,27 @@ def test_summary_line_carries_phase_breakdown():
     assert "phase_breakdown" not in bench._summary_line(_serving_result())
 
 
+def test_summary_line_carries_interactive_slo():
+    """BENCH_r08+: the mixed-prompt interactive point rides the summary
+    as a compact block (TTFT p99, p99/p50, step jitter ratio)."""
+    r = _serving_result()
+    r["detail"]["interactive_slo"] = {
+        "offered_qps": 250.0, "steady_qps": 248.0,
+        "p50_ms": 120.0, "p99_ms": 160.0, "p99_over_p50": 1.33,
+        "ttft_p50_ms": 30.0, "ttft_p99_ms": 80.0,
+        "step_jitter": {"step_p50_ms": 2.1, "step_p99_ms": 3.0,
+                        "step_p99_over_p50": 1.43},
+    }
+    s = bench._summary_line(r)
+    assert s["interactive_slo"] == {
+        "offered_qps": 250.0, "steady_qps": 248.0, "ttft_p99_ms": 80.0,
+        "p99_over_p50": 1.33, "step_p99_over_p50": 1.43,
+    }
+    assert len(json.dumps(s)) < 1500
+    # absent block (--no-interactive-slo / CPU runs) must not leak a key
+    assert "interactive_slo" not in bench._summary_line(_serving_result())
+
+
 def test_phase_breakdown_from_histogram_deltas():
     """p50/p99 come from the count DELTAS between two snapshots, so the
     SLO window is attributed without the warmup/probe traffic that also

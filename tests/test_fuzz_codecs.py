@@ -164,7 +164,10 @@ class TestHTTPParserFuzz:
             try:
                 with socket.create_connection(("127.0.0.1", server), timeout=2) as s:
                     s.sendall(blob)
-                    s.settimeout(1.0)
+                    # short grace: most blobs draw an immediate 400/close;
+                    # ones that parse as a partial request would otherwise
+                    # idle the full timeout 40x (tier-1 runtime)
+                    s.settimeout(0.25)
                     try:
                         s.recv(4096)
                     except socket.timeout:

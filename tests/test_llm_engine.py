@@ -170,6 +170,38 @@ class TestCollectorFailure:
         # lost entry must vanish from _processing in the same lock hold
         assert fake._processing is None
 
+    def test_close_unreachable_step_entry_counts_finisher_once(self):
+        """A finishing row appears in BOTH a step entry's finishes and its
+        decode snapshot; surviving coverage must be k+1 (first token plus
+        the piggybacked decode), not 2k+2 — double-crediting makes a lost
+        request look finishable, skips the close, and hangs its consumer
+        until the stream timeout. A snapshot-only rider is credited k."""
+        import collections
+        import types
+
+        k = 2
+        orphan = GenRequest([1], max_new_tokens=5)  # k+1 = 3 < 5: close
+        # (the 2k+2 = 6 >= 5 double-credit would have kept it open)
+        covered = GenRequest([2], max_new_tokens=3)  # 3 <= k+1: stays open
+        rider = GenRequest([3], max_new_tokens=2)  # snapshot-only: 2 <= k
+        fake = types.SimpleNamespace(
+            _lock=threading.RLock(),
+            _entry_requests=LLMEngine._entry_requests,
+            _observe_finish=lambda r, now: None,
+            _processing=("chunk", None, [orphan, covered, rider, None], 1),
+            _inflight=collections.deque([(
+                "step", None, [(0, 1, orphan), (1, 2, covered)], None,
+                [None, orphan, covered, rider], k, None,
+            )]),
+            _slot_req=[None, None, None, None],
+        )
+        failed = fake._processing
+        LLMEngine._close_unreachable(fake, failed)
+        assert orphan.finish_reason == "cancelled"
+        assert orphan.out.get_nowait() is None
+        assert covered.finish_reason is None
+        assert rider.finish_reason is None
+
 
 class TestSLOAdmission:
     def test_max_queue_rejects_with_429(self, params):

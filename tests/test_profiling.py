@@ -259,7 +259,10 @@ class TestEngineMFU:
         eng, _ = engine
         dbg = eng.debug_state()
         programs = {e["program"] for e in dbg["compiles"]}
-        assert {"llm.prefill", "llm.insert_many", "llm.admit_update"} <= programs
+        # chunked scheduler: the unified step family replaces the
+        # monolithic llm.prefill programs in the warmed set
+        assert {"llm.insert_many", "llm.admit_update"} <= programs
+        assert any(p.startswith("llm.step_p") for p in programs)
         assert any(p.startswith("llm.decode_chunk") for p in programs)
         for e in dbg["compiles"]:
             assert e["model"] == "mfu-test" and e["compile_s"] >= 0
@@ -406,7 +409,10 @@ class TestEndpoints:
             body = json.loads(r.read())["data"]
         assert set(body) == {"programs", "totals", "backend_events", "warmup"}
         mine = [e for e in body["programs"] if e["model"] == "tinyprof"]
-        assert {"llm.prefill"} <= {e["program"] for e in mine}
+        # chunked scheduler: prompts run through the unified step programs
+        assert any(
+            e["program"].startswith("llm.step_p") for e in mine
+        ), {e["program"] for e in mine}
         for e in mine:
             for key in ("program", "model", "arg_shapes", "compiles", "hits",
                         "compile_s", "trace_s", "backend", "measured", "age_s"):
