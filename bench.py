@@ -725,6 +725,15 @@ def bench_serving(args) -> dict:
             args, cfg, eng.params if quantize else params, quantize
         )
 
+    # degraded-operation point (BENCH_r09+): kill one of two replicas
+    # mid-run via the fault injector — client-visible error rate,
+    # failover count, and time-to-restored-capacity are the resilience
+    # subsystem's numbers (gofr_tpu.resilience)
+    if on_tpu and not args.no_degraded:
+        detail["degraded"] = _bench_degraded(
+            args, cfg, eng.params if quantize else params, quantize
+        )
+
     # prefix-cache operating point: 50% shared-prefix traffic — hits skip
     # the prefill wave entirely, so the engine can exceed the NO-CACHE
     # device ceiling (per-request prefill is the larger serial share at
@@ -798,6 +807,99 @@ def _bench_long_context(args, cfg, params, quantize: bool) -> dict:
     finally:
         eng.close()
     return point
+
+
+def _bench_degraded(args, cfg, params, quantize: bool) -> dict:
+    """Degraded-operation point: a 2-replica fleet under steady
+    closed-loop load loses replica 0 mid-run (fault injector) and the
+    numbers that matter are the BLAST RADIUS — client-visible error
+    rate, in-flight failovers, and time-to-restored-capacity (kill ->
+    the supervisor's rebuilt replica back in the routing set). An
+    unfailed run of the same shape would report error_rate 0 and no
+    failovers; the point exists to keep those properties honest."""
+    import jax
+
+    from gofr_tpu.llm import GenRequest, ReplicatedLLMEngine
+    from gofr_tpu.resilience import FaultInjector
+
+    if len(jax.devices()) < 2:
+        return {"skipped": "needs >=2 devices"}
+    S = args.prefill_len
+    inj = FaultInjector()
+    rep = ReplicatedLLMEngine(
+        cfg, params, replicas=2, fault_injector=inj,
+        slots=args.batch,
+        max_seq_len=S + args.new_tokens + 2 * args.decode_chunk,
+        prefill_buckets=(S,), decode_chunk=args.decode_chunk,
+        admit_cap=args.admit_cap, quantize=quantize,
+    )
+    ok = errors = 0
+    lock = threading.Lock()
+    stop = threading.Event()
+
+    def client(cid: int):
+        nonlocal ok, errors
+        rng = np.random.default_rng(cid)
+        while not stop.is_set():
+            prompt = rng.integers(1, cfg.vocab_size, size=S - 8).tolist()
+            try:
+                req = rep.submit(GenRequest(prompt, max_new_tokens=args.new_tokens))
+                toks = req.tokens(timeout=600)
+                good = len(toks) == args.new_tokens
+            except Exception:  # noqa: BLE001 — errors ARE the measurement
+                good = False
+            with lock:
+                if good:
+                    ok += 1
+                else:
+                    errors += 1
+
+    n_clients = min(64, args.clients)
+    ts = [threading.Thread(target=client, args=(c,)) for c in range(n_clients)]
+    t0 = time.perf_counter()
+    for t in ts:
+        t.start()
+    try:
+        # steady state first, then the kill
+        time.sleep(3.0)
+        inj.arm("replica_kill", label="/r0")
+        t_kill = time.perf_counter()
+        # wait for the death, then for restored capacity (supervised
+        # rebuild + warm on the same device; cap the wait at 120 s)
+        t_restored = None
+        deadline = t_kill + 120.0
+        died = False
+        while time.perf_counter() < deadline:
+            alive = sum(e.alive() for e in rep.engines)
+            if alive < 2:
+                died = True
+            elif died:
+                t_restored = time.perf_counter()
+                break
+            time.sleep(0.05)
+        time.sleep(2.0)  # post-restore steady state
+    finally:
+        stop.set()
+        for t in ts:
+            t.join(timeout=60)
+    wall = time.perf_counter() - t0
+    st = rep.stats()
+    rep.close()
+    total = ok + errors
+    return {
+        "requests": total,
+        "qps": round(total / wall, 1),
+        "errors": errors,
+        "error_rate": round(errors / max(1, total), 4),
+        "failovers": st["failovers"],
+        "failover_errors": st["failover_errors"],
+        "restarts": st["restarts"],
+        "time_to_restored_s": (
+            round(t_restored - t_kill, 2) if t_restored is not None else None
+        ),
+        "clients": n_clients,
+        "replicas": 2,
+    }
 
 
 def _bench_prefix_cache(args, cfg, params, quantize: bool, ceiling_qps: float) -> dict:
@@ -1132,6 +1234,9 @@ def main() -> None:
                     help="skip the 50%%-shared-prefix prefix-cache point")
     ap.add_argument("--no-interactive-slo", action="store_true",
                     help="skip the mixed-prompt interactive-SLO point")
+    ap.add_argument("--no-degraded", action="store_true",
+                    help="skip the degraded-operation point (replica kill "
+                         "mid-run; needs >=2 devices)")
     ap.add_argument("--interactive-rate", type=float, default=250.0,
                     help="fixed offered load (req/s) for the interactive-"
                          "SLO point — fixed so rounds compare directly")
@@ -1232,6 +1337,13 @@ def _summary_line(result: dict) -> dict:
             "step_p99_over_p50": (isl.get("step_jitter") or {}).get(
                 "step_p99_over_p50"
             ),
+        }
+    if d.get("degraded") and not d["degraded"].get("skipped"):
+        dg = d["degraded"]  # BENCH_r09+: resilience blast radius
+        s["degraded"] = {
+            "error_rate": dg.get("error_rate"),
+            "failovers": dg.get("failovers"),
+            "time_to_restored_s": dg.get("time_to_restored_s"),
         }
     if d.get("subruns"):
         s["greet_qps"] = d["subruns"].get("greet_qps_cpu")

@@ -116,6 +116,9 @@ def generate(ctx):
     out = ctx.tpu().llm("gemma").generate(
         toks, max_new_tokens=int(body.get("max_new_tokens", 16)),
         temperature=float(body.get("temperature", 0.0)), eos_token=eos,
+        # end-to-end deadline: if this handler's timeout fires, the engine
+        # cancels the slotted decode instead of finishing it for no one
+        deadline=ctx.deadline,
     )
     resp = {"tokens": out}
     if TOKENIZER is not None:
@@ -134,6 +137,10 @@ async def stream(ctx):
             max_new_tokens=int(body.get("max_new_tokens", 16)),
             temperature=float(body.get("temperature", 0.0)),
             eos_token=eos,
+            # NO deadline here, unlike generate(): REQUEST_TIMEOUT only
+            # bounds OBTAINING this generator, never the streaming phase,
+            # so a connected client legitimately streams past it — a
+            # deadline would silently truncate the live stream mid-flight
         )
     )
     emitted: list[int] = []

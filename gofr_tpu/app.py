@@ -76,6 +76,12 @@ class App:
         self._shutdown_event: asyncio.Event | None = None
         self._loop: asyncio.AbstractEventLoop | None = None
         self._bg_tasks: list[asyncio.Task] = []
+        # graceful drain (rolling deploys; docs/advanced-guide/resilience.md):
+        # the flag lives on the CONTAINER so handlers (health readiness)
+        # see it without a back-reference to the app
+        self._draining = False
+        self.container.draining = False
+        self.drain_deadline_s = self.config.get_float("GOFR_DRAIN_DEADLINE_S", 30.0)
 
     def _make_http_server(self):
         """Native-codec protocol server when the C++ extension builds
@@ -270,6 +276,7 @@ class App:
             "POST", "/.well-known/debug/profile", debug_profile_handler,
             timeout_s=max(60.0, self.request_timeout),
         )
+        self._add("POST", "/.well-known/debug/drain", self._drain_handler)
         self.router.add("GET", "/favicon.ico", favicon_wire_handler)
         from .swagger import register_swagger_routes
 
@@ -361,6 +368,85 @@ class App:
         if self._loop is not None and self._shutdown_event is not None:
             self._loop.call_soon_threadsafe(self._shutdown_event.set)
 
+    # ---- graceful drain (rolling deploys) ----
+    def _drain_handler(self, ctx) -> dict:
+        """POST /.well-known/debug/drain — begin the graceful drain from
+        the deploy controller's preStop hook (the SIGTERM path runs the
+        same sequence). Idempotent: a second call reports the drain
+        already in progress.
+
+        Loopback-only by default: unlike the other debug routes this one
+        is DESTRUCTIVE (takes the instance out of rotation and closes
+        it), and auth middleware is opt-in — an exposed port must not be
+        a one-request denial of service. The preStop hook runs inside
+        the pod, so localhost covers it; GOFR_DRAIN_REMOTE=1 opts remote
+        callers in for deployments that gate the route themselves."""
+        host = (getattr(ctx.request, "remote_addr", "") or "").rsplit(":", 1)[0]
+        if host not in ("127.0.0.1", "::1", "[::1]", "localhost", "") and (
+            self.config.get_or_default("GOFR_DRAIN_REMOTE", "0") != "1"
+        ):
+            from .http.errors import HTTPError
+
+            err = HTTPError("drain is loopback-only (set GOFR_DRAIN_REMOTE=1)")
+            err.status_code = 403
+            raise err
+        started = self.begin_drain()
+        return {
+            "draining": True,
+            "started": started,
+            "deadline_s": self.drain_deadline_s,
+        }
+
+    def begin_drain(self, deadline_s: float | None = None) -> bool:
+        """Flip readiness to 503 (health_handler), close engine admission
+        (submit -> EngineDraining/503), wait for in-flight work up to the
+        drain deadline, then shut the servers down. Returns False if a
+        drain is already running. Safe from any thread (the waiter runs
+        on its own daemon thread; shutdown() is loop-threadsafe)."""
+        if self._draining:
+            return False
+        self._draining = True
+        self.container.draining = True
+        deadline_s = deadline_s if deadline_s is not None else self.drain_deadline_s
+        self.logger.info(
+            f"drain: readiness down, admission closed; finishing in-flight "
+            f"work (deadline {deadline_s:.0f}s)"
+        )
+        rt = self.container.tpu_runtime  # never CONSTRUCT the runtime here
+        if rt is not None:
+            try:
+                rt.drain()
+            except Exception as e:  # noqa: BLE001 — drain must reach shutdown
+                self.logger.error(f"drain: engine drain failed: {e!r}")
+        threading.Thread(
+            target=self._drain_then_stop, args=(deadline_s,),
+            name="app-drain", daemon=True,
+        ).start()
+        return True
+
+    def _drain_then_stop(self, deadline_s: float) -> None:
+        import time as _time
+
+        # grace floor even when nothing is in flight: the load balancer
+        # must get at least one readiness probe window at 503 (and the
+        # drain POST its response) before the listener closes
+        _time.sleep(min(0.5, deadline_s))
+        t0 = _time.monotonic()
+        while _time.monotonic() - t0 < deadline_s:
+            rt = self.container.tpu_runtime
+            try:
+                if rt is None or rt.drained():
+                    break
+            except Exception:  # noqa: BLE001 — a sick engine must not wedge exit
+                break
+            _time.sleep(0.05)
+        else:
+            self.logger.warn(
+                f"drain: deadline {deadline_s:.0f}s elapsed with work still "
+                "in flight; shutting down anyway"
+            )
+        self.shutdown()
+
     def run(self) -> None:
         """Blocking entrypoint with signal-driven graceful shutdown.
 
@@ -381,9 +467,15 @@ class App:
 
         async def main():
             loop = asyncio.get_running_loop()
-            for sig in (signal.SIGINT, signal.SIGTERM):
+            # SIGTERM = the orchestrator's rolling-deploy signal: drain
+            # gracefully (readiness 503, finish in-flight, then close).
+            # SIGINT = a human at the keyboard: stop now.
+            for sig, handler in (
+                (signal.SIGINT, self.shutdown),
+                (signal.SIGTERM, self.begin_drain),
+            ):
                 try:
-                    loop.add_signal_handler(sig, self.shutdown)
+                    loop.add_signal_handler(sig, handler)
                 except (NotImplementedError, RuntimeError):
                     pass
             await self.serve()

@@ -259,6 +259,10 @@ class TPURuntime:
         # defaults, which also honor the same names as process env vars)
         self.default_llm_step_budget = get("TPU_LLM_STEP_TOKEN_BUDGET", "")
         self.default_llm_prefill_chunk = get("TPU_LLM_PREFILL_CHUNK", "")
+        # resilience knobs (gofr_tpu.resilience): step-watchdog threshold
+        # seconds ("" = engine default, which reads the same env var; 0
+        # disables) — docs/advanced-guide/resilience.md
+        self.default_llm_step_watchdog = get("TPU_LLM_STEP_WATCHDOG_S", "")
         self._models: dict[str, _Model] = {}
         self._lock = threading.Lock()
         if metrics is not None:
@@ -449,6 +453,10 @@ class TPURuntime:
             engine_kw.setdefault(
                 "prefill_chunk", int(self.default_llm_prefill_chunk)
             )
+        if self.default_llm_step_watchdog != "":
+            engine_kw.setdefault(
+                "step_watchdog_s", float(self.default_llm_step_watchdog)
+            )
         engine_kw.setdefault("kv_label", name)  # metric-series label
         engine_kw.setdefault("tracer", self.tracer)  # lifecycle spans
         if not hasattr(self, "_llms"):
@@ -476,6 +484,21 @@ class TPURuntime:
             raise KeyError(
                 f"LLM '{name}' not registered; known: {list(llms)}"
             ) from None
+
+    # -- graceful drain (App.begin_drain calls these) ----------------------
+    def drain(self) -> None:
+        """Close admission on every registered LLM engine (submit ->
+        EngineDraining/503) while their in-flight work runs to
+        completion; batched models keep serving until close() — their
+        executions are milliseconds, not multi-second decodes."""
+        for eng in getattr(self, "_llms", {}).values():
+            eng.drain()
+
+    def drained(self) -> bool:
+        """True once no LLM engine holds in-flight or queued work."""
+        return all(
+            eng.drained() for eng in getattr(self, "_llms", {}).values()
+        )
 
     # -- lifecycle hooks (App.serve/_stop_servers call these) --------------
     async def start_batchers(self) -> None:
@@ -564,6 +587,12 @@ class MockTPU:
 
     async def stop_batchers(self) -> None:
         pass
+
+    def drain(self) -> None:
+        pass
+
+    def drained(self) -> bool:
+        return True
 
     def close(self) -> None:
         pass

@@ -48,6 +48,13 @@ def wrap_handler(fn: Callable, container: Container, timeout_s: float | None) ->
 
     async def h(req: Request) -> Response:
         ctx = Context(req, container)
+        if timeout_s and timeout_s > 0:
+            # absolute wall deadline (perf_counter timebase) handlers can
+            # propagate into work that outlives their await — e.g.
+            # GenRequest(deadline=ctx.deadline): the LLM engine cancels a
+            # slotted decode whose client timed out instead of burning
+            # chip time for an abandoned connection
+            ctx.deadline = time.perf_counter() + timeout_s
         try:
             if timeout_s and timeout_s > 0:
                 result = await asyncio.wait_for(_call_handler(fn, ctx), timeout=timeout_s)
@@ -82,7 +89,17 @@ def health_handler(ctx: Context) -> Any:
     thresholds configured, status flips to "degraded" (HTTP still 200 —
     this is a shed-before-saturation signal for load balancers, not a
     liveness failure) when the PR-2 engine gauges cross them. Unset
-    thresholds keep the legacy always-"UP" behavior."""
+    thresholds keep the legacy always-"UP" behavior.
+
+    A DRAINING app answers 503: readiness must fail the instant a
+    rolling deploy begins so the load balancer stops routing here while
+    in-flight work finishes (docs/advanced-guide/resilience.md).
+    Liveness (/.well-known/alive) stays 200 — the process is healthy,
+    just leaving."""
+    if getattr(ctx.container, "draining", False):
+        from .http.errors import ErrorServiceUnavailable
+
+        raise ErrorServiceUnavailable("draining")
     out = ctx.container.health()
     out["status"] = _serving_status(ctx.container)
     return out

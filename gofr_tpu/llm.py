@@ -92,7 +92,14 @@ from typing import Any, Iterator
 
 import numpy as np
 
-__all__ = ["LLMEngine", "ReplicatedLLMEngine", "GenRequest", "EngineOverloaded"]
+__all__ = [
+    "LLMEngine",
+    "ReplicatedLLMEngine",
+    "GenRequest",
+    "EngineOverloaded",
+    "EngineStoppedError",
+    "EngineDraining",
+]
 
 _EOS_DEFAULT = -1  # no EOS cut by default (random-weight models)
 
@@ -158,6 +165,9 @@ def _register_phase_metrics(metrics) -> None:
     from .profiling import register_compile_metrics
 
     register_compile_metrics(metrics)  # app_jax_* (own registration lock)
+    from .resilience import register_resilience_metrics
+
+    register_resilience_metrics(metrics)  # app_llm_*_total + drain gauge
 
 
 class EngineOverloaded(RuntimeError):
@@ -167,6 +177,23 @@ class EngineOverloaded(RuntimeError):
     translates it without a handler-side catch."""
 
     status_code = 429
+
+
+class EngineStoppedError(RuntimeError):
+    """Raised by submit() on a dead or closed engine. A TYPE, not a
+    string: the replica router's retry loop used to match
+    "engine stopped" in str(e) and silently swallowed any RuntimeError
+    that happened to contain it. Subclasses RuntimeError so callers that
+    caught the old error keep working."""
+
+
+class EngineDraining(RuntimeError):
+    """Raised by submit() while the engine drains (rolling deploy):
+    admission is closed but in-flight work runs to completion. 503 via
+    the statusCodeResponder seam — the load balancer should retry the
+    next pod, not this one."""
+
+    status_code = 503
 
 
 @dataclass(eq=False)  # identity semantics: requests are handles, and the
@@ -181,6 +208,11 @@ class GenRequest:
     # tracing contextvar does not reach (executor pools, user threads);
     # submit() prefers the live contextvar span when one is active.
     traceparent: str | None = None
+    # Absolute wall deadline (time.perf_counter timebase). Past it the
+    # engine cancels the request EVEN WHILE SLOTTED (finish_reason
+    # "deadline") — a decode past its HTTP timeout burns chip time for a
+    # client that already gave up. Handlers pass ctx.deadline here.
+    deadline: float | None = None
     id: int = field(default_factory=itertools.count().__next__)
 
     def __post_init__(self):
@@ -189,7 +221,16 @@ class GenRequest:
         self.emitted = 0
         self.capped = False  # engine reduced max_new_tokens to fit the cache
         self.finish_reason: str | None = None  # "eos" | "length" | "cancelled"
+        #   | "shed" | "deadline" | "error" ("failover" transiently marks a
+        #   request rescued off a dying replica so drain paths skip it)
         self.submitted_at: float | None = None
+        # -- failover state (gofr_tpu.resilience) --
+        # tokens emitted since the last (re)submit: on replica death the
+        # router re-seeds prompt_tokens + history as the continuation
+        # prompt, so the failed-over stream resumes exactly where the
+        # consumer left off (greedy streams are token-identical).
+        self.history: list[int] = []
+        self.retries = 0  # failover re-dispatches consumed
         # -- chunked-prefill scheduler state (engine-maintained) --
         self.prefill_pos = 0  # prompt tokens already appended to slot KV
         self.prefill_done = False  # all prompt tokens resident; decoding
@@ -260,6 +301,8 @@ class LLMEngine:
         device=None,
         max_queue: int | None = None,
         ttft_deadline_ms: float | None = None,
+        step_watchdog_s: float | None = None,
+        fault_injector=None,
         logger=None,
         metrics=None,
         tracer=None,
@@ -349,6 +392,32 @@ class LLMEngine:
         )
         self.rejected = 0  # submit-time cap rejections
         self.shed = 0  # deadline sheds at admission
+        self.deadline_cancels = 0  # mid-flight deadline cancellations
+        # -- resilience (gofr_tpu.resilience; docs/advanced-guide/resilience.md)
+        from .resilience import Heartbeat, default_injector
+
+        # fault-injection seams: disarmed cost is one dict lookup per
+        # check; tests/chaos pass their own injector, production uses the
+        # process default (armable via TPU_LLM_FAULTS)
+        self.faults = fault_injector if fault_injector is not None else default_injector()
+        # heartbeats the step watchdog monitors: the scheduler's blocking
+        # dispatch section and the collector's device fetch
+        self._hb_dispatch = Heartbeat()
+        self._hb_fetch = Heartbeat()
+        if step_watchdog_s is None:
+            step_watchdog_s = float(
+                _os.environ.get("TPU_LLM_STEP_WATCHDOG_S", "0") or 0.0
+            )
+        self.step_watchdog_s = max(0.0, float(step_watchdog_s))
+        self.watchdog = None  # started after the engine threads
+        self._draining = False  # drain(): admission closed, work finishes
+        self._died = False  # _die ran (idempotence + stale-emission guard)
+        self._die_guard = threading.Lock()
+        self.died_reason: str | None = None
+        # replica-failover seam: ReplicatedLLMEngine sets this; _die hands
+        # it every recoverable in-flight/queued request instead of
+        # error-draining them
+        self.failover_hook = None
         self.logger = logger
         self.metrics = metrics
         self.tracer = tracer
@@ -696,11 +765,19 @@ class LLMEngine:
         )
         self._thread.start()
         self._collector.start()
+        if self.step_watchdog_s > 0:
+            from .resilience import StepWatchdog
+
+            # started AFTER _warm: beats wrap serving dispatch/fetch only,
+            # so cold compiles can never trip a seconds-scale threshold
+            self.watchdog = StepWatchdog(self, self.step_watchdog_s)
 
     # -- public API -------------------------------------------------------
     def submit(self, req: GenRequest) -> GenRequest:
         if self._stop:
-            raise RuntimeError("engine stopped")
+            raise EngineStoppedError("engine stopped")
+        if self._draining:
+            raise EngineDraining("engine draining (rolling deploy)")
         plen = len(req.prompt_tokens)
         if plen >= self.max_seq_len:
             raise ValueError(
@@ -717,8 +794,12 @@ class LLMEngine:
                 f"prompt of {plen} tokens leaves no decode room at "
                 f"max_seq_len {self.max_seq_len} (chunk {self.decode_chunk})"
             )
-        if req.max_new_tokens > room:
-            req.max_new_tokens = room
+        # emitted discounts work already done — a failover continuation
+        # re-submits with its history folded into the prompt, and only
+        # the REMAINING tokens need decode room (emitted == 0 for fresh
+        # requests, so this is the original cap there)
+        if req.max_new_tokens - req.emitted > room:
+            req.max_new_tokens = room + req.emitted
             req.capped = True
         if self.max_queue is not None:
             depth = self._admit_q.qsize() + len(self._waiting) + self._admitting
@@ -730,7 +811,10 @@ class LLMEngine:
         now = time.perf_counter()
         req.submitted_at = now
         req.phase = "queued"
-        if self.tracer is not None:
+        if self.tracer is not None and req.span is None:
+            # span is None except for failover continuations, whose
+            # llm.request span from the original submit stays open across
+            # replicas — a second start would orphan the first
             # Contextvar capture happens HERE, on the submitting thread —
             # the scheduler/collector threads that serve the request never
             # see the caller's context, so every later phase span is
@@ -812,6 +896,9 @@ class LLMEngine:
                 "load_tokens": self.load_tokens(),
                 "rejected": self.rejected,
                 "shed": self.shed,
+                "deadline_cancels": self.deadline_cancels,
+                "draining": self._draining,
+                "watchdog_trips": self.watchdog.trips if self.watchdog else 0,
                 "kvcache": self.kv.stats(),
                 # recent-window phase latencies (seconds): exact p50/p99
                 # over the last ~512 observations per phase
@@ -890,6 +977,15 @@ class LLMEngine:
         return {
             "label": self.label,
             "alive": self.alive(),
+            "draining": self._draining,
+            "died_reason": self.died_reason,
+            "watchdog": (
+                {"threshold_s": self.step_watchdog_s,
+                 "trips": self.watchdog.trips}
+                if self.watchdog is not None else None
+            ),
+            "faults": self.faults.snapshot(),
+            "deadline_cancels": self.deadline_cancels,
             "slots": self.slots,
             "active": sum(row is not None for row in slot_table),
             "max_seq_len": self.max_seq_len,
@@ -956,6 +1052,70 @@ class LLMEngine:
             and self._collector.is_alive()
         )
 
+    def accepting(self) -> bool:
+        """Routing signal: alive AND taking new work (a draining replica
+        finishes its in-flight requests but must not be fed more)."""
+        return self.alive() and not self._draining
+
+    def drain(self) -> None:
+        """Graceful-drain entry (rolling deploy): close admission —
+        submit() raises EngineDraining (503) — while every slotted and
+        queued request runs to completion. The app lifecycle polls
+        drained() under GOFR_DRAIN_DEADLINE_S and then close()s."""
+        self._draining = True
+        if self.metrics is not None:
+            self.metrics.set_gauge(
+                "app_llm_drain_state", 1.0, model=self.label
+            )
+        self._kick.set()
+
+    def drained(self) -> bool:
+        """True once no request holds a slot, waits, or is in flight.
+        A DEAD engine is vacuously drained — its requests were rescued
+        or closed by _die, and in the wedged-lock watchdog case the lock
+        below is held forever by the hung device call (the drain poll
+        must not block on a corpse)."""
+        if self._died:
+            return True
+        with self._lock:
+            return (
+                self.load() == 0
+                and not self._inflight
+                and self._processing is None
+            )
+
+    # -- fault-injection seams (gofr_tpu.resilience.faults) ---------------
+    def _fault(self, point: str) -> None:
+        """Raise-kind seam: InjectedFault when `point` is armed for this
+        engine label. Disarmed cost: one dict lookup."""
+        spec = self.faults.take(point, self.label)
+        if spec is None:
+            return
+        self._count_fault(point)
+        from .resilience import InjectedFault
+
+        raise InjectedFault(spec.message)
+
+    def _fault_latency(self) -> None:
+        """Sleep-kind seam: a wedged device transfer, as the host sees
+        one — the blocking happens outside the engine lock, exactly where
+        a real fetch blocks, so the step watchdog can convert it."""
+        spec = self.faults.take("step_latency", self.label)
+        if spec is None:
+            return
+        self._count_fault("step_latency")
+        from .resilience.faults import sleep_for
+
+        sleep_for(spec)
+
+    def _count_fault(self, point: str) -> None:
+        if self.logger is not None:
+            self.logger.warn(f"fault injection: {point} fired on {self.label}")
+        if self.metrics is not None:
+            self.metrics.increment_counter(
+                "app_llm_faults_injected_total", point=point, model=self.label
+            )
+
     def _zero_state_gauges(self) -> None:
         """A stopped engine must not keep exporting its last live
         occupancy/backlog — dashboards and autoscaling would read load
@@ -968,6 +1128,7 @@ class LLMEngine:
             "app_llm_queue_depth",
             "app_llm_admission_backlog",
             "app_llm_step_budget_utilization",
+            "app_llm_drain_state",
         ):
             self.metrics.set_gauge(name, 0.0, model=self.label)
 
@@ -1286,6 +1447,7 @@ class LLMEngine:
                 else:
                     kept.append(r)
             self._waiting = kept
+        self._expire_deadlines(time.perf_counter())
         if self.logger is not None:
             # queue-side terminations (cancelled in the drain, shed above)
             # have no collector iteration to flush them — do it here, on
@@ -1307,6 +1469,51 @@ class LLMEngine:
                 "app_llm_admission_backlog", float(self._admitting),
                 model=self.label,
             )
+
+    def _expire_deadlines(self, now: float) -> None:
+        """Cancel every request whose wall deadline passed — INCLUDING
+        slotted ones. ttft_deadline_ms only sheds at admission; before
+        this sweep a decode past its HTTP timeout kept burning chip time
+        for a client that already hung up. Cancelled occupants free their
+        slot through the virtual-free path (same machinery as a user
+        cancel), so the next admission reuses the slot immediately. Runs
+        once per scheduler pass: O(slots + waiting), no device work."""
+        expired: list[GenRequest] = []
+        with self._lock:
+            for slot, r in enumerate(self._slot_req):
+                if (
+                    r is not None
+                    and r.deadline is not None
+                    and now > r.deadline
+                    and r.finish_reason is None
+                ):
+                    expired.append(r)
+                    self._slot_req[slot] = None
+            if self._waiting:
+                kept = []
+                for r in self._waiting:
+                    if (
+                        r.deadline is not None
+                        and now > r.deadline
+                        and r.finish_reason is None
+                    ):
+                        expired.append(r)
+                    else:
+                        kept.append(r)
+                self._waiting = kept
+            for r in expired:
+                r.cancelled = True  # in-flight snapshots drop its tokens
+                r.finish_reason = "deadline"
+                self.deadline_cancels += 1
+                self._observe_finish(r, now)
+                r.out.put(None)
+        if expired:
+            self._kick.set()
+            if self.metrics is not None:
+                self.metrics.increment_counter(
+                    "app_llm_deadline_cancels_total",
+                    by=float(len(expired)), model=self.label,
+                )
 
     def _admit(self) -> bool:
         """Admission entry, called once per scheduler pass (THE seam:
@@ -1384,6 +1591,7 @@ class LLMEngine:
         free: list[int],
     ) -> bool:
         jnp = self._jnp
+        self._fault("admission_oom")  # chaos seam: callers requeue stranded
         try:
             self._admit_exact_hits(hits, free)
         finally:
@@ -1411,9 +1619,10 @@ class LLMEngine:
                 pack[j, -2] = n
                 pack[j, -1] = np.float32(r.temperature).view(np.int32)
             t0 = time.perf_counter()
-            first_dev, new_cache, logits_dev, self._rng = self._prefill_op(
-                self.params, jnp.asarray(pack), self._rng,
-            )
+            with self._hb_dispatch.beat("dispatch:prefill"):
+                first_dev, new_cache, logits_dev, self._rng = self._prefill_op(
+                    self.params, jnp.asarray(pack), self._rng,
+                )
             if self.metrics is not None:
                 self.metrics.record_histogram(
                     "app_tpu_stats", time.perf_counter() - t0,
@@ -1534,6 +1743,7 @@ class LLMEngine:
         self._drain_and_observe(busy)
         if not self._waiting or not free:
             return False
+        self._fault("admission_oom")  # chaos seam: nothing pulled yet
         pulled = self._waiting[: len(free)]
         self._waiting = self._waiting[len(free):]
         self._admitting += len(pulled)
@@ -1858,6 +2068,15 @@ class LLMEngine:
         not the emit loop's position within the batch)."""
         if r.finish_reason is not None:
             return  # already finished; stale chunk overlap
+        if self._died:
+            # a dying engine must NEVER emit: its recoverable requests are
+            # (or are about to be) rescued by the failover hook, and a
+            # late emission here would race the continuation's stream on
+            # the replacement replica (duplicate tokens). The check runs
+            # under _lock — the same lock _die holds while rescuing — so
+            # an emission is either fully before the rescue (counted in
+            # history) or fully dropped.
+            return
         if now is None:
             now = time.perf_counter()
         finish = None
@@ -1884,6 +2103,7 @@ class LLMEngine:
                         )
             r.out.put(toks)
             r.emitted += len(toks)
+            r.history.extend(toks)  # failover continuation seed
             self._load_credit(r, len(toks))
         if finish is None and r.emitted >= r.max_new_tokens:
             finish = "length"
@@ -1926,10 +2146,13 @@ class LLMEngine:
                 if needed_steps <= self._chunk_short
                 else self.decode_chunk
             )
+            self._fault("device_step")
             t0 = time.perf_counter()
-            toks, last, self.cache, self._rng = self._chunk_ops[k](
-                self.params, self._tail, self.cache, self._active, self._temps, self._rng,
-            )
+            with self._hb_dispatch.beat("dispatch:chunk"):
+                toks, last, self.cache, self._rng = self._chunk_ops[k](
+                    self.params, self._tail, self.cache,
+                    self._active, self._temps, self._rng,
+                )
             self._tail = last
             self._start_fetch(toks)
             self._inflight.append(("chunk", toks, snapshot, k, t0))
@@ -1964,6 +2187,7 @@ class LLMEngine:
         False when every queued prefill row turned out stale
         (reassigned/cancelled)."""
         jnp = self._jnp
+        self._fault("device_step")  # before any cursor mutation
         with self._work_cv:
             # purge stale prefill rows (cancelled, or slot reassigned)
             rows: list[tuple[GenRequest, int]] = []  # (request, n_new)
@@ -2043,11 +2267,12 @@ class LLMEngine:
                     finishes.append((j, r.slot, r))
             op = self._step_ops[shape]
             t0 = time.perf_counter()
-            first_dev, logits_dev, toks_dev, last, cache, active, temps, rng = op(
-                self.params, self.cache, self._tail, self._active,
-                self._temps, jnp.asarray(pack), jnp.asarray(meta),
-                self._rng,
-            )
+            with self._hb_dispatch.beat("dispatch:step"):
+                first_dev, logits_dev, toks_dev, last, cache, active, temps, rng = op(
+                    self.params, self.cache, self._tail, self._active,
+                    self._temps, jnp.asarray(pack), jnp.asarray(meta),
+                    self._rng,
+                )
             self._tail = last
             self.cache, self._active, self._temps, self._rng = (
                 cache, active, temps, rng,
@@ -2312,6 +2537,13 @@ class LLMEngine:
         jnp = self._jnp
         try:
             while not self._stop:
+                if self.faults.take("replica_kill", self.label) is not None:
+                    # terminal chaos: the whole-replica death the failover
+                    # and supervisor paths exist for (NOT routed through
+                    # the per-iteration recovery below — a kill is final)
+                    self._count_fault("replica_kill")
+                    self._die("fault injection: replica_kill")
+                    break
                 try:
                     did = self._admit()
                     if self._stop:
@@ -2361,24 +2593,118 @@ class LLMEngine:
             if not self._stop:
                 self._die("scheduler thread exited unexpectedly")
 
-    def _die(self, why: str) -> None:
-        """Terminal thread failure: mark the engine dead (alive() -> False,
-        submit() refuses), then end-of-stream every reachable request —
-        occupants, in-flight snapshots, the waiting list, and the admit
-        queue — so no consumer blocks until its stream timeout."""
+    def _die(self, why: str, lock_timeout: float | None = None) -> None:
+        """Terminal failure: mark the engine dead (alive() -> False,
+        submit() refuses), hand every RECOVERABLE request to the failover
+        hook when one is wired (ReplicatedLLMEngine re-dispatches them to
+        a live replica), then end-of-stream everything else — occupants,
+        in-flight snapshots, the waiting list, and the admit queue — so
+        no consumer blocks until its stream timeout.
+
+        Idempotent (the watchdog, the scheduler's finally, and the
+        collector's finally can race). `lock_timeout` bounds the lock
+        acquisition for callers that suspect the lock is WEDGED under a
+        hung device call (the watchdog): on timeout the engine is still
+        marked dead — the router stops feeding it and the supervisor
+        replaces it — but the drain is skipped and the hung entries'
+        consumers hit their stream timeout (nothing else is safe to do
+        from outside the critical section)."""
+        with self._die_guard:
+            if self._died:
+                return
+            self._died = True
         self._stop = True
+        self.died_reason = why
         if self.logger is not None:
             self.logger.error(f"LLM engine died: {why}")
-        try:
-            self._recover_all()
-        except Exception:  # noqa: BLE001 — draining must not re-raise
-            pass
-        self._drain_pending()
+        if lock_timeout is None:
+            acquired = self._lock.acquire()
+        else:
+            acquired = self._lock.acquire(timeout=lock_timeout)
+        rescued: list[GenRequest] = []
+        if acquired:
+            try:
+                if self.failover_hook is not None:
+                    rescued = self._extract_recoverable()
+                try:
+                    self._recover_all()
+                except Exception:  # noqa: BLE001 — draining must not re-raise
+                    pass
+                self._drain_pending()
+            finally:
+                self._lock.release()
+        elif self.logger is not None:
+            self.logger.error(
+                "LLM engine lock wedged while dying; marked dead without "
+                "drain (in-flight consumers will hit their stream timeout)"
+            )
         self._zero_state_gauges()
         self._teardown_profiling()
         self._kick.set()
-        with self._work_cv:
-            self._work_cv.notify_all()
+        if acquired:
+            with self._work_cv:
+                self._work_cv.notify_all()
+        if rescued:
+            # OUTSIDE the lock: the hook submits into OTHER engines and
+            # must not nest their locks under ours
+            try:
+                self.failover_hook(rescued)
+            except Exception as e:  # noqa: BLE001 — rescue must terminate
+                if self.logger is not None:
+                    self.logger.error(f"failover hook failed: {e!r}")
+                for r in rescued:
+                    if r.finish_reason == "failover":
+                        r.finish_reason = "error"
+                        r.out.put(None)
+
+    def _extract_recoverable(self) -> list[GenRequest]:
+        """Collect every request a replacement replica could finish —
+        slotted, mid-prefill, riding an in-flight snapshot, waiting, or
+        still in the admit queue — and mark each finish_reason="failover"
+        so the regular die-drain paths (which close only requests with
+        finish_reason None) walk straight past them. The failover hook
+        clears the marker on re-dispatch or replaces it with "error".
+        Call with the lock held. Returned in submit order (ids are a
+        process-global monotone counter)."""
+        rescued: dict[int, GenRequest] = {}
+
+        def take(r: GenRequest | None) -> None:
+            if r is not None and r.finish_reason is None and not r.cancelled:
+                rescued[r.id] = r
+
+        for r in self._slot_req:
+            take(r)
+        entries = list(self._inflight)
+        if self._processing is not None:
+            entries.append(self._processing)
+        for e in entries:
+            for r in self._entry_requests(e):
+                take(r)
+        for r in self._prefilling:
+            take(r)
+        for r in self._waiting:
+            take(r)
+        # the admit queue must be drained here (not left to
+        # _drain_pending, which would close rescued members): pulled
+        # non-recoverable entries get their end-of-stream immediately
+        now = time.perf_counter()
+        while True:
+            try:
+                r = self._admit_q.get_nowait()
+            except queue.Empty:
+                break
+            if r is None:
+                continue
+            if r.finish_reason is None and not r.cancelled:
+                take(r)
+            elif r.finish_reason is None:
+                r.finish_reason = "cancelled"
+                self._observe_finish(r, now)
+                r.out.put(None)
+        out = [rescued[i] for i in sorted(rescued)]
+        for r in out:
+            r.finish_reason = "failover"
+        return out
 
     def _recover_all(self) -> None:
         """Full-stop recovery: close every request reachable from in-flight
@@ -2456,7 +2782,9 @@ class LLMEngine:
                         self._jumped = False
                 self._processing = entry
             try:
-                self._process_entry(entry)
+                with self._hb_fetch.beat(f"fetch:{entry[0]}"):
+                    self._fault_latency()  # chaos: a wedged transfer
+                    self._process_entry(entry)
                 self._fetch_fail_streak = 0
             except Exception as e:  # noqa: BLE001
                 if self.logger is not None:
@@ -2598,9 +2926,12 @@ class ReplicatedLLMEngine:
         meshes: list | None = None,
         router: str = "least_loaded",
         logger=None,
+        supervise: bool = True,
+        failover_retries: int | None = None,
         **engine_kw,
     ):
         import jax
+        import os as _os
 
         if router not in ("least_loaded", "round_robin"):
             raise ValueError(f"unknown router {router!r}")
@@ -2623,8 +2954,28 @@ class ReplicatedLLMEngine:
         if logger is not None:
             logger.info(
                 f"replicated LLM serving: {len(specs)} replicas, "
-                f"router={router}"
+                f"router={router}, supervise={supervise}"
             )
+        # Rebuild inputs retained for the supervisor: a dead replica is
+        # reconstructed from the SAME cfg/params/spec on the same
+        # device/submesh. Holding `params` keeps the host copy alive for
+        # the process lifetime — the price of restartability (pass
+        # supervise=False to opt out and drop nothing extra: the engines
+        # hold their device copies either way).
+        self.logger = logger
+        self.metrics = engine_kw.get("metrics")
+        self.label = engine_kw.pop("kv_label", "llm")
+        self._cfg, self._params = cfg, params
+        self._specs = specs
+        self._engine_kw = engine_kw
+        if failover_retries is None:
+            failover_retries = int(
+                _os.environ.get("TPU_LLM_FAILOVER_RETRIES", "2")
+            )
+        self.failover_retries = max(0, failover_retries)
+        self.failovers = 0  # requests re-dispatched off a dead replica
+        self.failover_errors = 0  # rescues that found no live replica
+        self._draining = False
         # build replicas concurrently: XLA releases the GIL while compiling,
         # so N warmups overlap instead of serializing construction N-fold.
         # On any failure, close the replicas that DID come up — each holds
@@ -2632,16 +2983,10 @@ class ReplicatedLLMEngine:
         # would otherwise leak with no handle to free them.
         from concurrent.futures import ThreadPoolExecutor
 
-        kv_label = engine_kw.pop("kv_label", "llm")
         with ThreadPoolExecutor(max_workers=len(specs)) as pool:
             futures = [
-                # per-replica kv label: N replicas sharing one label set
-                # would clobber each other's resident-bytes gauges
-                pool.submit(
-                    LLMEngine, cfg, params, logger=logger,
-                    kv_label=f"{kv_label}/r{i}", **spec, **engine_kw,
-                )
-                for i, spec in enumerate(specs)
+                pool.submit(self._build_replica, i)
+                for i in range(len(specs))
             ]
             engines, first_err = [], None
             for f in futures:
@@ -2654,15 +2999,51 @@ class ReplicatedLLMEngine:
                 e.close()
             raise first_err
         self.engines = engines
+        self.supervisor = None
+        if supervise:
+            from .resilience import ReplicaSupervisor
+
+            self.supervisor = ReplicaSupervisor(
+                self,
+                interval_s=float(
+                    _os.environ.get("TPU_LLM_SUPERVISOR_INTERVAL_S", "0.5")
+                ),
+                backoff_s=float(
+                    _os.environ.get("TPU_LLM_RESTART_BACKOFF_S", "1.0")
+                ),
+                backoff_max_s=float(
+                    _os.environ.get("TPU_LLM_RESTART_BACKOFF_MAX_S", "30")
+                ),
+            )
+
+    def _build_replica(self, i: int) -> "LLMEngine":
+        """Construct (and warm) replica slot i from its retained spec —
+        the same path at first build and at supervised restart. Wires the
+        failover hook so the new replica's deaths rescue in-flight work
+        too. Per-replica kv label: N replicas sharing one label set would
+        clobber each other's resident-bytes gauges."""
+        eng = LLMEngine(
+            self._cfg, self._params, logger=self.logger,
+            kv_label=f"{self.label}/r{i}", **self._specs[i],
+            **self._engine_kw,
+        )
+        eng.failover_hook = self._failover
+        return eng
 
     # -- routing -----------------------------------------------------------
-    def _pick(self) -> "LLMEngine":
-        """Route among LIVE replicas only. A replica whose scheduler or
-        collector thread died (LLMEngine._die) ends its own queued
-        requests; the router's job is to stop feeding it new ones."""
-        live = [e for e in self.engines if e.alive()]
+    def _pick(self, exclude: set | frozenset = frozenset()) -> "LLMEngine":
+        """Route among replicas that ACCEPT work — alive and not
+        draining. A replica whose scheduler or collector thread died
+        (LLMEngine._die) hands its queued requests to the failover hook;
+        the router's job is to stop feeding it new ones."""
+        live = [
+            e for e in self.engines
+            if e.accepting() and id(e) not in exclude
+        ]
         if not live:
-            raise RuntimeError("all replicas dead")
+            if any(e.alive() for e in self.engines):
+                raise EngineDraining("all replicas draining")
+            raise EngineStoppedError("all replicas dead")
         if self.router == "round_robin" or len(live) == 1:
             return live[next(self._rr) % len(live)]
         # token-weighted least-loaded: queued device work, not request
@@ -2672,16 +3053,96 @@ class ReplicatedLLMEngine:
 
     # -- LLMEngine surface -------------------------------------------------
     def submit(self, req: GenRequest) -> GenRequest:
-        # a replica can die between _pick and submit; retry on the
-        # survivors (EngineOverloaded and validation errors propagate)
-        for _ in range(len(self.engines)):
-            eng = self._pick()
+        # a replica can die between _pick and submit; retry on the LIVE
+        # survivors — typed EngineStoppedError, never string matching
+        # (EngineOverloaded, EngineDraining, and validation errors
+        # propagate). Bounded: the supervisor may swap replacements in
+        # mid-loop, so the exclusion set alone is not a terminator.
+        tried: set[int] = set()
+        for _ in range(2 * len(self.engines) + 2):
+            eng = self._pick(exclude=tried)
             try:
                 return eng.submit(req)
-            except RuntimeError as e:
-                if "engine stopped" not in str(e):
-                    raise
-        raise RuntimeError("all replicas dead")
+            except EngineStoppedError:
+                tried.add(id(eng))
+        raise EngineStoppedError("all replicas dead")
+
+    # -- in-flight failover (gofr_tpu.resilience) --------------------------
+    def _failover(self, reqs: list[GenRequest]) -> None:
+        """A dying replica's rescued requests, re-dispatched to the live
+        survivors. Each continuation re-seeds its prompt with everything
+        already emitted (prompt + history), so the consumer's stream
+        resumes exactly where it left off — no duplicate and no missing
+        token, token-identical for greedy decodes (sampled decodes
+        continue with fresh randomness). Errors surface only when the
+        per-request retry budget is spent or no live replica remains."""
+        # ONE overload-wait window shared by the whole batch: a saturated
+        # survivor must cost the rescue ~5 s total, not 5 s per rescued
+        # request serially on the dying engine's thread
+        batch_deadline = time.perf_counter() + 5.0
+        for r in reqs:
+            r.retries += 1
+            placed = False
+            if r.retries <= self.failover_retries:
+                if r.history:
+                    r.prompt_tokens = list(r.prompt_tokens) + r.history
+                    r.history = []
+                # reset engine-owned scheduling state; consumer-facing
+                # state (out queue, emitted, span) carries over
+                r.finish_reason = None
+                r.phase = "queued"
+                r.prefill_pos = 0
+                r.prefill_done = False
+                r.slot = None
+                r._rows_hi = 0
+                r._prefill_t0 = None
+                r._load_acct = 0
+                tried: set[int] = set()
+                # A momentarily FULL live replica is not a dead one:
+                # excluding it would error rescued work while capacity
+                # exists seconds later (the overload+death case failover
+                # exists for). Overloads wait-and-retry inside the shared
+                # window; only stopped/draining replicas are excluded.
+                first_try = True
+                while first_try or time.perf_counter() < batch_deadline:
+                    first_try = False
+                    try:
+                        eng = self._pick(exclude=tried)
+                    except (EngineStoppedError, EngineDraining):
+                        break
+                    try:
+                        eng.submit(r)
+                        placed = True
+                        break
+                    except (EngineStoppedError, EngineDraining):
+                        tried.add(id(eng))
+                    except EngineOverloaded:
+                        time.sleep(0.05)
+                    except ValueError:
+                        break  # continuation no longer fits the cache
+            if placed:
+                self.failovers += 1
+                if self.metrics is not None:
+                    self.metrics.increment_counter(
+                        "app_llm_failovers_total", model=self.label
+                    )
+                if self.logger is not None:
+                    self.logger.warn(
+                        f"failover: request {r.id} re-dispatched "
+                        f"(retry {r.retries}/{self.failover_retries})"
+                    )
+            else:
+                self.failover_errors += 1
+                if self.metrics is not None:
+                    self.metrics.increment_counter(
+                        "app_llm_failover_errors_total", model=self.label
+                    )
+                r.finish_reason = "error"
+                if r.span is not None and r.span.end_ns == 0:
+                    r.span.set_attribute("llm.finish_reason", "error")
+                    r.span.set_status("ERROR")
+                    r.span.end()
+                r.out.put(None)
 
     def generate(self, prompt_tokens: list[int], **kw) -> list[int]:
         return self.submit(GenRequest(prompt_tokens, **kw)).tokens()
@@ -2698,6 +3159,10 @@ class ReplicatedLLMEngine:
             "replicas": len(per),
             "replicas_alive": sum(e.alive() for e in self.engines),
             "router": self.router,
+            "draining": self._draining,
+            "failovers": self.failovers,
+            "failover_errors": self.failover_errors,
+            "restarts": self.supervisor.restarts if self.supervisor else 0,
             "slots": sum(s["slots"] for s in per),
             "active": sum(s["active"] for s in per),
             "waiting": sum(s["waiting"] for s in per),
@@ -2767,10 +3232,36 @@ class ReplicatedLLMEngine:
             "router": self.router,
             "replicas": len(self.engines),
             "replicas_alive": sum(e.alive() for e in self.engines),
+            "draining": self._draining,
+            "failovers": self.failovers,
+            "failover_errors": self.failover_errors,
+            "failover_retries": self.failover_retries,
+            "supervisor": (
+                self.supervisor.snapshot()
+                if self.supervisor is not None else None
+            ),
             "phases": self._merged_phases(),
             "per_replica": [e.debug_state() for e in self.engines],
         }
 
+    def drain(self) -> None:
+        """Fleet drain: stop the supervisor from rebuilding (the process
+        is going down), close admission on every live replica, let
+        in-flight work finish. The app lifecycle polls drained()."""
+        self._draining = True
+        for e in self.engines:
+            if e.alive():
+                e.drain()
+
+    def drained(self) -> bool:
+        # aliveness FIRST: e.drained() on a watchdog-killed replica whose
+        # lock is wedged under a hung device call would block the drain
+        # poll forever (the deadline could never fire)
+        return all(not e.alive() or e.drained() for e in self.engines)
+
     def close(self) -> None:
+        self._draining = True  # a rebuild racing close must not be routed
+        if self.supervisor is not None:
+            self.supervisor.close()
         for e in self.engines:
             e.close()

@@ -1,0 +1,81 @@
+"""Resilience: fault injection, step watchdog, replica supervision, drain.
+
+The reference repo frames resilience as a first-class capability — its
+outbound HTTP client carries a circuit breaker with background health
+probes, and every app exposes liveness/readiness checks. This package is
+the TPU-serving counterpart BELOW the HTTP layer, where the failure
+modes are different: an XLA fault kills an engine thread, an HBM OOM
+aborts an admission, a wedged transfer hangs a step forever, and a
+process being rolled must finish in-flight decodes before dying.
+
+Pieces (docs/advanced-guide/resilience.md has the failure model):
+
+- :class:`FaultInjector` (faults.py) — named failure points toggled per
+  point via the Python API or ``TPU_LLM_FAULTS``, so every recovery path
+  is exercised deterministically in tier-1 and the CI chaos smoke.
+- :class:`Heartbeat` / :class:`StepWatchdog` (watchdog.py) — convert a
+  device step exceeding ``TPU_LLM_STEP_WATCHDOG_S`` into a replica death
+  with a distinct reason (a hang used to block invisibly forever).
+- :class:`ReplicaSupervisor` (supervisor.py) — rebuild dead replicas
+  (construct + warm on the same device/submesh) under capped exponential
+  backoff and return them to the routing set.
+- In-flight failover and graceful drain live in ``gofr_tpu.llm`` /
+  ``gofr_tpu.app`` (they ARE the engine/app lifecycle); this package
+  owns their metrics registration so the series exist wherever any
+  resilience feature is active.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from .faults import FAULT_POINTS, FaultInjector, InjectedFault, default_injector
+from .supervisor import ReplicaSupervisor
+from .watchdog import Heartbeat, StepWatchdog
+
+__all__ = [
+    "FAULT_POINTS",
+    "FaultInjector",
+    "Heartbeat",
+    "InjectedFault",
+    "ReplicaSupervisor",
+    "StepWatchdog",
+    "default_injector",
+    "register_resilience_metrics",
+]
+
+# Serializes registration across engines (replicas register concurrently;
+# same rationale as llm.py's _OBS_REG_LOCK).
+_REG_LOCK = threading.Lock()
+
+
+def register_resilience_metrics(metrics) -> None:
+    """The resilience instrument set, registered once per process (series
+    separate by the model label). Counters are monotone trip/restart
+    tallies; the drain gauge is the rolling-deploy signal (0 serving,
+    1 draining)."""
+    with _REG_LOCK:
+        for name, desc in (
+            ("app_llm_replica_restarts_total",
+             "llm replicas rebuilt and routed back by the supervisor"),
+            ("app_llm_failovers_total",
+             "llm in-flight requests re-dispatched off a dead replica"),
+            ("app_llm_failover_errors_total",
+             "llm failover requests errored out (no live replica or "
+             "retry budget exhausted)"),
+            ("app_llm_watchdog_trips_total",
+             "llm device steps converted to replica death by the step "
+             "watchdog"),
+            ("app_llm_deadline_cancels_total",
+             "llm requests cancelled mid-flight because their deadline "
+             "passed"),
+            ("app_llm_faults_injected_total",
+             "faults fired by the injection harness (chaos only)"),
+        ):
+            if not metrics.has(name):
+                metrics.new_counter(name, desc)
+        if not metrics.has("app_llm_drain_state"):
+            metrics.new_gauge(
+                "app_llm_drain_state",
+                "llm engine drain state (0 serving, 1 draining)",
+            )

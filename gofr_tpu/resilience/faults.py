@@ -1,0 +1,211 @@
+"""Deterministic fault injection for the serving path.
+
+The recovery code in gofr_tpu.llm (per-iteration scheduler recovery,
+collector fetch retries, replica failover, the step watchdog, supervised
+restart) is exactly the code that never runs in a healthy test
+environment — a CPU backend does not throw XLA faults on demand. The
+injector gives every recovery path a named, countable trigger so tier-1
+tests and the CI chaos smoke (scripts/smoke_chaos.py) exercise them
+deterministically, the same way the reference repo's circuit breaker is
+driven by a fake failing service rather than a real outage.
+
+Named failure points (armed per point, optionally per engine label):
+
+- ``device_step``    — raise ``InjectedFault`` at the next device-step
+                       dispatch (scheduler-side; exercises per-iteration
+                       recovery + stranded-request requeue).
+- ``step_latency``   — sleep ``delay`` seconds inside the next device
+                       fetch (collector-side; a hung step, the watchdog's
+                       prey — the sleep happens OUTSIDE the engine lock,
+                       like a real wedged transfer).
+- ``admission_oom``  — raise at the next admission before any slot is
+                       assigned (exercises ``_requeue_stranded``).
+- ``replica_kill``   — the next scheduler pass calls ``_die`` (terminal
+                       replica death; exercises in-flight failover and
+                       supervised restart).
+
+Arming: the Python API (``injector.arm(point, ...)``) for tests and the
+chaos smoke, or the ``TPU_LLM_FAULTS`` env var for a black-box process —
+a comma list of ``point[=count[:delay_s]]`` entries parsed once when the
+process-default injector is first built, e.g.
+``TPU_LLM_FAULTS="replica_kill=1,step_latency=1:5.0"``.
+
+A disarmed injector costs one dict lookup per check — the seams stay in
+production code (the same argument as the reference keeping its circuit
+breaker in the client, not in a test build).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+
+__all__ = ["FaultInjector", "InjectedFault", "default_injector", "FAULT_POINTS"]
+
+FAULT_POINTS = ("device_step", "step_latency", "admission_oom", "replica_kill")
+
+
+class InjectedFault(RuntimeError):
+    """Raised by an armed raise-kind failure point. A distinct type so
+    tests can tell an injected failure from a real one; engine recovery
+    treats it like any other device error (that is the point)."""
+
+
+@dataclass
+class _Spec:
+    point: str
+    count: int = 1  # fires remaining; <0 = unlimited
+    # Engine-label anchor: exact label or suffix ("/r1" matches "llm/r1"
+    # but NOT "llm/r10" — a substring match would kill the wrong replica
+    # in fleets of >=10). None = any engine.
+    label: str | None = None
+    delay: float = 0.0  # step_latency sleep seconds
+    message: str = ""
+
+    def matches(self, label: str) -> bool:
+        return (
+            self.label is None
+            or label == self.label
+            or label.endswith(self.label)
+        )
+
+
+class FaultInjector:
+    """Thread-safe registry of armed failure points.
+
+    Engines hold one injector (the process default unless a test passes
+    its own) and call :meth:`take` at each seam; a hit decrements the
+    armed count and is tallied in :meth:`fired`.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._armed: dict[str, list[_Spec]] = {}
+        self._fired: dict[str, int] = {}
+
+    def arm(
+        self,
+        point: str,
+        *,
+        count: int = 1,
+        label: str | None = None,
+        delay: float = 0.0,
+        message: str = "",
+    ) -> None:
+        if point not in FAULT_POINTS:
+            raise ValueError(
+                f"unknown fault point {point!r}; known: {FAULT_POINTS}"
+            )
+        spec = _Spec(point=point, count=count, label=label, delay=delay,
+                     message=message or f"injected fault: {point}")
+        with self._lock:
+            self._armed.setdefault(point, []).append(spec)
+
+    def disarm(self, point: str | None = None) -> None:
+        with self._lock:
+            if point is None:
+                self._armed.clear()
+            else:
+                self._armed.pop(point, None)
+
+    def take(self, point: str, label: str = "") -> _Spec | None:
+        """One seam check: the first armed spec matching this engine label
+        fires (its count decrements); None when nothing is armed — the
+        disarmed fast path is a single dict lookup under no lock."""
+        if not self._armed:  # benign race: worst case one extra locked check
+            return None
+        with self._lock:
+            specs = self._armed.get(point)
+            if not specs:
+                return None
+            for spec in specs:
+                if not spec.matches(label):
+                    continue
+                if spec.count == 0:
+                    continue
+                if spec.count > 0:
+                    spec.count -= 1
+                self._fired[point] = self._fired.get(point, 0) + 1
+                if spec.count == 0:
+                    specs.remove(spec)
+                    if not specs:
+                        del self._armed[point]
+                return spec
+            return None
+
+    def fired(self, point: str | None = None) -> int:
+        with self._lock:
+            if point is not None:
+                return self._fired.get(point, 0)
+            return sum(self._fired.values())
+
+    def snapshot(self) -> dict:
+        """Armed/fired view for debug_state()."""
+        with self._lock:
+            return {
+                "armed": {
+                    p: [
+                        {"count": s.count, "label": s.label, "delay": s.delay}
+                        for s in specs
+                    ]
+                    for p, specs in self._armed.items()
+                },
+                "fired": dict(self._fired),
+            }
+
+
+@dataclass
+class _DefaultHolder:
+    injector: FaultInjector | None = None
+    lock: threading.Lock = field(default_factory=threading.Lock)
+
+
+_default = _DefaultHolder()
+
+
+def _arm_from_env(inj: FaultInjector, raw: str, logger=None) -> None:
+    for part in raw.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        point, _, rest = part.partition("=")
+        count, delay = 1, 0.0
+        if rest:
+            cnt, _, d = rest.partition(":")
+            try:
+                count = int(cnt)
+                if d:
+                    delay = float(d)
+            except ValueError:
+                if logger is not None:
+                    logger.warn(f"TPU_LLM_FAULTS: unparseable entry {part!r}")
+                continue
+        try:
+            inj.arm(point.strip(), count=count, delay=delay)
+        except ValueError as e:
+            if logger is not None:
+                logger.warn(f"TPU_LLM_FAULTS: {e}")
+
+
+def default_injector() -> FaultInjector:
+    """Process-default injector, armed once from ``TPU_LLM_FAULTS``.
+    Tests pass their own ``FaultInjector()`` to the engine instead of
+    touching this shared instance."""
+    if _default.injector is None:
+        with _default.lock:
+            if _default.injector is None:
+                inj = FaultInjector()
+                raw = os.environ.get("TPU_LLM_FAULTS", "")
+                if raw:
+                    _arm_from_env(inj, raw)
+                _default.injector = inj
+    return _default.injector
+
+
+def sleep_for(spec: _Spec) -> None:
+    """Serve a step_latency spec: a plain blocking sleep, exactly what a
+    wedged device transfer looks like from the host."""
+    if spec.delay > 0:
+        time.sleep(spec.delay)

@@ -1,0 +1,150 @@
+"""Replica supervisor: rebuild dead replicas under capped backoff.
+
+Before this module a dead replica was permanent: ``ReplicatedLLMEngine``
+stopped routing NEW work to it (llm.py ``_pick``) but nothing ever
+rebuilt it, so one XLA fault cost a replica's worth of fleet capacity
+for the rest of the process lifetime. The supervisor closes the loop the
+way the reference repo's circuit breaker does for outbound services —
+background probes that return a recovered endpoint to rotation — except
+a dead engine cannot "recover": its threads are gone, so recovery means
+CONSTRUCTING a replacement (params re-placed on the same device/submesh,
+executables re-warmed) and swapping it into the routing set.
+
+Policy: capped exponential backoff per replica slot
+(``TPU_LLM_RESTART_BACKOFF_S`` doubling to
+``TPU_LLM_RESTART_BACKOFF_MAX_S``), reset on a successful build. A
+DRAINING fleet never restarts — the process is going down; rebuilding a
+replica there would fight the rolling deploy. Restarts are counted in
+``app_llm_replica_restarts_total`` and the per-slot state is visible in
+``debug_state()["supervisor"]``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+__all__ = ["ReplicaSupervisor"]
+
+
+class ReplicaSupervisor:
+    """Monitor thread over a ReplicatedLLMEngine's replica slots.
+
+    The fleet owns construction (``fleet._build_replica(i)`` carries the
+    per-slot device/mesh spec and the failover-hook wiring); the
+    supervisor owns only the WHEN: detect death, wait out the backoff,
+    swap the replacement in, escalate the backoff on a failed build.
+    """
+
+    def __init__(
+        self,
+        fleet,
+        *,
+        interval_s: float = 0.5,
+        backoff_s: float = 1.0,
+        backoff_max_s: float = 30.0,
+    ):
+        self.fleet = fleet
+        self.interval = interval_s
+        self.backoff0 = backoff_s
+        self.backoff_max = backoff_max_s
+        self.restarts = 0
+        self.restart_failures = 0
+        self._stop = False
+        # per-slot restart state: {slot: {"backoff": s, "next_try": t,
+        # "building": bool, "failures": n}}
+        self._state: dict[int, dict] = {}
+        self._thread = threading.Thread(
+            target=self._run, name="llm-replica-supervisor", daemon=True
+        )
+        self._thread.start()
+
+    # -- monitor loop -----------------------------------------------------
+    def _run(self) -> None:
+        while not self._stop:
+            try:
+                self._scan()
+            except Exception as e:  # noqa: BLE001 — supervisor must survive
+                log = getattr(self.fleet, "logger", None)
+                if log is not None:
+                    log.error(f"replica supervisor scan failed: {e!r}")
+            time.sleep(self.interval)
+
+    def _scan(self) -> None:
+        fleet = self.fleet
+        if self._stop or getattr(fleet, "_draining", False):
+            return
+        now = time.perf_counter()
+        for i, eng in enumerate(list(fleet.engines)):
+            if eng.alive():
+                self._state.pop(i, None)
+                continue
+            st = self._state.setdefault(
+                i, {"backoff": self.backoff0, "next_try": now + self.backoff0,
+                    "failures": 0},
+            )
+            if now < st["next_try"]:
+                continue
+            self._rebuild(i, st)
+
+    def _rebuild(self, i: int, st: dict) -> None:
+        fleet = self.fleet
+        log = getattr(fleet, "logger", None)
+        if log is not None:
+            log.warn(f"replica supervisor: rebuilding dead replica {i}")
+        t0 = time.perf_counter()
+        try:
+            replacement = fleet._build_replica(i)
+        except Exception as e:  # noqa: BLE001 — the device may still be sick
+            self.restart_failures += 1
+            st["failures"] += 1
+            st["backoff"] = min(st["backoff"] * 2.0, self.backoff_max)
+            st["next_try"] = time.perf_counter() + st["backoff"]
+            if log is not None:
+                log.error(
+                    f"replica {i} rebuild failed ({e!r}); next attempt in "
+                    f"{st['backoff']:.1f}s"
+                )
+            return
+        if self._stop or getattr(fleet, "_draining", False):
+            # raced a close/drain: the fleet is going down — do not route
+            # to (or leak) the replacement
+            replacement.close()
+            return
+        fleet.engines[i] = replacement  # atomic item swap: routers see old or new
+        self._state.pop(i, None)
+        self.restarts += 1
+        if fleet.metrics is not None:
+            fleet.metrics.increment_counter(
+                "app_llm_replica_restarts_total", model=fleet.label
+            )
+        if log is not None:
+            log.info(
+                f"replica {i} restarted and routed back in "
+                f"{time.perf_counter() - t0:.1f}s"
+            )
+
+    # -- introspection / lifecycle ---------------------------------------
+    def snapshot(self) -> dict:
+        # list() guards against the supervisor thread resizing the dict
+        # mid-iteration; the values are read torn-tolerantly (debug view)
+        per_slot = {
+            i: {
+                "backoff_s": round(st["backoff"], 2),
+                "failures": st["failures"],
+                "retry_in_s": round(
+                    max(0.0, st["next_try"] - time.perf_counter()), 2
+                ),
+            }
+            for i, st in list(self._state.items())
+        }
+        return {
+            "restarts": self.restarts,
+            "restart_failures": self.restart_failures,
+            "interval_s": self.interval,
+            "pending": per_slot,
+        }
+
+    def close(self) -> None:
+        self._stop = True
+        self._thread.join(timeout=5)

@@ -1,0 +1,135 @@
+"""Step watchdog: convert a hung device step into a detectable death.
+
+``LLMEngine._die`` fires when an engine THREAD exits — but a hung XLA
+execution or wedged device->host transfer never exits; it blocks the
+scheduler or collector inside a C call forever. Before this module a hang
+was invisible: ``alive()`` stayed True, the replica router kept feeding
+the corpse, and every routed consumer blocked until its stream timeout.
+
+The fix is heartbeats plus a monitor. Each engine thread wraps its
+blocking device interaction in a :class:`Heartbeat` beat (dispatch on the
+scheduler, fetch on the collector); the :class:`StepWatchdog` thread
+samples both beats and, when one has been in flight longer than the
+threshold (``TPU_LLM_STEP_WATCHDOG_S``), trips: counts
+``app_llm_watchdog_trips_total``, then drives the engine's ``_die`` with
+a distinct reason so the failover hook rescues the in-flight requests and
+the supervisor schedules a replacement replica.
+
+The die path must tolerate a WEDGED ENGINE LOCK: a hang inside a
+dispatch happens under the scheduler's critical section, so the watchdog
+passes a lock acquisition timeout — if the lock cannot be had, the
+engine is still marked dead (router stops feeding it) and the stuck
+thread is abandoned (Python cannot kill a thread blocked in C; the
+supervisor replaces the whole replica instead).
+
+Compile stalls are deliberately NOT covered: beats wrap serving
+dispatch/fetch only, never ``_warm`` — a cold compile can legitimately
+take minutes and must not trip a seconds-scale watchdog.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from contextlib import contextmanager
+
+__all__ = ["Heartbeat", "StepWatchdog"]
+
+
+class Heartbeat:
+    """One thread's in-flight device operation: (name, started-at).
+
+    Written by the engine thread, read by the watchdog — both touch two
+    slots without a lock, which is safe by ordering: ``begin`` publishes
+    the timestamp BEFORE the name, ``end`` retracts the name first, and
+    the reader starts from the name. A torn read costs one stale sample
+    at the next interval, never a false trip."""
+
+    __slots__ = ("_name", "_t0")
+
+    def __init__(self):
+        self._name: str | None = None
+        self._t0 = 0.0
+
+    def begin(self, name: str) -> None:
+        self._t0 = time.perf_counter()
+        self._name = name
+
+    def end(self) -> None:
+        self._name = None
+
+    @contextmanager
+    def beat(self, name: str):
+        self.begin(name)
+        try:
+            yield
+        finally:
+            self.end()
+
+    def stalled(self) -> tuple[str | None, float]:
+        """(operation name, seconds in flight) — (None, 0.0) when idle."""
+        name = self._name
+        if name is None:
+            return None, 0.0
+        return name, time.perf_counter() - self._t0
+
+
+class StepWatchdog:
+    """Per-engine monitor thread over a set of heartbeats.
+
+    ``threshold_s`` is the step budget; the sampling interval is
+    threshold/4 capped at 1 s, so a hang is converted into a death
+    within threshold + one interval (the acceptance bound). One-shot:
+    after a trip the engine is dead and the thread exits."""
+
+    def __init__(
+        self,
+        engine,
+        threshold_s: float,
+        *,
+        interval_s: float | None = None,
+    ):
+        self.engine = engine
+        self.threshold = float(threshold_s)
+        self.interval = (
+            interval_s if interval_s is not None
+            else max(0.01, min(self.threshold / 4.0, 1.0))
+        )
+        self.trips = 0
+        self._thread = threading.Thread(
+            target=self._run, name="llm-engine-watchdog", daemon=True
+        )
+        self._thread.start()
+
+    def _run(self) -> None:
+        eng = self.engine
+        while not eng._stop:
+            for hb in (eng._hb_dispatch, eng._hb_fetch):
+                name, dt = hb.stalled()
+                if name is not None and dt > self.threshold:
+                    self._trip(name, dt)
+                    return
+            time.sleep(self.interval)
+
+    def _trip(self, name: str, dt: float) -> None:
+        eng = self.engine
+        self.trips += 1
+        if eng.metrics is not None:
+            eng.metrics.increment_counter(
+                "app_llm_watchdog_trips_total", model=eng.label
+            )
+        if eng.logger is not None:
+            eng.logger.error(
+                f"LLM engine watchdog: {name} in flight {dt:.1f}s "
+                f"(threshold {self.threshold:.1f}s) — killing replica"
+            )
+        # The hung call may hold the engine lock (dispatch section): a
+        # bounded acquisition lets _die degrade to mark-dead-only instead
+        # of deadlocking the watchdog thread on the wedged lock.
+        eng._die(
+            f"step watchdog: {name} exceeded {self.threshold:.1f}s",
+            lock_timeout=min(5.0, max(1.0, self.threshold)),
+        )
+
+    def join(self, timeout: float | None = None) -> None:
+        self._thread.join(timeout=timeout)

@@ -1,0 +1,193 @@
+#!/usr/bin/env python
+"""CI chaos smoke: replica kill -> failover -> supervised restart, over
+real sockets.
+
+Boots a 2-replica CPU fleet (two virtual devices) behind a tiny-model
+app, starts a long generation over HTTP, kills the replica serving it
+mid-stream via the fault injector, and asserts the resilience contract
+(docs/advanced-guide/resilience.md):
+
+- the HTTP response completes with the exact tokens of an unfaulted
+  single-engine run (failover continuation, no duplicate/missing token),
+- app_llm_failovers_total increments on /metrics,
+- the supervisor rebuilds the dead replica and routes it back
+  (replicas_alive returns to 2; app_llm_replica_restarts_total on
+  /metrics), and the restored replica serves traffic,
+- POST /.well-known/debug/drain flips readiness to 503.
+
+Usage: JAX_PLATFORMS=cpu python scripts/smoke_chaos.py
+Exit codes: 0 clean, non-zero assertion failure (message on stderr).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# two virtual CPU devices for the two replicas, fast supervisor cadence —
+# BEFORE jax import
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=2"
+    ).strip()
+os.environ.setdefault("TPU_LLM_SUPERVISOR_INTERVAL_S", "0.05")
+os.environ.setdefault("TPU_LLM_RESTART_BACKOFF_S", "0.1")
+
+
+def _wait(pred, timeout: float, what: str) -> None:
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def main() -> int:
+    import jax
+
+    from gofr_tpu import App
+    from gofr_tpu.config import new_mock_config
+    from gofr_tpu.llm import LLMEngine
+    from gofr_tpu.models import TransformerConfig, init_params
+    from gofr_tpu.resilience import FaultInjector
+
+    cfg = TransformerConfig.tiny()
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    assert len(jax.devices()) >= 2, jax.devices()
+    inj = FaultInjector()
+    app = App(config=new_mock_config({
+        "APP_NAME": "chaos-smoke", "HTTP_PORT": "0", "METRICS_PORT": "0",
+        "LOG_LEVEL": "ERROR", "TPU_TELEMETRY_INTERVAL_S": "0",
+        "REQUEST_TIMEOUT": "60",
+    }))
+    app.container.tpu().register_llm(
+        "tiny", cfg, params, replicas=2, slots=2, max_seq_len=128,
+        prefill_buckets=(8,), prefill_chunk=4, step_token_budget=4,
+        decode_chunk=2, lookahead=1, warmup=False, fault_injector=inj,
+    )
+
+    def gen(ctx):
+        body = ctx.bind()
+        out = ctx.tpu().llm("tiny").generate(
+            list(body["tokens"]),
+            max_new_tokens=int(body.get("max_new_tokens", 16)),
+        )
+        return {"tokens": out}
+
+    app.post("/generate", gen)
+    app.run_in_background()
+    base = f"http://127.0.0.1:{app.http_server.port}"
+    mbase = f"http://127.0.0.1:{app.metrics_server.port}"
+    try:
+        rep = app.container.tpu().llm("tiny")
+        prompt = list(range(1, 25))  # 24 tokens -> 6 prefill chunks
+
+        # unfaulted reference: a bare single engine on the same params
+        mono = LLMEngine(
+            cfg, params, slots=2, max_seq_len=128, prefill_buckets=(8,),
+            prefill_chunk=4, step_token_budget=4, decode_chunk=2,
+            warmup=False,
+        )
+        try:
+            want = mono.generate(prompt, max_new_tokens=48)
+        finally:
+            mono.close()
+
+        # long generation over a real socket, on its own thread
+        result: dict = {}
+
+        def client():
+            req = urllib.request.Request(
+                f"{base}/generate",
+                data=json.dumps(
+                    {"tokens": prompt, "max_new_tokens": 48}
+                ).encode(),
+                headers={"Content-Type": "application/json"}, method="POST",
+            )
+            with urllib.request.urlopen(req, timeout=60) as r:
+                result.update(json.loads(r.read())["data"])
+
+        t = threading.Thread(target=client)
+        t.start()
+
+        # find the replica serving it and kill it mid-stream
+        def serving_index():
+            for i, e in enumerate(rep.engines):
+                if any(
+                    r is not None and r.emitted > 0 for r in e._slot_req
+                ):
+                    return i
+            return None
+
+        _wait(lambda: serving_index() is not None, 30, "first token")
+        victim = serving_index()
+        corpse = rep.engines[victim]
+        inj.arm("replica_kill", label=f"/r{victim}")
+        print(f"killed replica {victim} mid-stream")
+
+        t.join(timeout=60)
+        assert not t.is_alive(), "client hung"
+        assert result.get("tokens") == want, (
+            f"failed-over stream diverged: {result.get('tokens')} != {want}"
+        )
+        assert not corpse.alive()
+        assert rep.failovers >= 1, rep.failovers
+        print(f"failover OK: {len(want)} tokens, token-identical, "
+              f"failovers={rep.failovers}")
+
+        # counters on /metrics over the real socket
+        with urllib.request.urlopen(f"{mbase}/metrics", timeout=15) as r:
+            expo = r.read().decode()
+        assert "app_llm_failovers_total" in expo, "failover counter missing"
+
+        # the supervisor rebuilds the corpse and routes it back
+        _wait(
+            lambda: rep.engines[victim] is not corpse
+            and rep.engines[victim].alive(),
+            60, "supervised restart",
+        )
+        assert rep.supervisor.restarts >= 1
+        toks = rep.engines[victim].generate([5, 9, 2], max_new_tokens=4)
+        assert len(toks) == 4, toks
+        st = rep.stats()
+        assert st["replicas_alive"] == 2, st["replicas_alive"]
+        with urllib.request.urlopen(f"{mbase}/metrics", timeout=15) as r:
+            expo = r.read().decode()
+        assert "app_llm_replica_restarts_total" in expo
+        print(f"supervisor OK: replica {victim} restored, "
+              f"restarts={rep.supervisor.restarts}, replicas_alive=2")
+
+        # graceful drain flips readiness to 503
+        req = urllib.request.Request(
+            f"{base}/.well-known/debug/drain", method="POST", data=b""
+        )
+        with urllib.request.urlopen(req, timeout=5) as r:
+            assert json.loads(r.read())["data"]["draining"] is True
+        try:
+            urllib.request.urlopen(f"{base}/.well-known/health", timeout=5)
+            raise AssertionError("health stayed 200 during drain")
+        except urllib.error.HTTPError as e:
+            assert e.code == 503, e.code
+        print("drain OK: readiness 503")
+        print("smoke_chaos: OK")
+        return 0
+    finally:
+        app.shutdown()
+
+
+if __name__ == "__main__":
+    rc = main()
+    sys.stdout.flush()
+    sys.stderr.flush()
+    # _exit skips interpreter teardown (see smoke_profiling.py: XLA
+    # destructors intermittently abort after all work completed)
+    os._exit(rc)

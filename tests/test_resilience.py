@@ -1,0 +1,521 @@
+"""Resilience tests: fault injection, in-flight failover, step watchdog,
+supervised restart, graceful drain, and deadline cancellation.
+
+The load-bearing invariant mirrors test_llm_engine's: recovery may change
+SCHEDULING, never RESULTS — a greedy request that survives a replica kill
+must emit exactly the tokens an unfaulted run would, with no duplicate
+and no missing token, because the failover continuation re-seeds the
+prompt with everything already emitted.
+
+Every fault here is deterministic (gofr_tpu.resilience.faults), so these
+paths run on the CPU backend in tier-1; scripts/smoke_chaos.py drives the
+same machinery over real sockets in CI."""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gofr_tpu.llm import (
+    EngineDraining,
+    EngineStoppedError,
+    GenRequest,
+    LLMEngine,
+    ReplicatedLLMEngine,
+)
+from gofr_tpu.metrics import new_metrics_manager
+from gofr_tpu.models import TransformerConfig, generate, init_params
+from gofr_tpu.resilience import FaultInjector
+
+CFG = TransformerConfig.tiny()
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(jax.random.PRNGKey(0), CFG)
+
+
+def _reference_tokens(params, prompt: list[int], n: int) -> list[int]:
+    toks = jnp.asarray([prompt], jnp.int32)
+    lens = jnp.asarray([len(prompt)], jnp.int32)
+    out = generate(params, CFG, toks, lens, n)
+    return [int(t) for t in np.asarray(out)[0]]
+
+
+def _wait(pred, timeout: float, what: str = "condition") -> None:
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return
+        time.sleep(0.01)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def _fleet(params, inj, *, monkeypatch=None, supervise=False, **kw):
+    """2-replica CPU fleet with small chunks so prefill/decode take many
+    scheduler passes (room to kill mid-flight)."""
+    kw.setdefault("slots", 2)
+    kw.setdefault("max_seq_len", 128)
+    kw.setdefault("prefill_buckets", (8,))
+    kw.setdefault("prefill_chunk", 4)
+    kw.setdefault("step_token_budget", 4)
+    kw.setdefault("decode_chunk", 2)
+    kw.setdefault("lookahead", 1)
+    kw.setdefault("warmup", False)
+    return ReplicatedLLMEngine(
+        CFG, params, replicas=2, fault_injector=inj,
+        supervise=supervise, **kw,
+    )
+
+
+# ---------------------------------------------------------------------------
+# fault injector unit behavior
+# ---------------------------------------------------------------------------
+class TestFaultInjector:
+    def test_arm_take_count(self):
+        inj = FaultInjector()
+        inj.arm("device_step", count=2)
+        assert inj.take("device_step") is not None
+        assert inj.take("device_step") is not None
+        assert inj.take("device_step") is None
+        assert inj.fired("device_step") == 2
+
+    def test_label_targeting(self):
+        inj = FaultInjector()
+        inj.arm("replica_kill", label="llm/r0")
+        assert inj.take("replica_kill", "llm/r1") is None
+        assert inj.take("replica_kill", "llm/r0") is not None
+        assert inj.take("replica_kill", "llm/r0") is None
+
+    def test_unknown_point_rejected(self):
+        with pytest.raises(ValueError, match="unknown fault point"):
+            FaultInjector().arm("nope")
+
+    def test_env_arming(self):
+        from gofr_tpu.resilience.faults import _arm_from_env
+
+        inj = FaultInjector()
+        _arm_from_env(inj, "replica_kill=1,step_latency=2:1.5, bogus=x")
+        snap = inj.snapshot()
+        assert snap["armed"]["replica_kill"][0]["count"] == 1
+        assert snap["armed"]["step_latency"][0] == {
+            "count": 2, "label": None, "delay": 1.5,
+        }
+        assert "bogus" not in snap["armed"]
+
+    def test_disarm(self):
+        inj = FaultInjector()
+        inj.arm("device_step", count=-1)
+        assert inj.take("device_step") is not None
+        inj.disarm("device_step")
+        assert inj.take("device_step") is None
+
+
+# ---------------------------------------------------------------------------
+# typed submit errors (satellite: no more string-matching retries)
+# ---------------------------------------------------------------------------
+class TestTypedErrors:
+    def test_stopped_engine_raises_typed(self, params):
+        eng = LLMEngine(
+            CFG, params, slots=2, max_seq_len=64, prefill_buckets=(8,),
+            warmup=False,
+        )
+        eng.close()
+        with pytest.raises(EngineStoppedError):
+            eng.submit(GenRequest([1, 2], max_new_tokens=2))
+        # back-compat: old callers caught RuntimeError("engine stopped")
+        assert issubclass(EngineStoppedError, RuntimeError)
+
+    def test_replicated_submit_skips_dead_replica(self, params):
+        inj = FaultInjector()
+        rep = _fleet(params, inj)
+        try:
+            inj.arm("replica_kill", label="/r0")
+            _wait(lambda: not rep.engines[0].alive(), 10, "replica 0 death")
+            # every submit lands on the survivor — typed retry, no string match
+            for _ in range(3):
+                toks = rep.generate([5, 9, 2], max_new_tokens=4)
+                assert len(toks) == 4
+            assert rep.engines[1].submitted >= 3
+        finally:
+            rep.close()
+
+    def test_all_dead_raises_typed(self, params):
+        inj = FaultInjector()
+        rep = _fleet(params, inj)
+        try:
+            inj.arm("replica_kill", count=2)
+            _wait(
+                lambda: not any(e.alive() for e in rep.engines), 10,
+                "fleet death",
+            )
+            with pytest.raises(EngineStoppedError, match="all replicas dead"):
+                rep.submit(GenRequest([1, 2], max_new_tokens=2))
+        finally:
+            rep.close()
+
+
+# ---------------------------------------------------------------------------
+# transient injected faults: engine recovers, later traffic unaffected
+# ---------------------------------------------------------------------------
+class TestTransientFaults:
+    def test_admission_oom_is_retried_transparently(self, params):
+        inj = FaultInjector()
+        eng = LLMEngine(
+            CFG, params, slots=2, max_seq_len=64, prefill_buckets=(8,),
+            warmup=False, fault_injector=inj,
+        )
+        try:
+            inj.arm("admission_oom", count=1)
+            # nothing was pulled when the fault fired, so the request is
+            # still waiting and the next pass admits it — the caller never
+            # notices
+            toks = eng.generate([5, 9, 2], max_new_tokens=4)
+            assert toks == _reference_tokens(params, [5, 9, 2], 4)
+            assert inj.fired("admission_oom") == 1
+            assert eng.alive()
+        finally:
+            eng.close()
+
+    def test_device_step_fault_recovers_engine(self, params):
+        inj = FaultInjector()
+        eng = LLMEngine(
+            CFG, params, slots=2, max_seq_len=64, prefill_buckets=(8,),
+            prefill_chunk=4, step_token_budget=4, warmup=False,
+            fault_injector=inj,
+        )
+        try:
+            inj.arm("device_step", count=1)
+            req = eng.submit(GenRequest(list(range(1, 9)), max_new_tokens=4))
+            toks = req.tokens(timeout=30)
+            # the per-iteration recovery closes the in-flight request
+            # (no failover hook on a bare engine) ...
+            assert req.finish_reason in ("cancelled", "length")
+            assert len(toks) <= 4
+            # ... but the ENGINE survives and serves the next request
+            assert eng.alive()
+            toks2 = eng.generate([5, 9, 2], max_new_tokens=4)
+            assert toks2 == _reference_tokens(params, [5, 9, 2], 4)
+        finally:
+            eng.close()
+
+
+# ---------------------------------------------------------------------------
+# tentpole: in-flight failover — token equality across a replica kill
+# ---------------------------------------------------------------------------
+class TestFailover:
+    PROMPT = [5, 9, 2, 11, 7, 3, 13, 1, 4, 6, 8, 10, 12, 14, 15, 16,
+              17, 18, 19, 20, 21, 22, 23, 24]
+
+    def test_kill_mid_decode_token_identical(self, params):
+        inj = FaultInjector()
+        metrics = new_metrics_manager()
+        rep = _fleet(params, inj, metrics=metrics)
+        try:
+            want = _reference_tokens(params, self.PROMPT, 48)
+            req = GenRequest(list(self.PROMPT), max_new_tokens=48)
+            rep.engines[0].submit(req)  # pin to the replica we will kill
+            got: list[int] = []
+            armed = False
+            for t in req.stream(timeout=60):
+                got.append(t)
+                if not armed:
+                    # first token seen -> the request is decoding; kill
+                    # its replica under it
+                    inj.arm("replica_kill", label="/r0")
+                    armed = True
+            assert got == want, "failed-over stream != unfaulted stream"
+            assert req.finish_reason == "length"
+            assert rep.failovers >= 1, "kill landed after completion?"
+            assert not rep.engines[0].alive()
+            assert rep.engines[1].submitted >= 1
+            # counters visible in metrics
+            expo = metrics.render_prometheus()
+            assert "app_llm_failovers_total" in expo
+        finally:
+            rep.close()
+
+    def test_kill_mid_prefill_token_identical(self, params):
+        inj = FaultInjector()
+        rep = _fleet(params, inj)
+        try:
+            want = _reference_tokens(params, self.PROMPT, 8)
+            req = GenRequest(list(self.PROMPT), max_new_tokens=8)
+            rep.engines[0].submit(req)
+            # 24-token prompt / 4-token chunks = 6 unified steps: arm the
+            # kill as soon as the first chunk lands, well before decode
+            _wait(lambda: req.prefill_pos > 0, 20, "first prefill chunk")
+            mid_prefill = not req.prefill_done
+            inj.arm("replica_kill", label="/r0")
+            got = req.tokens(timeout=60)
+            assert got == want
+            assert rep.failovers >= 1
+            assert mid_prefill, "prefill finished before the arm (timing)"
+            assert req.finish_reason == "length"
+        finally:
+            rep.close()
+
+    def test_no_live_replica_errors_out(self, params):
+        inj = FaultInjector()
+        rep = _fleet(params, inj)
+        try:
+            req = GenRequest(list(self.PROMPT), max_new_tokens=48)
+            rep.engines[0].submit(req)
+            _wait(lambda: req.emitted > 0, 30, "first token")
+            inj.arm("replica_kill", count=2)  # both replicas
+            toks = req.tokens(timeout=30)
+            assert req.finish_reason in ("error", "cancelled")
+            assert len(toks) < 48
+            assert rep.failover_errors + rep.failovers >= 1
+        finally:
+            rep.close()
+
+
+# ---------------------------------------------------------------------------
+# step watchdog: a hung step becomes a detectable death
+# ---------------------------------------------------------------------------
+class TestWatchdog:
+    def test_hung_fetch_trips_watchdog(self, params):
+        inj = FaultInjector()
+        # warmed: the dispatch beat covers lazy compiles too, and a cold
+        # compile longer than the threshold would trip the watchdog
+        # (production guidance: warm engines, or threshold > compile time)
+        eng = LLMEngine(
+            CFG, params, slots=2, max_seq_len=64, prefill_buckets=(8,),
+            fault_injector=inj, step_watchdog_s=0.3,
+        )
+        try:
+            inj.arm("step_latency", delay=3.0)
+            t0 = time.time()
+            req = eng.submit(GenRequest([5, 9, 2], max_new_tokens=4))
+            # acceptance bound: threshold + one monitor interval (+ slack
+            # for the slow CI CPU)
+            _wait(lambda: not eng.alive(), 2.5, "watchdog death")
+            assert time.time() - t0 < 3.0, "trip waited out the full hang"
+            assert eng.watchdog is not None and eng.watchdog.trips == 1
+            assert "step watchdog" in (eng.died_reason or "")
+            # the consumer got an end-of-stream, not a hang
+            toks = req.tokens(timeout=10)
+            assert len(toks) < 4
+        finally:
+            eng.close()
+
+    def test_hung_replica_fails_over(self, params):
+        inj = FaultInjector()
+        rep = _fleet(params, inj, step_watchdog_s=0.3, warmup=True)
+        try:
+            want = _reference_tokens(params, [5, 9, 2, 11], 24)
+            req = GenRequest([5, 9, 2, 11], max_new_tokens=24)
+            rep.engines[0].submit(req)
+            _wait(lambda: req.emitted > 0, 30, "first token")
+            inj.arm("step_latency", label="/r0", delay=5.0)
+            got = req.tokens(timeout=30)
+            assert got == want
+            assert not rep.engines[0].alive()
+            assert "step watchdog" in (rep.engines[0].died_reason or "")
+            assert rep.failovers >= 1
+        finally:
+            rep.close()
+
+
+# ---------------------------------------------------------------------------
+# supervised restart: dead replicas return to the routing set
+# ---------------------------------------------------------------------------
+class TestSupervisor:
+    def test_restart_and_route_back(self, params, monkeypatch):
+        monkeypatch.setenv("TPU_LLM_SUPERVISOR_INTERVAL_S", "0.05")
+        monkeypatch.setenv("TPU_LLM_RESTART_BACKOFF_S", "0.1")
+        inj = FaultInjector()
+        metrics = new_metrics_manager()
+        rep = _fleet(params, inj, supervise=True, metrics=metrics)
+        try:
+            corpse = rep.engines[0]
+            inj.arm("replica_kill", label="/r0")
+            _wait(lambda: not corpse.alive(), 10, "replica 0 death")
+            _wait(
+                lambda: rep.engines[0] is not corpse and rep.engines[0].alive(),
+                60, "supervised restart",
+            )
+            assert rep.supervisor.restarts == 1
+            assert rep.stats()["replicas_alive"] == 2
+            # the replacement serves — and its replica label is the same
+            toks = rep.engines[0].generate([5, 9, 2], max_new_tokens=4)
+            assert toks == _reference_tokens(params, [5, 9, 2], 4)
+            assert rep.engines[0].label == corpse.label
+            # restart visible in metrics and debug_state
+            assert "app_llm_replica_restarts_total" in metrics.render_prometheus()
+            dbg = rep.debug_state()
+            assert dbg["supervisor"]["restarts"] == 1
+            assert dbg["replicas_alive"] == 2
+        finally:
+            rep.close()
+
+    def test_draining_fleet_never_restarts(self, params, monkeypatch):
+        monkeypatch.setenv("TPU_LLM_SUPERVISOR_INTERVAL_S", "0.05")
+        monkeypatch.setenv("TPU_LLM_RESTART_BACKOFF_S", "0.05")
+        inj = FaultInjector()
+        rep = _fleet(params, inj, supervise=True)
+        try:
+            rep.drain()
+            inj.arm("replica_kill", label="/r0")
+            # the kill seam needs a scheduler pass; draining engines idle
+            # but their loops still spin
+            _wait(lambda: not rep.engines[0].alive(), 10, "replica 0 death")
+            time.sleep(0.5)  # several supervisor intervals
+            assert rep.supervisor.restarts == 0
+            assert not rep.engines[0].alive()
+        finally:
+            rep.close()
+
+
+# ---------------------------------------------------------------------------
+# graceful drain: refuse new work, finish in-flight
+# ---------------------------------------------------------------------------
+class TestDrain:
+    def test_drain_refuses_new_completes_inflight(self, params):
+        eng = LLMEngine(
+            CFG, params, slots=2, max_seq_len=128, prefill_buckets=(8,),
+            decode_chunk=2, lookahead=1, warmup=False,
+        )
+        try:
+            want = _reference_tokens(params, [5, 9, 2], 32)
+            req = eng.submit(GenRequest([5, 9, 2], max_new_tokens=32))
+            _wait(lambda: req.emitted > 0, 30, "first token")
+            eng.drain()
+            assert not eng.drained()  # in-flight work still running
+            with pytest.raises(EngineDraining):
+                eng.submit(GenRequest([1, 2], max_new_tokens=2))
+            assert EngineDraining.status_code == 503
+            got = req.tokens(timeout=60)
+            assert got == want, "drain truncated an in-flight stream"
+            _wait(eng.drained, 10, "drained")
+            assert eng.alive()  # drained, not dead: close() still owns teardown
+            assert not eng.accepting()
+        finally:
+            eng.close()
+
+    def test_drain_state_in_stats(self, params):
+        eng = LLMEngine(
+            CFG, params, slots=2, max_seq_len=64, prefill_buckets=(8,),
+            warmup=False,
+        )
+        try:
+            assert eng.stats()["draining"] is False
+            eng.drain()
+            assert eng.stats()["draining"] is True
+            assert eng.debug_state()["draining"] is True
+        finally:
+            eng.close()
+
+
+# ---------------------------------------------------------------------------
+# deadline propagation: a slotted request past its deadline frees the slot
+# ---------------------------------------------------------------------------
+class TestDeadline:
+    def test_deadline_cancels_slotted_request(self, params):
+        eng = LLMEngine(
+            CFG, params, slots=1, max_seq_len=512, prefill_buckets=(8,),
+            decode_chunk=2, lookahead=1, warmup=False,
+        )
+        try:
+            req = eng.submit(GenRequest([5, 9, 2], max_new_tokens=400))
+            _wait(lambda: req.emitted > 0, 30, "first token")
+            # deadline armed only now: lazy first-dispatch compile time
+            # must not eat the budget before any token exists (the sweep
+            # reads the attribute, so late binding is legal)
+            req.deadline = time.perf_counter() + 0.3
+            toks = req.tokens(timeout=30)  # ends at the deadline, not length
+            assert req.finish_reason == "deadline"
+            assert 0 < len(toks) < 400
+            assert eng.deadline_cancels == 1
+            # the slot is free again: the single-slot engine serves the
+            # next request promptly
+            toks2 = eng.generate([1, 2], max_new_tokens=4)
+            assert toks2 == _reference_tokens(params, [1, 2], 4)
+            assert eng.stats()["deadline_cancels"] == 1
+        finally:
+            eng.close()
+
+    def test_queued_past_deadline_never_burns_a_slot(self, params):
+        eng = LLMEngine(
+            CFG, params, slots=2, max_seq_len=64, prefill_buckets=(8,),
+            warmup=False,
+        )
+        try:
+            req = eng.submit(GenRequest(
+                [5, 9, 2], max_new_tokens=4,
+                deadline=time.perf_counter() - 0.01,  # already dead
+            ))
+            toks = req.tokens(timeout=10)
+            assert toks == []
+            assert req.finish_reason == "deadline"
+        finally:
+            eng.close()
+
+    def test_ctx_deadline_reaches_handler(self):
+        import urllib.request
+
+        import gofr_tpu
+        from gofr_tpu.config import new_mock_config
+
+        app = gofr_tpu.new(config=new_mock_config({
+            "APP_NAME": "deadline-test", "HTTP_PORT": "0",
+            "METRICS_PORT": "0", "REQUEST_TIMEOUT": "3",
+        }))
+        seen = {}
+
+        def probe(ctx):
+            seen["deadline"] = ctx.deadline
+            seen["now"] = time.perf_counter()
+            return {"ok": True}
+
+        app.get("/probe", probe)
+        app.run_in_background()
+        try:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{app.http_server.port}/probe", timeout=5
+            ):
+                pass
+            assert seen["deadline"] is not None
+            # ~REQUEST_TIMEOUT in the future, perf_counter timebase
+            assert 0 < seen["deadline"] - seen["now"] <= 3.1
+        finally:
+            app.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# app-level drain: endpoint + readiness flip + shutdown inside the deadline
+# ---------------------------------------------------------------------------
+class TestAppDrain:
+    def test_drain_endpoint_flips_readiness_and_stops(self):
+        import json
+        import urllib.error
+        import urllib.request
+
+        import gofr_tpu
+        from gofr_tpu.config import new_mock_config
+
+        app = gofr_tpu.new(config=new_mock_config({
+            "APP_NAME": "drain-test", "HTTP_PORT": "0", "METRICS_PORT": "0",
+            "GOFR_DRAIN_DEADLINE_S": "5",
+        }))
+        app.get("/greet", lambda ctx: "hi")
+        t = app.run_in_background()
+        base = f"http://127.0.0.1:{app.http_server.port}"
+        with urllib.request.urlopen(f"{base}/.well-known/health", timeout=5) as r:
+            assert r.status == 200
+        req = urllib.request.Request(
+            f"{base}/.well-known/debug/drain", method="POST", data=b""
+        )
+        with urllib.request.urlopen(req, timeout=5) as r:
+            body = json.load(r)
+        assert body["data"]["draining"] is True
+        # readiness must be down the moment the drain begins
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(f"{base}/.well-known/health", timeout=5)
+        assert ei.value.code == 503
+        # no TPU runtime -> nothing in flight -> the server closes fast
+        t.join(timeout=10)
+        assert not t.is_alive(), "drain did not shut the app down"
