@@ -734,6 +734,16 @@ def bench_serving(args) -> dict:
             args, cfg, eng.params if quantize else params, quantize
         )
 
+    # overload operating point (BENCH_r10+): ~2x offered load with a
+    # 10:1 heavy:light batch client mix + interactive probes — goodput,
+    # shed rate, interactive-vs-batch TTFT split, and the Jain fairness
+    # index (gofr_tpu.resilience.overload)
+    if on_tpu and not args.no_overload:
+        detail["overload"] = _bench_overload(
+            args, cfg, eng.params if quantize else params, quantize,
+            ceiling_sust_qps,
+        )
+
     # prefix-cache operating point: 50% shared-prefix traffic — hits skip
     # the prefill wave entirely, so the engine can exceed the NO-CACHE
     # device ceiling (per-request prefill is the larger serial share at
@@ -1007,6 +1017,143 @@ def _bench_interactive_slo(args, cfg, params, quantize: bool) -> dict:
     return point
 
 
+def _bench_overload(args, cfg, params, quantize: bool,
+                    ceiling_qps: float) -> dict:
+    """Overload operating point (docs/advanced-guide/overload.md): open
+    loop at ~2x the device ceiling with a 10:1 heavy:light batch client
+    mix plus a low-rate interactive probe class. The numbers that matter
+    under sustained excess demand: GOODPUT (completed req/s), shed rate
+    (every shed carries a computed Retry-After), the interactive-vs-
+    batch TTFT split (interactive stays flat while batch absorbs the
+    pressure via fair queuing + preemption), and the Jain fairness index
+    across the synthetic batch clients' completed tokens."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    from gofr_tpu.llm import EngineOverloaded, GenRequest, LLMEngine
+
+    S = args.prefill_len
+    eng = LLMEngine(
+        cfg, params, slots=args.batch,
+        max_seq_len=S + args.new_tokens + 2 * args.decode_chunk,
+        prefill_buckets=(max(16, S // 4), S), decode_chunk=args.decode_chunk,
+        admit_cap=args.admit_cap, quantize=quantize,
+        max_queue=8 * args.batch,
+        # shed once the backlog prices a ~2 s first-token wait — at 2x
+        # offered load the controller must shed roughly half the excess
+        shed_predicted_wait_s=2.0,
+    )
+    duration = max(6.0, args.open_loop_s)
+    offered = 2.0 * max(ceiling_qps, 1.0)
+    # 10:1 heavy:light batch mix across 5 clients + interactive probes
+    clients = [("heavy", offered * 10 / 14)] + [
+        (f"light{i}", offered / 14) for i in range(4)
+    ]
+    probe_rate = max(2.0, offered * 0.05)
+    rng = np.random.default_rng(7)
+    lock = threading.Lock()
+    stats = {
+        "ok": 0, "shed": 0, "tokens": {},
+        "ttft": {"interactive": [], "batch": []},
+    }
+    stop = threading.Event()
+    pool = ThreadPoolExecutor(max_workers=1024)
+
+    def consume(req, t_arrival, client, priority):
+        first_t = None
+        count = 0
+        for _t in req.stream(timeout=600):
+            if first_t is None:
+                first_t = time.perf_counter() - t_arrival
+            count += 1
+        with lock:
+            stats["ok"] += 1
+            stats["tokens"][client] = stats["tokens"].get(client, 0) + count
+            if first_t is not None:
+                stats["ttft"][priority].append(first_t)
+
+    def drive(client: str, rate: float, priority: str):
+        t0 = time.perf_counter()
+        n = max(1, int(rate * duration))
+        arrivals = np.cumsum(rng.exponential(1.0 / rate, size=n))
+        for i in range(n):
+            if stop.is_set():
+                return
+            wait = arrivals[i] - (time.perf_counter() - t0)
+            if wait > 0:
+                time.sleep(wait)
+            prompt = np.random.default_rng(i).integers(
+                1, cfg.vocab_size, size=S - 8,
+            ).tolist()
+            try:
+                req = eng.submit(GenRequest(
+                    prompt, max_new_tokens=args.new_tokens,
+                    priority=priority, client=client,
+                ))
+            except EngineOverloaded:
+                with lock:
+                    stats["shed"] += 1
+                continue
+            pool.submit(consume, req, t0 + arrivals[i], client, priority)
+
+    threads = [
+        threading.Thread(target=drive, args=(c, r, "batch"))
+        for c, r in clients
+    ]
+    threads.append(
+        threading.Thread(target=drive, args=("probe", probe_rate, "interactive"))
+    )
+    t_start = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=600)
+    # cancel any straggler BEFORE the engine closes (a driver still
+    # pacing after its join timed out would hit a stopped engine and
+    # skew the shed/ok counts with uncaught errors), then give it one
+    # short join to observe the flag
+    stop.set()
+    for t in threads:
+        t.join(timeout=5)
+    pool.shutdown(wait=True)
+    wall = time.perf_counter() - t_start
+    st = eng.stats()
+    eng.close()
+    total = stats["ok"] + stats["shed"]
+    # Jain index over the batch clients' WEIGHTED completed tokens (all
+    # weight 1 here): (sum x)^2 / (n sum x^2); 1.0 is perfectly fair.
+    # The heavy client's flood is 10x the offered rate of each light
+    # client, so raw completions CANNOT be equal — fairness here means
+    # each light client got its own demand served (no starvation), which
+    # is what the per-client share vector feeds into the index.
+    xs = [stats["tokens"].get(c, 0) for c, _ in clients]
+    jain = (
+        (sum(xs) ** 2) / (len(xs) * sum(x * x for x in xs))
+        if any(xs) else 0.0
+    )
+    light_served = [stats["tokens"].get(f"light{i}", 0) for i in range(4)]
+    it = stats["ttft"]["interactive"]
+    bt = stats["ttft"]["batch"]
+    return {
+        "offered_qps": round(offered, 1),
+        "duration_s": duration,
+        "goodput_qps": round(stats["ok"] / wall, 1),
+        "shed": stats["shed"],
+        "shed_rate": round(stats["shed"] / max(1, total), 3),
+        "sheds_predicted": st.get("sheds_predicted", 0),
+        "preemptions": st.get("preemptions", 0),
+        "ttft_interactive_p50_ms": round(_percentile(it, 0.5) * 1e3, 1) if it else None,
+        "ttft_interactive_p99_ms": round(_percentile(it, 0.99) * 1e3, 1) if it else None,
+        "ttft_batch_p50_ms": round(_percentile(bt, 0.5) * 1e3, 1) if bt else None,
+        "ttft_batch_p99_ms": round(_percentile(bt, 0.99) * 1e3, 1) if bt else None,
+        "jain_fairness": round(jain, 3),
+        "client_tokens": {c: stats["tokens"].get(c, 0) for c, _ in clients},
+        "light_client_spread": (
+            round(min(light_served) / max(1, max(light_served)), 3)
+        ),
+        "clients": len(clients) + 1,
+    }
+
+
 def bench_mlp(args) -> dict:
     import jax
 
@@ -1237,6 +1384,9 @@ def main() -> None:
     ap.add_argument("--no-degraded", action="store_true",
                     help="skip the degraded-operation point (replica kill "
                          "mid-run; needs >=2 devices)")
+    ap.add_argument("--no-overload", action="store_true",
+                    help="skip the overload point (2x offered load, fair "
+                         "queuing + shed telemetry)")
     ap.add_argument("--interactive-rate", type=float, default=250.0,
                     help="fixed offered load (req/s) for the interactive-"
                          "SLO point — fixed so rounds compare directly")
@@ -1344,6 +1494,16 @@ def _summary_line(result: dict) -> dict:
             "error_rate": dg.get("error_rate"),
             "failovers": dg.get("failovers"),
             "time_to_restored_s": dg.get("time_to_restored_s"),
+        }
+    if d.get("overload"):  # BENCH_r10+: demand-side robustness
+        ov = d["overload"]
+        s["overload"] = {
+            "goodput_qps": ov.get("goodput_qps"),
+            "shed_rate": ov.get("shed_rate"),
+            "ttft_interactive_p99_ms": ov.get("ttft_interactive_p99_ms"),
+            "ttft_batch_p99_ms": ov.get("ttft_batch_p99_ms"),
+            "jain_fairness": ov.get("jain_fairness"),
+            "preemptions": ov.get("preemptions"),
         }
     if d.get("subruns"):
         s["greet_qps"] = d["subruns"].get("greet_qps_cpu")

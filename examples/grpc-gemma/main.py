@@ -111,6 +111,8 @@ def _request_tokens(body) -> tuple[list[int], int]:
 
 
 def generate(ctx):
+    from gofr_tpu.handler import llm_request_kwargs
+
     body = ctx.bind()
     toks, eos = _request_tokens(body)
     out = ctx.tpu().llm("gemma").generate(
@@ -119,6 +121,12 @@ def generate(ctx):
         # end-to-end deadline: if this handler's timeout fires, the engine
         # cancels the slotted decode instead of finishing it for no one
         deadline=ctx.deadline,
+        # overload-control identity from the edge (HTTP headers and gRPC
+        # metadata both surface through ctx.header): X-GoFr-Priority
+        # ("batch" absorbs pressure via preemption/brownout) and
+        # X-GoFr-Client (per-client weighted fair queuing) — see
+        # docs/advanced-guide/overload.md
+        **llm_request_kwargs(ctx),
     )
     resp = {"tokens": out}
     if TOKENIZER is not None:
@@ -127,6 +135,7 @@ def generate(ctx):
 
 
 async def stream(ctx):
+    from gofr_tpu.handler import llm_request_kwargs
     from gofr_tpu.llm import GenRequest
 
     body = ctx.bind()
@@ -141,6 +150,7 @@ async def stream(ctx):
             # bounds OBTAINING this generator, never the streaming phase,
             # so a connected client legitimately streams past it — a
             # deadline would silently truncate the live stream mid-flight
+            **llm_request_kwargs(ctx),
         )
     )
     emitted: list[int] = []

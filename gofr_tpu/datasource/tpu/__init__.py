@@ -441,7 +441,13 @@ class TPURuntime:
         comes from gofr_tpu.kvcache; `prefix_cache_mb` defaults to the
         TPU_LLM_PREFIX_CACHE_MB config knob, and the token-budget step
         scheduler honors TPU_LLM_STEP_TOKEN_BUDGET / TPU_LLM_PREFILL_CHUNK
-        (docs/advanced-guide/scheduling.md)."""
+        (docs/advanced-guide/scheduling.md). Overload control — priority
+        classes with batch preemption, per-client weighted fair queuing
+        (`fair_weights`), predicted-wait shedding and brownout, the
+        fleet admission cap and retry budget — is on by default and
+        tuned via the TPU_LLM_FAIR / TPU_LLM_PREEMPT /
+        TPU_LLM_SHED_WAIT_S / TPU_LLM_BROWNOUT_* knobs or the matching
+        engine kwargs (docs/advanced-guide/overload.md)."""
         from ...llm import LLMEngine, ReplicatedLLMEngine
 
         engine_kw.setdefault("prefix_cache_mb", self.default_llm_prefix_cache_mb)

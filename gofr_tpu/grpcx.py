@@ -75,6 +75,33 @@ def _json_bytes(result: Any) -> bytes:
     return json.dumps(result).encode()
 
 
+# HTTP-status seam -> gRPC status, for exceptions carrying status_code
+# (the statusCodeResponder seam the HTTP edge uses). Overload maps to
+# RESOURCE_EXHAUSTED and drain/unavailability to UNAVAILABLE — the two
+# codes gRPC client retry policies key on — and a `retry-after` trailer
+# (seconds, decimal string) mirrors the HTTP Retry-After header
+# (docs/advanced-guide/overload.md).
+_STATUS_TO_GRPC = {
+    429: grpc.StatusCode.RESOURCE_EXHAUSTED,
+    503: grpc.StatusCode.UNAVAILABLE,
+}
+
+
+def _abort_mapped(ctx, e: BaseException) -> bool:
+    """Abort the RPC with the mapped gRPC status when `e` carries a
+    mappable status_code; False when the caller should fall through to
+    its INTERNAL recovery path. abort() raises, so on a mapping this
+    never returns."""
+    code = _STATUS_TO_GRPC.get(getattr(e, "status_code", None))
+    if code is None:
+        return False
+    retry_after = getattr(e, "retry_after", None)
+    if isinstance(retry_after, (int, float)) and 0 < retry_after < float("inf"):
+        ctx.set_trailing_metadata((("retry-after", f"{retry_after:.3f}"),))
+    ctx.abort(code, str(e) or e.__class__.__name__)
+    return True  # pragma: no cover — abort raises
+
+
 class _Interceptor(grpc.ServerInterceptor):
     """Recovery + logging + tracing in one chain link (grpc.go:24-27,
     grpc/log.go:58-95): wraps every behavior with panic recovery (-> INTERNAL),
@@ -153,6 +180,12 @@ class _Interceptor(grpc.ServerInterceptor):
             self._log(method, t0, "RPC_ERROR", rpc_id)
             raise
         except Exception as e:  # noqa: BLE001 — recovery interceptor (grpc.go:25)
+            code = _STATUS_TO_GRPC.get(getattr(e, "status_code", None))
+            if code is not None:
+                # overload/drain: a TYPED rejection, not a panic — map it
+                # (with the retry-after trailer) instead of masking it
+                self._log(method, t0, code.name, rpc_id)
+                _abort_mapped(ctx, e)
             logger = getattr(self.container, "logger", None)
             if logger is not None:
                 logger.error(f"panic in gRPC handler {method}: {e!r}")
@@ -173,6 +206,10 @@ class _Interceptor(grpc.ServerInterceptor):
             self._log(method, t0, "RPC_ERROR", rpc_id)
             raise
         except Exception as e:  # noqa: BLE001
+            code = _STATUS_TO_GRPC.get(getattr(e, "status_code", None))
+            if code is not None:
+                self._log(method, t0, code.name, rpc_id)
+                _abort_mapped(ctx, e)
             logger = getattr(self.container, "logger", None)
             if logger is not None:
                 logger.error(f"panic in gRPC stream handler {method}: {e!r}")

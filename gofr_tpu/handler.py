@@ -81,6 +81,59 @@ def wrap_handler(fn: Callable, container: Container, timeout_s: float | None) ->
     return h
 
 
+def llm_request_kwargs(ctx: Context) -> dict:
+    """Overload-control identity from the request edge, as GenRequest
+    kwargs (docs/advanced-guide/overload.md):
+
+    - ``priority``: the ``X-GoFr-Priority`` header ("interactive" |
+      "batch"; anything else degrades to interactive — the engine
+      normalizes, a typo must not error).
+    - ``client``: the fair-queuing client id — ``X-GoFr-Client`` header,
+      falling back to a HASH of the authenticated API key
+      (``X-API-KEY``) so keyed deployments get per-tenant fairness with
+      zero client changes (hashed because ledger client ids surface in
+      stats()/debug_state()/the debug route — a raw key there would be
+      a credential disclosure), then the peer address (portless, so one
+      busy host's ephemeral ports don't fan into thousands of ledger
+      rows).
+
+    Works over both edges: HTTP headers and gRPC metadata both surface
+    through ``ctx.header`` (grpc-gemma's handlers pass these straight
+    into ``GenRequest``/``generate``). Contexts without a header surface
+    (cron jobs, pub/sub, CLI) get the defaults — a shared handler must
+    not require an HTTP-shaped request."""
+
+    def hdr(name: str) -> str:
+        try:
+            return ctx.header(name) or ""
+        except Exception:  # noqa: BLE001 — headerless request shapes
+            return ""
+
+    client = hdr("X-GoFr-Client")
+    if not client:
+        key = hdr("X-API-KEY")
+        if key:
+            import hashlib
+
+            client = "key:" + hashlib.sha256(key.encode()).hexdigest()[:12]
+    if not client:
+        # HTTP: the socket peer; gRPC: host_name() is the peer string
+        # ("ipv4:addr:port"). HTTP's host_name() is the Host HEADER (the
+        # server's own name) — useless as a client identity, so
+        # remote_addr is consulted first.
+        addr = getattr(ctx.request, "remote_addr", "") or ""
+        if not addr:
+            try:
+                addr = ctx.host_name() or ""
+            except Exception:  # noqa: BLE001 — identity fallback must not fail
+                addr = ""
+        client = addr.rsplit(":", 1)[0] if addr else ""
+    return {
+        "priority": (hdr("X-GoFr-Priority") or "interactive").lower(),
+        "client": client,
+    }
+
+
 # -- built-in handlers (handler.go:78-113) --
 
 def health_handler(ctx: Context) -> Any:
@@ -99,7 +152,9 @@ def health_handler(ctx: Context) -> Any:
     if getattr(ctx.container, "draining", False):
         from .http.errors import ErrorServiceUnavailable
 
-        raise ErrorServiceUnavailable("draining")
+        # Retry-After ~ a readiness-probe window: a client talking
+        # straight to this pod should back off, not poll the corpse
+        raise ErrorServiceUnavailable("draining", retry_after=5.0)
     out = ctx.container.health()
     out["status"] = _serving_status(ctx.container)
     return out

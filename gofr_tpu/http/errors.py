@@ -76,12 +76,37 @@ class ErrorPanicRecovery(HTTPError):
 
 
 class ErrorServiceUnavailable(HTTPError):
-    """503 — dependency down / circuit open / batch queue full."""
+    """503 — dependency down / circuit open / batch queue full /
+    draining. ``retry_after`` (seconds) rides the response as the
+    Retry-After header when set (the responder seam)."""
 
     status_code = 503
+    retry_after: float | None = None
 
-    def __init__(self, message: str = "service unavailable"):
+    def __init__(self, message: str = "service unavailable",
+                 retry_after: float | None = None):
         super().__init__(message)
+        if retry_after is not None:
+            self.retry_after = retry_after
+
+
+class ErrorTooManyRequests(HTTPError):
+    """429 — the overload-control shed response (predicted-wait shed,
+    queue cap, fleet admission cap; docs/advanced-guide/overload.md).
+    ``retry_after`` (seconds) becomes the Retry-After header so the
+    client is told WHEN capacity is predicted back instead of being
+    invited to retry blind. The LLM engine's EngineOverloaded maps
+    through the status_code/retry_after seams without this type; it
+    exists for handlers shedding their own non-LLM work."""
+
+    status_code = 429
+    retry_after: float | None = None
+
+    def __init__(self, message: str = "too many requests",
+                 retry_after: float | None = None):
+        super().__init__(message)
+        if retry_after is not None:
+            self.retry_after = retry_after
 
 
 def status_from_error(err: BaseException) -> int:

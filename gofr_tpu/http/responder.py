@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import math
 from typing import Any, AsyncIterator
 
 from .errors import status_from_error
@@ -97,7 +98,21 @@ def respond(result: Any, err: BaseException | None, method: str = "GET") -> Resp
         status = status_from_error(err)
         msg = getattr(err, "message", None) or str(err) or err.__class__.__name__
         body = to_json_bytes({"error": {"message": msg}})
-        return Response(status, [("Content-Type", "application/json")], body)
+        headers = [("Content-Type", "application/json")]
+        # Overload/drain responses tell the client WHEN to come back:
+        # any error carrying a finite retry_after (EngineOverloaded,
+        # EngineDraining, ErrorTooManyRequests, ErrorServiceUnavailable)
+        # gets the RFC 9110 Retry-After header — integer seconds, ceiled
+        # so the client never retries early (docs/advanced-guide/overload.md).
+        retry_after = getattr(err, "retry_after", None)
+        if (
+            isinstance(retry_after, (int, float))
+            and retry_after == retry_after  # not NaN
+            and 0 < retry_after < float("inf")
+            and status in (429, 503)
+        ):
+            headers.append(("Retry-After", str(max(1, math.ceil(retry_after)))))
+        return Response(status, headers, body)
 
     if isinstance(result, Response):
         return result

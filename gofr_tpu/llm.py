@@ -171,12 +171,25 @@ def _register_phase_metrics(metrics) -> None:
 
 
 class EngineOverloaded(RuntimeError):
-    """Raised by submit() when the admission queue cap is hit — the
-    SLO-preserving alternative to unbounded queueing (map to HTTP 429).
-    Carries `status_code` so the responder's statusCodeResponder seam
-    translates it without a handler-side catch."""
+    """Raised by submit() when the admission queue cap is hit OR when the
+    predicted queue wait crosses the shed threshold — the SLO-preserving
+    alternative to unbounded queueing (map to HTTP 429). Carries
+    `status_code` so the responder's statusCodeResponder seam translates
+    it without a handler-side catch, and `retry_after` (seconds) so both
+    edges tell the client WHEN capacity is predicted back (HTTP
+    Retry-After header; gRPC retry-after trailer) instead of inviting an
+    immediate blind retry. NON-RETRYABLE inside the fleet: the router
+    picked the least-loaded replica, so every other replica is at least
+    as overloaded — retrying the rest would amplify the overload
+    (docs/advanced-guide/overload.md)."""
 
     status_code = 429
+    retry_after: float | None = None
+
+    def __init__(self, message: str = "", retry_after: float | None = None):
+        super().__init__(message)
+        if retry_after is not None:
+            self.retry_after = max(0.1, float(retry_after))
 
 
 class EngineStoppedError(RuntimeError):
@@ -191,9 +204,15 @@ class EngineDraining(RuntimeError):
     """Raised by submit() while the engine drains (rolling deploy):
     admission is closed but in-flight work runs to completion. 503 via
     the statusCodeResponder seam — the load balancer should retry the
-    next pod, not this one."""
+    next pod, not this one. `retry_after` rides the response (HTTP
+    Retry-After / gRPC trailer) so a client talking straight to the pod
+    backs off for roughly a readiness-probe window instead of spinning.
+    RETRYABLE inside the fleet: another replica may still be accepting
+    (the router excludes draining replicas, but a drain can begin
+    between pick and submit)."""
 
     status_code = 503
+    retry_after: float | None = 5.0
 
 
 @dataclass(eq=False)  # identity semantics: requests are handles, and the
@@ -204,6 +223,17 @@ class GenRequest:
     max_new_tokens: int = 32
     temperature: float = 0.0
     eos_token: int = _EOS_DEFAULT
+    # Overload-control identity (docs/advanced-guide/overload.md):
+    # priority class "interactive" (latency-sensitive; may preempt batch
+    # work under queue pressure) or "batch" (throughput work; absorbs
+    # pressure via preemption and brownout clamping). Anything except
+    # the literal "batch" is treated as interactive — the edge forwards
+    # the X-GoFr-Priority header verbatim and a typo must degrade to the
+    # latency-safe class, not an error.
+    priority: str = "interactive"
+    # Fair-queuing client id (X-GoFr-Client header / API key / caller's
+    # choice). "" pools unattributed traffic into one anonymous client.
+    client: str = ""
     # Explicit W3C trace context for callers whose submitting thread the
     # tracing contextvar does not reach (executor pools, user threads);
     # submit() prefers the live contextvar span when one is active.
@@ -220,6 +250,9 @@ class GenRequest:
         self.cancelled = False
         self.emitted = 0
         self.capped = False  # engine reduced max_new_tokens to fit the cache
+        self.browned = False  # brownout clamped max_new_tokens (batch class)
+        self.preempted = 0  # times a slot was taken back for interactive work
+        self._prompt_billed = False  # fairness ledger saw the prompt tokens
         self.finish_reason: str | None = None  # "eos" | "length" | "cancelled"
         #   | "shed" | "deadline" | "error" ("failover" transiently marks a
         #   request rescued off a dying replica so drain paths skip it)
@@ -281,6 +314,7 @@ class GenRequest:
 
 class LLMEngine:
     _FETCH_FAIL_LIMIT = 3  # consecutive fetch failures before full reset
+    _PREEMPT_CAP = 2  # max evictions per batch request (then it keeps its slot)
 
     def __init__(
         self,
@@ -301,6 +335,14 @@ class LLMEngine:
         device=None,
         max_queue: int | None = None,
         ttft_deadline_ms: float | None = None,
+        fair_queuing: bool | None = None,
+        fair_weights: dict | None = None,
+        fair_ledger=None,
+        preemption: bool | None = None,
+        shed_predicted_wait_s: float | None = None,
+        brownout_wait_s: float | None = None,
+        brownout_max_new: int | None = None,
+        brownout_hold_s: float | None = None,
         step_watchdog_s: float | None = None,
         fault_injector=None,
         logger=None,
@@ -393,6 +435,60 @@ class LLMEngine:
         self.rejected = 0  # submit-time cap rejections
         self.shed = 0  # deadline sheds at admission
         self.deadline_cancels = 0  # mid-flight deadline cancellations
+        # -- overload control (gofr_tpu.resilience.overload;
+        # docs/advanced-guide/overload.md) --------------------------------
+        # Per-client weighted fair queuing: _waiting is ordered
+        # (priority class, ledger counter, submit order) instead of FIFO,
+        # so a flood from one client cannot starve another's weighted
+        # share. ReplicatedLLMEngine passes ONE shared ledger to every
+        # replica (fleet-wide fairness); a bare engine builds its own.
+        from .resilience import FairLedger, OverloadController
+
+        if fair_queuing is None:
+            fair_queuing = _os.environ.get("TPU_LLM_FAIR", "1") != "0"
+        self.ledger = None
+        if fair_queuing:
+            self.ledger = (
+                fair_ledger if fair_ledger is not None
+                else FairLedger(fair_weights)
+            )
+        # Priority preemption: under interactive queue pressure a slotted
+        # batch request is preempted — its slot freed NOW, its emitted
+        # tokens folded into a continuation prompt and requeued (the PR 5
+        # failover re-seed, so greedy streams resume token-identically).
+        if preemption is None:
+            preemption = _os.environ.get("TPU_LLM_PREEMPT", "1") != "0"
+        self.preemption = bool(preemption)
+        self.preemptions = 0  # batch slots taken back for interactive work
+        # Adaptive shedding + brownout: predicted queue wait (queued
+        # tokens / measured step throughput) drives early 429s with a
+        # computed Retry-After, and sustained pressure clamps batch-class
+        # max_new_tokens BEFORE anything is shed (degrade, then shed).
+        if shed_predicted_wait_s is None:
+            shed_predicted_wait_s = float(
+                _os.environ.get("TPU_LLM_SHED_WAIT_S", "0") or 0.0
+            )
+        if brownout_wait_s is None:
+            brownout_wait_s = float(
+                _os.environ.get("TPU_LLM_BROWNOUT_WAIT_S", "0") or 0.0
+            )
+        if brownout_max_new is None:
+            brownout_max_new = int(
+                _os.environ.get("TPU_LLM_BROWNOUT_MAX_NEW", "0") or 0
+            )
+        if brownout_hold_s is None:
+            brownout_hold_s = float(
+                _os.environ.get("TPU_LLM_BROWNOUT_HOLD_S", "2.0") or 0.0
+            )
+        self.overload = OverloadController(
+            shed_wait_s=shed_predicted_wait_s,
+            brownout_wait_s=brownout_wait_s,
+            brownout_max_new=brownout_max_new,
+            brownout_hold_s=brownout_hold_s,
+        )
+        self.sheds_predicted = 0  # predicted-wait 429s
+        self.brownout_clamped = 0  # batch requests clamped while browned out
+        self._tput_ema: float | None = None  # measured tokens/s (EMA)
         # -- resilience (gofr_tpu.resilience; docs/advanced-guide/resilience.md)
         from .resilience import Heartbeat, default_injector
 
@@ -801,12 +897,52 @@ class LLMEngine:
         if req.max_new_tokens - req.emitted > room:
             req.max_new_tokens = room + req.emitted
             req.capped = True
+        # -- overload control (docs/advanced-guide/overload.md) -----------
+        # Anything except the literal "batch" is interactive: the edges
+        # forward the X-GoFr-Priority header verbatim, and a typo must
+        # degrade to the latency-safe class, not an error.
+        req.priority = "batch" if req.priority == "batch" else "interactive"
+        wait_s = self.predicted_wait_s()
+        spec = self.faults.take("overload_pressure", self.label)
+        if spec is not None:
+            # chaos seam: this submit sees `delay` seconds of predicted
+            # wait regardless of the real backlog (deterministic
+            # brownout/shed in tier-1 and the CI overload smoke)
+            self._count_fault("overload_pressure")
+            wait_s = spec.delay if spec.delay > 0 else 3600.0
+        self.overload.observe(wait_s)
+        shed_after = self.overload.should_shed(wait_s)
+        if shed_after is not None:
+            # predicted-wait shed: reject EARLY, before max_queue, with
+            # the time the backlog needs to drain — a client told WHEN to
+            # come back offers its load where capacity will exist
+            self.sheds_predicted += 1
+            if self.metrics is not None:
+                self.metrics.increment_counter(
+                    "app_llm_sheds_predicted_total", model=self.label
+                )
+            raise EngineOverloaded(
+                f"predicted queue wait {wait_s:.1f}s exceeds shed "
+                f"threshold {self.overload.shed_wait_s:.1f}s",
+                retry_after=shed_after,
+            )
+        # brownout degrade: clamp bounds the REMAINING tokens — a
+        # failover/preemption continuation re-submits with emitted > 0
+        # and must not land below what it already streamed
+        clamp = self.overload.clamp(
+            req.max_new_tokens - req.emitted, req.priority
+        ) + req.emitted
+        if clamp < req.max_new_tokens:
+            req.max_new_tokens = clamp
+            req.browned = True
+            self.brownout_clamped += 1
         if self.max_queue is not None:
             depth = self._admit_q.qsize() + len(self._waiting) + self._admitting
             if depth >= self.max_queue:
                 self.rejected += 1
                 raise EngineOverloaded(
-                    f"admission queue full ({depth} >= {self.max_queue})"
+                    f"admission queue full ({depth} >= {self.max_queue})",
+                    retry_after=wait_s if wait_s else 1.0,
                 )
         now = time.perf_counter()
         req.submitted_at = now
@@ -853,6 +989,11 @@ class LLMEngine:
                 self._ema_gap = (
                     gap if self._ema_gap is None else 0.8 * self._ema_gap + 0.2 * gap
                 )
+        if self.ledger is not None:
+            # new-arrival lift BEFORE the request becomes orderable: a
+            # client returning from idle starts at the active floor, not
+            # at whatever stale credit its old counter banked
+            self.ledger.touch(req.client)
         self._admit_q.put(req)
         # TOCTOU with _die()/close(): if the engine stopped between the
         # _stop check above and this put, its one-shot drain may already
@@ -897,6 +1038,15 @@ class LLMEngine:
                 "rejected": self.rejected,
                 "shed": self.shed,
                 "deadline_cancels": self.deadline_cancels,
+                # overload-control telemetry (docs/advanced-guide/overload.md)
+                "preemptions": self.preemptions,
+                "sheds_predicted": self.sheds_predicted,
+                "brownout_clamped": self.brownout_clamped,
+                "predicted_wait_s": self.predicted_wait_s(),
+                "overload": self.overload.snapshot(),
+                "fairness": (
+                    self.ledger.snapshot() if self.ledger is not None else None
+                ),
                 "draining": self._draining,
                 "watchdog_trips": self.watchdog.trips if self.watchdog else 0,
                 "kvcache": self.kv.stats(),
@@ -949,7 +1099,7 @@ class LLMEngine:
                 if e[0] == "prefill":
                     inflight.append({
                         "kind": "prefill",
-                        "requests": [r.id for _, r in e[2]],
+                        "requests": [r.id for _, r in e[2] if r is not None],
                         "wave": e[3]["nb"] or len(e[2]),
                         "bucket": e[3]["bucket"],
                         "age_ms": round((now - e[3]["t0"]) * 1e3, 1),
@@ -986,6 +1136,13 @@ class LLMEngine:
             ),
             "faults": self.faults.snapshot(),
             "deadline_cancels": self.deadline_cancels,
+            "preemptions": self.preemptions,
+            "sheds_predicted": self.sheds_predicted,
+            "predicted_wait_s": self.predicted_wait_s(),
+            "overload": self.overload.snapshot(),
+            "fairness": (
+                self.ledger.snapshot() if self.ledger is not None else None
+            ),
             "slots": self.slots,
             "active": sum(row is not None for row in slot_table),
             "max_seq_len": self.max_seq_len,
@@ -1033,6 +1190,29 @@ class LLMEngine:
         router actually needs to balance. Lock-free read of a single int
         (torn reads cost at most one stale request)."""
         return max(0, self._load_tokens)
+
+    def predicted_wait_s(self) -> float | None:
+        """Predicted queue wait for a NEW request: the outstanding token
+        estimate (load_tokens) over the measured serving throughput (EMA
+        over recent device windows). None until the first window lands —
+        the overload controller treats that as no pressure, so a cold
+        engine never sheds. An estimate, not a promise: pipelined
+        windows overlap, so the EMA reads slightly low and the
+        prediction slightly high (conservative for shedding)."""
+        tput = self._tput_ema
+        if not tput or tput <= 1e-9:
+            return None
+        return self.load_tokens() / tput
+
+    def _observe_tput(self, tokens: int, dt: float) -> None:
+        """Fold one finished device window (tokens served / wall) into
+        the throughput EMA that prices predicted queue wait. Lock-free
+        float write (a torn read costs one stale estimate)."""
+        if tokens <= 0 or dt <= 0:
+            return
+        rate = tokens / dt
+        ema = self._tput_ema
+        self._tput_ema = rate if ema is None else 0.8 * ema + 0.2 * rate
 
     def _load_credit(self, r: GenRequest, n: int) -> None:
         """Retire `n` tokens of r's outstanding-work estimate (bounded by
@@ -1129,6 +1309,8 @@ class LLMEngine:
             "app_llm_admission_backlog",
             "app_llm_step_budget_utilization",
             "app_llm_drain_state",
+            "app_llm_brownout_state",
+            "app_llm_fairness_debt",
         ):
             self.metrics.set_gauge(name, 0.0, model=self.label)
 
@@ -1165,6 +1347,10 @@ class LLMEngine:
         self._drain_pending()
         self._zero_state_gauges()
         self._teardown_profiling()
+        if self.ledger is not None:
+            # a closed replica must not pin the fleet ledger's
+            # new-arrival floor with a stale waiting-client set
+            self.ledger.set_active(self.label, set())
         self.kv.close()  # drop retained prefix rows (device buffers)
 
     def _drain_pending(self) -> None:
@@ -1448,6 +1634,11 @@ class LLMEngine:
                     kept.append(r)
             self._waiting = kept
         self._expire_deadlines(time.perf_counter())
+        self._order_waiting()
+        # fresh pressure sample once per scheduler pass: brownout must be
+        # able to DISENGAGE while no submits arrive (submit() feeds the
+        # controller too, but an empty ingress would freeze the state)
+        self.overload.observe(self.predicted_wait_s())
         if self.logger is not None:
             # queue-side terminations (cancelled in the drain, shed above)
             # have no collector iteration to flush them — do it here, on
@@ -1455,7 +1646,7 @@ class LLMEngine:
             self._flush_wide_events()
         if self.metrics is not None:
             # engine-state gauges, refreshed once per scheduler pass —
-            # three lock-light sets, no device interaction
+            # lock-light sets, no device interaction
             active_n = sum(r is not None for r in self._slot_req)
             self.metrics.set_gauge(
                 "app_llm_slots_in_use", float(active_n), model=self.label
@@ -1468,6 +1659,153 @@ class LLMEngine:
             self.metrics.set_gauge(
                 "app_llm_admission_backlog", float(self._admitting),
                 model=self.label,
+            )
+            self.metrics.set_gauge(
+                "app_llm_brownout_state",
+                1.0 if self.overload.brownout else 0.0, model=self.label,
+            )
+            if self.ledger is not None:
+                self.metrics.set_gauge(
+                    "app_llm_fairness_debt", self.ledger.debt_spread(),
+                    model=self.label,
+                )
+
+    def _order_waiting(self) -> None:
+        """Overload-aware queue order (replaces FIFO): interactive class
+        first, then least weighted-served client (the fairness ledger's
+        virtual token counter — "Fairness in Serving Large Language
+        Models", OSDI'24), submit order last for determinism. Also
+        refreshes the ledger's waiting-client set, which anchors the
+        new-arrival floor. Sorting every pass is O(n log n) on a queue
+        already bounded by max_queue; stable sort keeps equal keys FIFO."""
+        led = self.ledger
+        with self._lock:
+            clients = {r.client for r in self._waiting}
+            if led is not None:
+                led.set_active(self.label, clients)
+            if len(self._waiting) < 2:
+                return
+            # one bulk ledger snapshot for the whole sort: per-request
+            # counter() calls would contend the fleet-shared lock
+            # len(_waiting) times per scheduler pass per replica
+            counters = led.counters_for(clients) if led is not None else {}
+            self._waiting.sort(
+                key=lambda r: (
+                    1 if r.priority == "batch" else 0,
+                    counters.get(r.client, 0.0),
+                    r.id,
+                )
+            )
+
+    def _preempt_for_waiting(self, free: list[int]) -> list[int]:
+        """Priority preemption: when waiting interactive requests
+        outnumber the free slots, take slots back from batch-class
+        occupants — preferring the most recently admitted victim (least
+        sunk progress to redo) — and return the refreshed free list.
+        Nothing interactive waiting, or nothing batch slotted, is the
+        common case and costs two scans of bounded lists."""
+        if not self.preemption:
+            return free
+        with self._lock:
+            want = sum(
+                1 for r in self._waiting
+                if r.priority != "batch" and r.finish_reason is None
+            ) - len(free)
+            if want <= 0:
+                return free
+            victims = [
+                r for r in self._slot_req
+                if r is not None and r.priority == "batch"
+                and not r.cancelled and r.finish_reason is None
+                # per-request preemption cap: a request evicted this many
+                # times keeps its slot — without the bound, interactive
+                # arrivals oscillating around capacity could thrash the
+                # same batch request forever, re-running an ever-growing
+                # continuation prefill at exactly the moment the engine
+                # is pressured
+                and r.preempted < self._PREEMPT_CAP
+            ]
+            if not victims:
+                return free
+            victims.sort(key=lambda r: (r.admitted_at or 0.0), reverse=True)
+            for r in victims[:want]:
+                self._preempt(r)
+            self._kick.set()
+            return self._free_slots()
+
+    def _preempt(self, r: GenRequest) -> None:
+        """Take r's slot back NOW: scrub every in-flight reference (no
+        stale emission can reach it — the entry lists are shared with the
+        collector, which only emits under this same lock), then fold the
+        emitted tokens into the prompt and requeue as a continuation —
+        the PR 5 failover re-seed, so a preempted greedy stream resumes
+        token-identically; tokens computed-but-unfetched at preemption
+        are recomputed by the continuation rather than emitted stale.
+        Call with the lock held, scheduler thread only."""
+        slot = r.slot
+        if slot is not None and self._slot_req[slot] is r:
+            self._slot_req[slot] = None
+        r.slot = None
+        entries = list(self._inflight)
+        if self._processing is not None:
+            entries.append(self._processing)
+        for e in entries:
+            if e[0] == "prefill":
+                # keep j-alignment with the first-token array: blank the
+                # request, never remove the row
+                e[2][:] = [
+                    (s, rr if rr is not r else None) for s, rr in e[2]
+                ]
+            elif e[0] == "step":
+                e[2][:] = [t for t in e[2] if t[2] is not r]
+                if e[4] is not None:
+                    for i, rr in enumerate(e[4]):
+                        if rr is r:
+                            e[4][i] = None
+            else:
+                for i, rr in enumerate(e[2]):
+                    if rr is r:
+                        e[2][i] = None
+        try:
+            self._prefilling.remove(r)
+        except ValueError:
+            pass
+        # continuation re-seed (ReplicatedLLMEngine._failover semantics):
+        # prompt grows by what was already streamed, scheduling state
+        # resets, consumer-facing state (out queue, emitted) carries over
+        if r.history:
+            r.prompt_tokens = list(r.prompt_tokens) + r.history
+            r.history = []
+        r.prefill_pos = 0
+        r.prefill_done = False
+        r._rows_hi = 0
+        r._prefill_t0 = None
+        r.phase = "queued"
+        r.preempted += 1
+        # fresh wait epoch, mirroring failover's path through submit():
+        # without this, re-admission would observe queue_wait from the
+        # ORIGINAL submit — service time + both waits in one inflated
+        # sample, and the request counted twice in the histogram
+        r.submitted_at = time.perf_counter()
+        # outstanding-work estimate: the re-run prefill plus what decode
+        # still owes (the residue of the old estimate is flushed)
+        self._load_tokens -= r._load_acct
+        r._load_acct = len(r.prompt_tokens) + max(
+            0, r.max_new_tokens - r.emitted
+        )
+        self._load_tokens += r._load_acct
+        self._waiting.append(r)
+        if self.ledger is not None:
+            self.ledger.touch(r.client)
+        self.preemptions += 1
+        if self.logger is not None:
+            self.logger.info(
+                f"preempted batch request {r.id} (emitted {r.emitted}); "
+                "requeued as continuation"
+            )
+        if self.metrics is not None:
+            self.metrics.increment_counter(
+                "app_llm_preemptions_total", model=self.label
             )
 
     def _expire_deadlines(self, now: float) -> None:
@@ -1539,6 +1877,8 @@ class LLMEngine:
             free = self._free_slots()
             busy = self._any_active() or self._inflight or self._processing is not None
         self._drain_and_observe(busy)
+        if self._waiting:
+            free = self._preempt_for_waiting(free)
         if not self._waiting or not free:
             return False
         # Rate-gated wave-fill hold: a prefill wave costs device time that
@@ -1701,6 +2041,13 @@ class LLMEngine:
         """queue_wait closes at admission (slot assigned, KV en route)."""
         r.admitted_at = now
         r.phase = "prefill"
+        if self.ledger is not None and not r._prompt_billed:
+            # prompt tokens bill once per request lifetime: a preempted
+            # or failed-over continuation re-prefills its (grown) prompt,
+            # but double-billing it would punish the client for the
+            # engine's own scheduling decision
+            r._prompt_billed = True
+            self.ledger.charge(r.client, len(r.prompt_tokens))
         if r.submitted_at is not None:
             wait = now - r.submitted_at
             self._phases["queue_wait"].observe(wait)
@@ -1741,6 +2088,8 @@ class LLMEngine:
                 or bool(self._inflight) or self._processing is not None
             )
         self._drain_and_observe(busy)
+        if self._waiting:
+            free = self._preempt_for_waiting(free)
         if not self._waiting or not free:
             return False
         self._fault("admission_oom")  # chaos seam: nothing pulled yet
@@ -2105,6 +2454,8 @@ class LLMEngine:
             r.emitted += len(toks)
             r.history.extend(toks)  # failover continuation seed
             self._load_credit(r, len(toks))
+            if self.ledger is not None:
+                self.ledger.charge(r.client, len(toks))
         if finish is None and r.emitted >= r.max_new_tokens:
             finish = "length"
         if finish is not None:
@@ -2340,7 +2691,10 @@ class LLMEngine:
             now = time.perf_counter()
             if info["bucket"] is not None:  # miss wave: a device prefill ran
                 # (prefix-hit waves dispatch no prefill — no MFU to claim)
-                seq_lens = [len(r.prompt_tokens) for _, r in taken]
+                seq_lens = [
+                    len(r.prompt_tokens) for _, r in taken if r is not None
+                ]
+                self._observe_tput(sum(seq_lens), now - info["t0"])
                 self._observe_mfu(
                     "prefill",
                     tokens=sum(seq_lens),
@@ -2353,6 +2707,8 @@ class LLMEngine:
                 )
             with self._lock:
                 for j, (slot, r) in enumerate(taken):
+                    if r is None:  # scrubbed by preemption: tokens dropped
+                        continue
                     if r.span is not None and r.finish_reason is None:
                         self._phase_span(
                             r, "llm.prefill", info["t0"], now,
@@ -2382,6 +2738,7 @@ class LLMEngine:
         # (wave = active slots at dispatch, bucketed to a power of two so
         # the label set stays bounded at log2(slots) values)
         active_n, ctx_sum = self._ctx_tokens(snapshot)
+        self._observe_tput(k * active_n, now - t_dispatch)
         step_s = (now - t_dispatch) / k
         self._phases["decode_step"].observe(step_s)
         if active_n:
@@ -2435,6 +2792,11 @@ class LLMEngine:
         decoded = any(r is not None for r in snapshot)
         now = time.perf_counter()
         step_s = now - info["t0"]
+        self._observe_tput(
+            info["prefill_tokens"]
+            + k * sum(1 for r in snapshot if r is not None),
+            step_s,
+        )
         self._phases["step"].observe(step_s)
         if self.metrics is not None:
             self.metrics.record_histogram(
@@ -2640,6 +3002,8 @@ class LLMEngine:
             )
         self._zero_state_gauges()
         self._teardown_profiling()
+        if self.ledger is not None:
+            self.ledger.set_active(self.label, set())  # see close()
         self._kick.set()
         if acquired:
             with self._work_cv:
@@ -2928,6 +3292,9 @@ class ReplicatedLLMEngine:
         logger=None,
         supervise: bool = True,
         failover_retries: int | None = None,
+        fleet_max_queue_tokens: int | None = None,
+        retry_budget_per_s: float | None = None,
+        retry_budget_burst: float | None = None,
         **engine_kw,
     ):
         import jax
@@ -2976,6 +3343,56 @@ class ReplicatedLLMEngine:
         self.failovers = 0  # requests re-dispatched off a dead replica
         self.failover_errors = 0  # rescues that found no live replica
         self._draining = False
+        # -- fleet overload control (docs/advanced-guide/overload.md) -----
+        # ONE fairness ledger shared by every replica: the virtual token
+        # counters pool across the fleet, so least-served ordering holds
+        # no matter which replica a client's requests land on. Retained
+        # in _engine_kw, so supervised rebuilds rejoin the same ledger.
+        from .resilience import FairLedger, RetryBudget
+
+        fq = engine_kw.get("fair_queuing")
+        if fq is None:
+            # same precedence as LLMEngine: an explicit kwarg beats the
+            # env (otherwise TPU_LLM_FAIR=0 would silently skip the
+            # SHARED ledger while each replica still built its own —
+            # fleet fairness degraded to per-replica with no signal)
+            fq = _os.environ.get("TPU_LLM_FAIR", "1") != "0"
+        if fq:
+            # NOT setdefault(key, FairLedger(pop(...))): the value
+            # expression would evaluate eagerly, discarding fair_weights
+            # (and a throwaway ledger) whenever a fair_ledger was also
+            # passed — weights must land on whichever ledger is used
+            weights = engine_kw.pop("fair_weights", None)
+            if engine_kw.get("fair_ledger") is None:
+                engine_kw["fair_ledger"] = FairLedger(weights)
+            elif weights:
+                for c, w in weights.items():
+                    engine_kw["fair_ledger"].set_weight(c, w)
+        self.ledger = engine_kw.get("fair_ledger")
+        # Fleet admission cap: reject at the summed queued-token estimate
+        # across accepting replicas instead of piling onto the last
+        # healthy engine (0 disables; per-engine max_queue still applies)
+        if fleet_max_queue_tokens is None:
+            fleet_max_queue_tokens = int(
+                _os.environ.get("TPU_LLM_FLEET_MAX_QUEUE_TOKENS", "0") or 0
+            )
+        self.fleet_max_queue_tokens = max(0, int(fleet_max_queue_tokens))
+        self.fleet_rejected = 0
+        # Retry budget: router-side retries (failover re-dispatch,
+        # replica death between pick and submit) draw from a token
+        # bucket, so overload can never amplify into a retry storm — the
+        # same pathology the inter-service circuit breaker guards
+        # (gofr_tpu.service).
+        if retry_budget_per_s is None:
+            retry_budget_per_s = float(
+                _os.environ.get("TPU_LLM_RETRY_BUDGET_PER_S", "1.0") or 0.0
+            )
+        if retry_budget_burst is None:
+            retry_budget_burst = float(
+                _os.environ.get("TPU_LLM_RETRY_BUDGET_BURST", "10") or 0.0
+            )
+        self.retry_budget = RetryBudget(retry_budget_per_s, retry_budget_burst)
+        self.retry_budget_exhausted = 0
         # build replicas concurrently: XLA releases the GIL while compiling,
         # so N warmups overlap instead of serializing construction N-fold.
         # On any failure, close the replicas that DID come up — each holds
@@ -3053,19 +3470,79 @@ class ReplicatedLLMEngine:
 
     # -- LLMEngine surface -------------------------------------------------
     def submit(self, req: GenRequest) -> GenRequest:
-        # a replica can die between _pick and submit; retry on the LIVE
-        # survivors — typed EngineStoppedError, never string matching
-        # (EngineOverloaded, EngineDraining, and validation errors
-        # propagate). Bounded: the supervisor may swap replacements in
-        # mid-loop, so the exclusion set alone is not a terminator.
+        # keep the budget gauge live: written only on retry events it
+        # would stick at its post-burst low forever while the bucket
+        # quietly refilled — a permanent false alarm for operators
+        # alerting on "0 = retries disabled"
+        self._observe_retry_budget()
+        # Fleet-level admission: reject at the SUMMED queued-token
+        # estimate across accepting replicas. Without this, per-replica
+        # caps let a dying fleet funnel the whole offered load onto the
+        # last healthy engine — the cap the fleet was sized for, not the
+        # cap one replica was.
+        if self.fleet_max_queue_tokens > 0:
+            queued = sum(
+                e.load_tokens() for e in self.engines if e.accepting()
+            )
+            if queued >= self.fleet_max_queue_tokens:
+                self.fleet_rejected += 1
+                if self.metrics is not None:
+                    # its own series, NOT app_llm_sheds_predicted_total:
+                    # a queue-cap rejection and a predicted-wait shed are
+                    # different causes and operators alert on them
+                    # differently
+                    self.metrics.increment_counter(
+                        "app_llm_fleet_rejected_total", model=self.label
+                    )
+                raise EngineOverloaded(
+                    f"fleet queue full ({queued} >= "
+                    f"{self.fleet_max_queue_tokens} queued tokens)",
+                    retry_after=self._fleet_retry_after(queued),
+                )
+        # Error classification (docs/advanced-guide/overload.md):
+        # - EngineStoppedError / EngineDraining are RETRYABLE — the
+        #   replica died or began draining between pick and submit, and
+        #   another replica can serve the request. Retries past the first
+        #   attempt draw from the retry budget (no retry storms).
+        # - EngineOverloaded is NON-RETRYABLE: the router already picked
+        #   the least-loaded replica, so every other replica is at least
+        #   as loaded — walking the fleet would turn one client's 429
+        #   into fleet-wide overload amplification.
+        # Bounded: the supervisor may swap replacements in mid-loop, so
+        # the exclusion set alone is not a terminator.
         tried: set[int] = set()
-        for _ in range(2 * len(self.engines) + 2):
+        first_err: Exception | None = None
+        for attempt in range(2 * len(self.engines) + 2):
+            if attempt > 0 and not self.retry_budget.take():
+                self.retry_budget_exhausted += 1
+                self._observe_retry_budget()
+                raise first_err  # budget spent: surface the original error
+            if attempt > 0:
+                self._observe_retry_budget()
             eng = self._pick(exclude=tried)
             try:
                 return eng.submit(req)
-            except EngineStoppedError:
+            except (EngineStoppedError, EngineDraining) as e:
+                first_err = first_err or e
                 tried.add(id(eng))
-        raise EngineStoppedError("all replicas dead")
+        raise first_err or EngineStoppedError("all replicas dead")
+
+    def _fleet_retry_after(self, queued_tokens: int) -> float:
+        """Retry-After for a fleet-level rejection: excess backlog over
+        the cap, priced at the fleet's pooled measured throughput (1 s
+        floor when no replica has an estimate yet)."""
+        tput = sum(e._tput_ema or 0.0 for e in self.engines if e.alive())
+        if tput <= 1e-9:
+            return 1.0
+        excess = max(0, queued_tokens - self.fleet_max_queue_tokens)
+        return max(0.5, excess / tput) if excess else 1.0
+
+    def _observe_retry_budget(self) -> None:
+        if self.metrics is not None:
+            self.metrics.set_gauge(
+                "app_llm_retry_budget_remaining",
+                self.retry_budget.remaining(), model=self.label,
+            )
 
     # -- in-flight failover (gofr_tpu.resilience) --------------------------
     def _failover(self, reqs: list[GenRequest]) -> None:
@@ -3083,7 +3560,17 @@ class ReplicatedLLMEngine:
         for r in reqs:
             r.retries += 1
             placed = False
+            budget_ok = True
             if r.retries <= self.failover_retries:
+                # failover re-dispatch is a router-side retry: it draws
+                # from the same budget as submit-time retries, so a
+                # crash-looping replica under overload cannot multiply
+                # its queued work across the survivors forever
+                budget_ok = self.retry_budget.take()
+                if not budget_ok:
+                    self.retry_budget_exhausted += 1
+                self._observe_retry_budget()
+            if budget_ok and r.retries <= self.failover_retries:
                 if r.history:
                     r.prompt_tokens = list(r.prompt_tokens) + r.history
                     r.history = []
@@ -3163,6 +3650,16 @@ class ReplicatedLLMEngine:
             "failovers": self.failovers,
             "failover_errors": self.failover_errors,
             "restarts": self.supervisor.restarts if self.supervisor else 0,
+            # fleet overload control (docs/advanced-guide/overload.md)
+            "preemptions": sum(s.get("preemptions", 0) for s in per),
+            "sheds_predicted": sum(s.get("sheds_predicted", 0) for s in per),
+            "fleet_rejected": self.fleet_rejected,
+            "fleet_max_queue_tokens": self.fleet_max_queue_tokens,
+            "retry_budget_remaining": round(self.retry_budget.remaining(), 2),
+            "retry_budget_exhausted": self.retry_budget_exhausted,
+            "fairness": (
+                self.ledger.snapshot() if self.ledger is not None else None
+            ),
             "slots": sum(s["slots"] for s in per),
             "active": sum(s["active"] for s in per),
             "waiting": sum(s["waiting"] for s in per),
@@ -3236,6 +3733,17 @@ class ReplicatedLLMEngine:
             "failovers": self.failovers,
             "failover_errors": self.failover_errors,
             "failover_retries": self.failover_retries,
+            "fleet_rejected": self.fleet_rejected,
+            "fleet_max_queue_tokens": self.fleet_max_queue_tokens,
+            "retry_budget": {
+                "remaining": round(self.retry_budget.remaining(), 2),
+                "rate_per_s": self.retry_budget.rate,
+                "burst": self.retry_budget.burst,
+                "exhausted": self.retry_budget_exhausted,
+            },
+            "fairness": (
+                self.ledger.snapshot() if self.ledger is not None else None
+            ),
             "supervisor": (
                 self.supervisor.snapshot()
                 if self.supervisor is not None else None
@@ -3265,3 +3773,9 @@ class ReplicatedLLMEngine:
             self.supervisor.close()
         for e in self.engines:
             e.close()
+        if self.metrics is not None:
+            # a closed fleet must not keep exporting its last budget
+            # level (the dead-engine gauge bug class)
+            self.metrics.set_gauge(
+                "app_llm_retry_budget_remaining", 0.0, model=self.label
+            )

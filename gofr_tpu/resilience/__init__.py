@@ -30,15 +30,19 @@ from __future__ import annotations
 import threading
 
 from .faults import FAULT_POINTS, FaultInjector, InjectedFault, default_injector
+from .overload import FairLedger, OverloadController, RetryBudget
 from .supervisor import ReplicaSupervisor
 from .watchdog import Heartbeat, StepWatchdog
 
 __all__ = [
     "FAULT_POINTS",
+    "FairLedger",
     "FaultInjector",
     "Heartbeat",
     "InjectedFault",
+    "OverloadController",
     "ReplicaSupervisor",
+    "RetryBudget",
     "StepWatchdog",
     "default_injector",
     "register_resilience_metrics",
@@ -71,11 +75,30 @@ def register_resilience_metrics(metrics) -> None:
              "passed"),
             ("app_llm_faults_injected_total",
              "faults fired by the injection harness (chaos only)"),
+            ("app_llm_preemptions_total",
+             "llm batch-class requests preempted (slot freed, requeued "
+             "as a continuation) to admit interactive traffic"),
+            ("app_llm_sheds_predicted_total",
+             "llm requests shed at submit because predicted queue wait "
+             "crossed the shed threshold (429 + Retry-After)"),
+            ("app_llm_fleet_rejected_total",
+             "llm requests rejected at the fleet queued-token admission "
+             "cap (429 + Retry-After)"),
         ):
             if not metrics.has(name):
                 metrics.new_counter(name, desc)
-        if not metrics.has("app_llm_drain_state"):
-            metrics.new_gauge(
-                "app_llm_drain_state",
-                "llm engine drain state (0 serving, 1 draining)",
-            )
+        for name, desc in (
+            ("app_llm_drain_state",
+             "llm engine drain state (0 serving, 1 draining)"),
+            ("app_llm_brownout_state",
+             "llm brownout mode (0 normal, 1 batch max_new_tokens "
+             "clamped under sustained pressure)"),
+            ("app_llm_fairness_debt",
+             "spread (max-min) of weighted served-token counters across "
+             "clients with waiting work — 0 is perfectly fair"),
+            ("app_llm_retry_budget_remaining",
+             "router retry-budget tokens remaining (token bucket; 0 "
+             "means retries surface the original error)"),
+        ):
+            if not metrics.has(name):
+                metrics.new_gauge(name, desc)

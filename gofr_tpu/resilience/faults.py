@@ -23,6 +23,13 @@ Named failure points (armed per point, optionally per engine label):
 - ``replica_kill``   — the next scheduler pass calls ``_die`` (terminal
                        replica death; exercises in-flight failover and
                        supervised restart).
+- ``overload_pressure`` — the next submit() sees a predicted queue wait
+                       of ``delay`` seconds (default 3600) regardless of
+                       the real backlog, driving the brownout/shed
+                       overload controller deterministically (exercises
+                       predicted-wait shedding, Retry-After computation,
+                       and brownout engagement without constructing real
+                       queue pressure).
 
 Arming: the Python API (``injector.arm(point, ...)``) for tests and the
 chaos smoke, or the ``TPU_LLM_FAULTS`` env var for a black-box process —
@@ -44,7 +51,13 @@ from dataclasses import dataclass, field
 
 __all__ = ["FaultInjector", "InjectedFault", "default_injector", "FAULT_POINTS"]
 
-FAULT_POINTS = ("device_step", "step_latency", "admission_oom", "replica_kill")
+FAULT_POINTS = (
+    "device_step",
+    "step_latency",
+    "admission_oom",
+    "replica_kill",
+    "overload_pressure",
+)
 
 
 class InjectedFault(RuntimeError):

@@ -1,0 +1,354 @@
+#!/usr/bin/env python
+"""CI overload smoke: priority preemption, per-client fair queuing, and
+shed-with-Retry-After, over real sockets at ~2x offered capacity.
+
+Boots a 2-replica CPU fleet (two virtual devices) behind a tiny-model
+app and drives the overload contract (docs/advanced-guide/overload.md):
+
+- a 10:1 heavy:light batch client mix at ~2x measured capacity cannot
+  push the light client below 80% of its weighted entitlement (its
+  offered demand here — demand sits under its fair share, so ALL of it
+  should be served promptly; FIFO would tail it behind the flood),
+- interactive p99 TTFT stays bounded (<= 2x its uncontended value plus
+  a scheduling-step margin) while batch absorbs the pressure via
+  preemption — zero batch errors, preemption counter > 0,
+- a shed response (429) carries a finite Retry-After header, driven
+  deterministically by the overload_pressure fault point,
+- the overload counters are live on /metrics.
+
+Usage: JAX_PLATFORMS=cpu python scripts/smoke_overload.py
+Exit codes: 0 clean, non-zero assertion failure (message on stderr).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# two virtual CPU devices for the two replicas — BEFORE jax import
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=2"
+    ).strip()
+
+NEW_TOKENS = 16
+PROMPT = list(range(1, 9))
+WINDOW_S = 6.0
+
+
+def main() -> int:  # noqa: PLR0915 — a smoke is a script, not a library
+    import jax
+
+    from gofr_tpu import App
+    from gofr_tpu.config import new_mock_config
+    from gofr_tpu.handler import llm_request_kwargs
+    from gofr_tpu.http.responder import StreamingResponse
+    from gofr_tpu.llm import GenRequest
+    from gofr_tpu.resilience import FaultInjector
+
+    from gofr_tpu.models import TransformerConfig, init_params
+
+    cfg = TransformerConfig.tiny()
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    assert len(jax.devices()) >= 2, jax.devices()
+    inj = FaultInjector()
+    app = App(config=new_mock_config({
+        "APP_NAME": "overload-smoke", "HTTP_PORT": "0", "METRICS_PORT": "0",
+        "LOG_LEVEL": "ERROR", "TPU_TELEMETRY_INTERVAL_S": "0",
+        "REQUEST_TIMEOUT": "120",
+    }))
+    app.container.tpu().register_llm(
+        "tiny", cfg, params, replicas=2, slots=2, max_seq_len=128,
+        prefill_buckets=(8,), prefill_chunk=4, step_token_budget=8,
+        decode_chunk=2, lookahead=1, warmup=False, fault_injector=inj,
+        # shed threshold far above anything this smoke's real load can
+        # reach: live traffic never sheds; the fault point drives it
+        shed_predicted_wait_s=30.0,
+    )
+
+    def gen(ctx):
+        body = ctx.bind()
+        out = ctx.tpu().llm("tiny").generate(
+            list(body["tokens"]),
+            max_new_tokens=int(body.get("max_new_tokens", NEW_TOKENS)),
+            **llm_request_kwargs(ctx),
+        )
+        return {"tokens": out}
+
+    async def stream(ctx):
+        body = ctx.bind()
+        req = ctx.tpu().llm("tiny").submit(GenRequest(
+            list(body["tokens"]),
+            max_new_tokens=int(body.get("max_new_tokens", 4)),
+            **llm_request_kwargs(ctx),
+        ))
+
+        async def chunks():
+            async for tok in req.astream():
+                yield (json.dumps({"t": tok}) + "\n").encode()
+
+        return StreamingResponse(chunks(), content_type="application/jsonl")
+
+    app.post("/generate", gen)
+    app.post("/stream", stream)
+    app.run_in_background()
+    base = f"http://127.0.0.1:{app.http_server.port}"
+    mbase = f"http://127.0.0.1:{app.metrics_server.port}"
+    rep = app.container.tpu().llm("tiny")
+
+    def post(path: str, payload: dict, headers: dict, timeout: float = 120):
+        req = urllib.request.Request(
+            f"{base}{path}", data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json", **headers},
+            method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return json.loads(r.read())
+
+    def gen_once(client: str, priority: str = "batch") -> int:
+        out = post(
+            "/generate", {"tokens": PROMPT, "max_new_tokens": NEW_TOKENS},
+            {"X-GoFr-Client": client, "X-GoFr-Priority": priority},
+        )
+        return len(out["data"]["tokens"])
+
+    def stream_ttft(client: str) -> float:
+        """Interactive request over the streaming route; returns seconds
+        to the first emitted chunk (client-observed TTFT)."""
+        req = urllib.request.Request(
+            f"{base}/stream",
+            data=json.dumps({"tokens": PROMPT, "max_new_tokens": 4}).encode(),
+            headers={"Content-Type": "application/json",
+                     "X-GoFr-Client": client,
+                     "X-GoFr-Priority": "interactive"},
+            method="POST",
+        )
+        t0 = time.monotonic()
+        with urllib.request.urlopen(req, timeout=60) as r:
+            first = r.read(1)
+            ttft = time.monotonic() - t0
+            assert first, "stream ended with no tokens"
+            r.read()
+        return ttft
+
+    try:
+        # -- phase 0: warm the executables + uncontended baselines --------
+        gen_once("warm")
+        t0 = time.monotonic()
+        for _ in range(2):
+            gen_once("warm")
+        uncontended_latency = (time.monotonic() - t0) / 2
+        unc_ttfts = [stream_ttft("probe") for _ in range(6)]
+        unc_p99 = max(unc_ttfts)
+        print(f"uncontended: request {uncontended_latency*1e3:.0f} ms, "
+              f"ttft p99 {unc_p99*1e3:.0f} ms")
+
+        # -- phase 0.5: measure capacity (closed loop, all 4 slots) -------
+        cap_done = {"tokens": 0}
+        cap_stop = threading.Event()
+        cap_lock = threading.Lock()
+
+        def cap_client():
+            while not cap_stop.is_set():
+                n = gen_once("cap")
+                with cap_lock:
+                    cap_done["tokens"] += n
+
+        cap_threads = [threading.Thread(target=cap_client) for _ in range(4)]
+        for t in cap_threads:
+            t.start()
+        time.sleep(2.5)
+        cap_stop.set()
+        for t in cap_threads:
+            t.join(timeout=120)
+        capacity = cap_done["tokens"] / 2.5
+        print(f"measured capacity ~{capacity:.0f} tok/s")
+
+        # -- phase 1: 2x offered load, 10:1 heavy:light, + probes ---------
+        offered = 2.0 * capacity
+        heavy_rate = (offered * 10 / 11) / NEW_TOKENS  # req/s
+        light_rate = heavy_rate / 10
+        done: list[tuple[str, int, float, float]] = []  # client, n, t_sub, t_done
+        errors: list[str] = []
+        outstanding = {"n": 0}
+        lock = threading.Lock()
+        stop = threading.Event()
+
+        def one(client: str):
+            t_sub = time.monotonic()
+            with lock:
+                outstanding["n"] += 1
+            try:
+                n = gen_once(client)
+                with lock:
+                    done.append((client, n, t_sub, time.monotonic()))
+            except Exception as e:  # noqa: BLE001 — errors ARE the measurement
+                with lock:
+                    errors.append(f"{client}: {e}")
+            finally:
+                with lock:
+                    outstanding["n"] -= 1
+
+        def pace(client: str, rate: float):
+            interval = 1.0 / max(rate, 0.1)
+            nxt = time.monotonic()
+            while not stop.is_set():
+                now = time.monotonic()
+                if now < nxt:
+                    time.sleep(min(0.01, nxt - now))
+                    continue
+                nxt += interval
+                threading.Thread(
+                    target=one, args=(client,), daemon=True,
+                ).start()
+
+        pacers = [
+            threading.Thread(target=pace, args=("heavy", heavy_rate)),
+            threading.Thread(target=pace, args=("light", light_rate)),
+        ]
+        t_start = time.monotonic()
+        for t in pacers:
+            t.start()
+        loaded_ttfts = []
+        while time.monotonic() - t_start < WINDOW_S:
+            loaded_ttfts.append(stream_ttft("probe"))
+            time.sleep(0.15)
+        t_cutoff = time.monotonic()
+        stop.set()
+        for t in pacers:
+            t.join(timeout=10)
+        # let the tail drain so heavy requests can't error at shutdown
+        deadline = time.monotonic() + 90
+        while outstanding["n"] > 0 and time.monotonic() < deadline:
+            time.sleep(0.05)
+
+        with lock:
+            snap = list(done)
+            errs = list(errors)
+
+        assert not errs, f"batch requests errored under overload: {errs[:5]}"
+
+        # fairness: every light token offered a round-trip before the
+        # cutoff should be served by the cutoff — light demand (2x cap /
+        # 11) sits far under its weight-1 fair share (cap / 2)
+        grace = 2 * uncontended_latency + 0.5
+        light_offered = sum(
+            NEW_TOKENS for c, _n, t_sub, _t in snap
+            if c == "light" and t_sub <= t_cutoff - grace
+        )
+        light_done = sum(
+            n for c, n, _t, t_d in snap if c == "light" and t_d <= t_cutoff
+        )
+        heavy_done = sum(
+            n for c, n, _t, t_d in snap if c == "heavy" and t_d <= t_cutoff
+        )
+        assert light_offered > 0, "no light traffic made it in-window"
+        share = light_done / max(1, light_offered)
+        print(f"fairness: light {light_done}/{light_offered} entitled tokens "
+              f"({share:.2f}), heavy served {heavy_done}")
+        assert light_done >= 0.8 * light_offered, (
+            f"light client starved: {light_done} < 0.8 x {light_offered}"
+        )
+
+        # interactive latency while batch absorbs the pressure. The p99
+        # over ~36 probes is the max; one probe can hit an unrelated
+        # host-side stall (GC, CI noisy neighbor), so the single worst
+        # sample is dropped — systematic queueing (the failure this
+        # guards) shifts MANY samples, never exactly one.
+        ordered = sorted(loaded_ttfts)
+        loaded_p99 = ordered[-2] if len(ordered) >= 20 else ordered[-1]
+        bound = 2.0 * unc_p99 + 0.25
+        print(f"interactive ttft p99 loaded {loaded_p99*1e3:.0f} ms "
+              f"(bound {bound*1e3:.0f} ms, {len(loaded_ttfts)} probes)")
+        assert loaded_p99 <= bound, (
+            f"interactive p99 TTFT {loaded_p99:.3f}s exceeds {bound:.3f}s"
+        )
+
+        # -- phase 1.5: preemption — long batch decodes pin every slot ----
+        # (the 16-token flood above churns slots too fast to ever need a
+        # preemption; a slot pinned by an 80-token decode is the case the
+        # mechanism exists for)
+        long_results: list[int] = []
+        long_errors: list[str] = []
+
+        def long_batch():
+            try:
+                out = post(
+                    "/generate", {"tokens": PROMPT, "max_new_tokens": 80},
+                    {"X-GoFr-Client": "heavy", "X-GoFr-Priority": "batch"},
+                )
+                with lock:
+                    long_results.append(len(out["data"]["tokens"]))
+            except Exception as e:  # noqa: BLE001
+                with lock:
+                    long_errors.append(str(e))
+
+        longs = [threading.Thread(target=long_batch) for _ in range(4)]
+        for t in longs:
+            t.start()
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            slotted = sum(
+                1 for e in rep.engines for r in e._slot_req if r is not None
+            )
+            if slotted >= 4:
+                break
+            time.sleep(0.02)
+        preempt_ttft = stream_ttft("probe")  # must take a batch slot back
+        for t in longs:
+            t.join(timeout=120)
+        st = rep.stats()
+        assert not long_errors, (
+            f"preempted batch requests errored: {long_errors}"
+        )
+        assert long_results == [80, 80, 80, 80], (
+            f"preempted batch requests truncated: {long_results}"
+        )
+        assert st["preemptions"] > 0, (
+            "interactive pressure never preempted a batch slot"
+        )
+        print(f"preemption OK: ttft {preempt_ttft*1e3:.0f} ms with all "
+              f"slots pinned, preemptions={st['preemptions']}, "
+              f"batch completed intact, "
+              f"fairness debt={st['fairness']['debt_spread']:.0f}")
+
+        # -- phase 2: shed carries a finite Retry-After -------------------
+        inj.arm("overload_pressure", count=1, delay=45.0)
+        try:
+            gen_once("shed-probe")
+            raise AssertionError("armed overload_pressure did not shed")
+        except urllib.error.HTTPError as e:
+            assert e.code == 429, e.code
+            ra = e.headers.get("Retry-After")
+            assert ra is not None and float(ra) > 0, f"Retry-After: {ra!r}"
+            print(f"shed OK: 429 with Retry-After {ra}s")
+
+        # -- phase 3: counters on /metrics over the real socket -----------
+        with urllib.request.urlopen(f"{mbase}/metrics", timeout=15) as r:
+            expo = r.read().decode()
+        for name in ("app_llm_preemptions_total",
+                     "app_llm_sheds_predicted_total",
+                     "app_llm_fairness_debt",
+                     "app_llm_brownout_state"):
+            assert name in expo, f"{name} missing from /metrics"
+        print("smoke_overload: OK")
+        return 0
+    finally:
+        app.shutdown()
+
+
+if __name__ == "__main__":
+    rc = main()
+    sys.stdout.flush()
+    sys.stderr.flush()
+    # _exit skips interpreter teardown (see smoke_profiling.py: XLA
+    # destructors intermittently abort after all work completed)
+    os._exit(rc)

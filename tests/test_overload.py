@@ -1,0 +1,810 @@
+"""Overload-robustness tests: priority classes + preemption, per-client
+weighted fair queuing, adaptive shedding with brownout, fleet admission,
+and the router retry budget (docs/advanced-guide/overload.md).
+
+The load-bearing invariant mirrors test_resilience's: overload control
+may change SCHEDULING, never RESULTS — a batch request preempted for
+interactive traffic must emit exactly the tokens an uncontended run
+would (the continuation re-seed), and a shed request must be told WHEN
+to come back (finite Retry-After), never silently dropped.
+
+State machines (brownout, retry budget) are driven with faked clocks;
+engine-level paths run on the CPU backend with the same tiny shapes the
+resilience suite uses. scripts/smoke_overload.py drives the same
+machinery over real sockets in CI."""
+
+import threading
+import time
+
+import jax
+import pytest
+
+from gofr_tpu.llm import (
+    EngineDraining,
+    EngineOverloaded,
+    EngineStoppedError,
+    GenRequest,
+    LLMEngine,
+    ReplicatedLLMEngine,
+)
+from gofr_tpu.models import TransformerConfig, init_params
+from gofr_tpu.resilience import (
+    FairLedger,
+    FaultInjector,
+    OverloadController,
+    RetryBudget,
+)
+
+CFG = TransformerConfig.tiny()
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(jax.random.PRNGKey(0), CFG)
+
+
+def _wait(pred, timeout: float, what: str = "condition") -> None:
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return
+        time.sleep(0.01)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def _engine(params, **kw) -> LLMEngine:
+    kw.setdefault("slots", 2)
+    kw.setdefault("max_seq_len", 128)
+    kw.setdefault("prefill_buckets", (8,))
+    kw.setdefault("prefill_chunk", 4)
+    kw.setdefault("step_token_budget", 4)
+    kw.setdefault("decode_chunk", 2)
+    kw.setdefault("lookahead", 1)
+    kw.setdefault("warmup", False)
+    return LLMEngine(CFG, params, **kw)
+
+
+class FakeClock:
+    def __init__(self, t: float = 100.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+# ---------------------------------------------------------------------------
+# FairLedger (virtual token counters)
+# ---------------------------------------------------------------------------
+class TestFairLedger:
+    def test_charge_orders_least_served_first(self):
+        led = FairLedger()
+        led.touch("a")  # both enter the ledger at the (empty) floor,
+        led.touch("b")  # exactly as submit() touches real clients
+        led.charge("a", 100)
+        led.charge("b", 10)
+        led.set_active("e", {"a", "b"})
+        assert led.counter("b") < led.counter("a")
+
+    def test_weight_discounts_charges(self):
+        led = FairLedger({"paid": 4.0})
+        led.charge("paid", 100)
+        led.charge("free", 100)
+        # the weighted client is billed a quarter per served token
+        assert led.counter("paid") == pytest.approx(25.0)
+        assert led.counter("free") == pytest.approx(100.0)
+
+    def test_new_arrival_lifts_to_active_floor(self):
+        led = FairLedger()
+        led.set_active("e", {"a", "b"})
+        led.charge("a", 50)
+        led.charge("b", 80)
+        led.touch("fresh")  # floor = min(active) = 50, not 0
+        assert led.counter("fresh") == pytest.approx(50.0)
+        # reconnecting under a fresh name banks no credit
+        led.touch("fresh2")
+        assert led.counter("fresh2") >= 50.0
+
+    def test_idle_return_keeps_earned_debt(self):
+        led = FairLedger()
+        led.set_active("e", {"hog"})
+        led.charge("hog", 200)
+        led.touch("hog")  # lift never LOWERS a counter
+        assert led.counter("hog") == pytest.approx(200.0)
+
+    def test_debt_spread_active_only(self):
+        led = FairLedger()
+        led.charge("a", 100)
+        led.charge("b", 10)
+        assert led.debt_spread() == 0.0  # nobody waiting
+        led.set_active("e", {"a", "b"})
+        assert led.debt_spread() == pytest.approx(90.0)
+        led.set_active("e", {"a"})
+        assert led.debt_spread() == 0.0
+
+    def test_cap_bounds_clients(self):
+        led = FairLedger(max_clients=4)
+        for i in range(10):
+            led.touch(f"c{i}")
+        assert led.snapshot()["clients"] <= 4
+
+    def test_eviction_keeps_heavy_debt(self):
+        """Debt laundering regression: a flooder spraying spoofed fresh
+        ids must not evict its own heavy counter — eviction discards the
+        least-debt entries (whose loss is free), never the hitters."""
+        led = FairLedger(max_clients=4)
+        led.touch("flooder")
+        led.charge("flooder", 10_000)
+        for i in range(20):
+            led.touch(f"spoof{i}")  # fresh ids enter at the floor (0)
+        assert led.counter("flooder") == pytest.approx(10_000.0)
+        assert "flooder" in led.snapshot()["counters"]
+
+    def test_shard_union_across_replicas(self):
+        led = FairLedger()
+        led.charge("a", 10)
+        led.charge("b", 90)
+        led.set_active("r0", {"a"})
+        led.set_active("r1", {"b"})
+        assert led.debt_spread() == pytest.approx(80.0)
+        led.set_active("r1", set())  # replica drained/closed
+        assert led.debt_spread() == 0.0
+
+
+# ---------------------------------------------------------------------------
+# RetryBudget (token bucket)
+# ---------------------------------------------------------------------------
+class TestRetryBudget:
+    def test_burst_then_exhausted(self):
+        clock = FakeClock()
+        b = RetryBudget(rate=0.0, burst=2, now_fn=clock)
+        assert b.take() and b.take()
+        assert not b.take()
+
+    def test_refill_at_rate(self):
+        clock = FakeClock()
+        b = RetryBudget(rate=2.0, burst=4, now_fn=clock)
+        for _ in range(4):
+            assert b.take()
+        assert not b.take()
+        clock.advance(1.0)  # 2 tokens back
+        assert b.take() and b.take()
+        assert not b.take()
+
+    def test_refill_caps_at_burst(self):
+        clock = FakeClock()
+        b = RetryBudget(rate=100.0, burst=3, now_fn=clock)
+        clock.advance(60.0)
+        assert b.remaining() == pytest.approx(3.0)
+
+    def test_zero_budget_disables_retries(self):
+        b = RetryBudget(rate=0.0, burst=0.0, now_fn=FakeClock())
+        assert not b.take()
+
+
+# ---------------------------------------------------------------------------
+# OverloadController (brownout state machine + shed)
+# ---------------------------------------------------------------------------
+class TestOverloadController:
+    def test_brownout_engages_after_sustained_hold(self):
+        clock = FakeClock()
+        c = OverloadController(
+            brownout_wait_s=1.0, brownout_max_new=8, brownout_hold_s=2.0,
+            now_fn=clock,
+        )
+        c.observe(5.0)
+        assert not c.brownout  # pressure must SUSTAIN, not spike
+        clock.advance(1.0)
+        c.observe(5.0)
+        assert not c.brownout
+        clock.advance(1.5)
+        c.observe(5.0)
+        assert c.brownout
+
+    def test_pressure_blip_resets_hold(self):
+        clock = FakeClock()
+        c = OverloadController(
+            brownout_wait_s=1.0, brownout_max_new=8, brownout_hold_s=2.0,
+            now_fn=clock,
+        )
+        c.observe(5.0)
+        clock.advance(1.9)
+        c.observe(0.1)  # dip below threshold: the clock restarts
+        clock.advance(0.2)
+        c.observe(5.0)
+        assert not c.brownout
+
+    def test_brownout_exits_with_hysteresis(self):
+        clock = FakeClock()
+        c = OverloadController(
+            brownout_wait_s=1.0, brownout_max_new=8, brownout_hold_s=0.0,
+            now_fn=clock,
+        )
+        c.observe(5.0)
+        assert c.brownout
+        c.observe(0.8)  # under threshold but above half: still browned
+        assert c.brownout
+        c.observe(0.3)  # under half: exit (hold 0)
+        assert not c.brownout
+
+    def test_clamp_batch_only(self):
+        c = OverloadController(
+            brownout_wait_s=1.0, brownout_max_new=8, brownout_hold_s=0.0,
+            now_fn=FakeClock(),
+        )
+        c.observe(5.0)
+        assert c.clamp(64, "batch") == 8
+        assert c.clamp(64, "interactive") == 64
+        assert c.clamp(4, "batch") == 4  # never grows a request
+
+    def test_shed_direct_when_no_brownout_configured(self):
+        c = OverloadController(shed_wait_s=2.0, now_fn=FakeClock())
+        assert c.should_shed(1.0) is None
+        assert c.should_shed(None) is None
+        ra = c.should_shed(7.5)
+        assert ra == pytest.approx(5.5)  # time for the backlog to drain
+
+    def test_degrade_before_shed(self):
+        clock = FakeClock()
+        c = OverloadController(
+            shed_wait_s=2.0, brownout_wait_s=1.0, brownout_max_new=8,
+            brownout_hold_s=1.0, now_fn=clock,
+        )
+        c.observe(10.0)
+        # pressure is over the shed line, but brownout has not engaged:
+        # degrade first, shed only past the degrade stage
+        assert c.should_shed(10.0) is None
+        clock.advance(1.5)
+        c.observe(10.0)
+        assert c.brownout
+        assert c.should_shed(10.0) == pytest.approx(8.0)
+
+    def test_retry_after_floor(self):
+        c = OverloadController(shed_wait_s=2.0, now_fn=FakeClock())
+        assert c.should_shed(2.01) == pytest.approx(0.5)  # min_retry_after
+
+
+# ---------------------------------------------------------------------------
+# engine: predicted-wait shed + brownout (deterministic, no real pressure)
+# ---------------------------------------------------------------------------
+class TestEngineShedding:
+    def test_predicted_shed_fires_before_max_queue(self, params, monkeypatch):
+        eng = _engine(params, max_queue=64, shed_predicted_wait_s=1.0)
+        try:
+            monkeypatch.setattr(eng, "_admit", lambda: False)  # freeze queue
+            eng._tput_ema = 50.0  # measured 50 tok/s
+            for _ in range(2):  # 2 x (8 prompt + 20 decode) = 56 queued
+                eng.submit(GenRequest(list(range(1, 9)), max_new_tokens=20))
+            with pytest.raises(EngineOverloaded) as ei:
+                eng.submit(GenRequest(list(range(1, 9)), max_new_tokens=20))
+            # predicted 56/50 = 1.12 s > 1.0 s: shed EARLY — the queue cap
+            # (64) is nowhere near hit and the queue-full counter is clean
+            assert eng.sheds_predicted == 1
+            assert eng.rejected == 0
+            ra = ei.value.retry_after
+            assert ra is not None and 0 < ra < 60
+        finally:
+            eng.close()
+
+    def test_queue_full_429_carries_retry_after(self, params, monkeypatch):
+        eng = _engine(params, max_queue=1)
+        try:
+            monkeypatch.setattr(eng, "_admit", lambda: False)
+            eng.submit(GenRequest([1, 2, 3], max_new_tokens=4))
+            with pytest.raises(EngineOverloaded) as ei:
+                eng.submit(GenRequest([1, 2, 3], max_new_tokens=4))
+            assert ei.value.retry_after is not None
+            assert 0 < ei.value.retry_after < float("inf")
+        finally:
+            eng.close()
+
+    def test_overload_pressure_fault_point(self, params, monkeypatch):
+        inj = FaultInjector()
+        eng = _engine(
+            params, shed_predicted_wait_s=1.0, fault_injector=inj,
+        )
+        try:
+            monkeypatch.setattr(eng, "_admit", lambda: False)
+            inj.arm("overload_pressure", delay=9.0)
+            with pytest.raises(EngineOverloaded) as ei:
+                eng.submit(GenRequest([1, 2, 3], max_new_tokens=4))
+            assert ei.value.retry_after == pytest.approx(8.0)
+            # one-shot: the next submit sees the real (empty) queue
+            eng.submit(GenRequest([1, 2, 3], max_new_tokens=4))
+            assert inj.fired("overload_pressure") == 1
+        finally:
+            eng.close()
+
+    def test_brownout_clamps_batch_then_restores(self, params, monkeypatch):
+        eng = _engine(
+            params, brownout_wait_s=1.0, brownout_max_new=4,
+            brownout_hold_s=0.0,
+        )
+        try:
+            monkeypatch.setattr(eng, "_admit", lambda: False)
+            eng._tput_ema = 10.0
+            eng.submit(GenRequest(list(range(1, 9)), max_new_tokens=20))
+            # predicted wait now 28/10 = 2.8 s > 1.0 s: brownout engages
+            # (hold 0) and the BATCH request is clamped...
+            rb = eng.submit(GenRequest(
+                list(range(1, 9)), max_new_tokens=20, priority="batch",
+            ))
+            assert eng.overload.brownout
+            assert rb.max_new_tokens == 4 and rb.browned
+            # ...while interactive requests keep their full budget
+            ri = eng.submit(GenRequest(list(range(1, 9)), max_new_tokens=20))
+            assert ri.max_new_tokens == 20 and not ri.browned
+            # pressure gone (no throughput estimate -> no pressure):
+            # brownout exits and batch is whole again
+            eng._tput_ema = None
+            rb2 = eng.submit(GenRequest(
+                list(range(1, 9)), max_new_tokens=20, priority="batch",
+            ))
+            assert not eng.overload.brownout
+            assert rb2.max_new_tokens == 20 and not rb2.browned
+        finally:
+            eng.close()
+
+    def test_brownout_clamp_respects_continuation_emitted(self, params,
+                                                          monkeypatch):
+        eng = _engine(
+            params, brownout_wait_s=1.0, brownout_max_new=4,
+            brownout_hold_s=0.0,
+        )
+        try:
+            monkeypatch.setattr(eng, "_admit", lambda: False)
+            eng._tput_ema = 1.0
+            eng.submit(GenRequest(list(range(1, 9)), max_new_tokens=20))
+            # a continuation that already streamed 10 tokens must get
+            # emitted + clamp, never clamped below what it delivered
+            r = GenRequest(list(range(1, 9)), max_new_tokens=20,
+                           priority="batch")
+            r.emitted = 10
+            eng.submit(r)
+            assert r.max_new_tokens == 14  # 10 emitted + 4 brownout budget
+        finally:
+            eng.close()
+
+
+# ---------------------------------------------------------------------------
+# engine: fair queuing + priority ordering
+# ---------------------------------------------------------------------------
+class TestFairQueuing:
+    def test_waiting_order_fair_then_fifo(self, params):
+        eng = _engine(params)
+        try:
+            led = eng.ledger
+            assert led is not None  # on by default
+            led.touch("hog")
+            led.touch("lite")
+            led.charge("hog", 1000)
+            reqs = {
+                "h1": GenRequest([1], client="hog"),
+                "h2": GenRequest([1], client="hog"),
+                "lite": GenRequest([1], client="lite"),
+                "inter": GenRequest([1], client="hog", priority="interactive"),
+            }
+            reqs["h1"].priority = reqs["h2"].priority = "batch"
+            reqs["lite"].priority = "batch"
+            with eng._lock:
+                eng._waiting = [
+                    reqs["h1"], reqs["h2"], reqs["lite"], reqs["inter"],
+                ]
+            eng._order_waiting()
+            with eng._lock:
+                order = list(eng._waiting)
+            # interactive first regardless of client debt; then the
+            # least-served client; FIFO (submit id) breaks ties
+            assert order[0] is reqs["inter"]
+            assert order[1] is reqs["lite"]
+            assert order[2] is reqs["h1"] and order[3] is reqs["h2"]
+        finally:
+            eng.close()
+
+    def test_flood_cannot_starve_light_client(self, params):
+        eng = _engine(params, slots=1)
+        try:
+            done: list[str] = []
+            lock = threading.Lock()
+
+            def consume(req, name):
+                req.tokens(timeout=120)
+                with lock:
+                    done.append(name)
+
+            threads = []
+            reqs = []
+            for i in range(5):
+                r = eng.submit(GenRequest(
+                    [7, 3, 5, 2, 9, 4], max_new_tokens=6, client="heavy",
+                ))
+                reqs.append((r, f"h{i}"))
+            for i in range(2):
+                r = eng.submit(GenRequest(
+                    [6, 1, 8, 2, 4, 3], max_new_tokens=6, client="light",
+                ))
+                reqs.append((r, f"l{i}"))
+            for r, name in reqs:
+                t = threading.Thread(target=consume, args=(r, name))
+                t.start()
+                threads.append(t)
+            for t in threads:
+                t.join(timeout=120)
+            assert len(done) == 7, done
+            # fair queuing: after the head-of-line heavy request, the
+            # light client's virtual counter is lowest, so both light
+            # requests complete inside the first four — a FIFO queue
+            # would pin them to positions 6 and 7
+            light_pos = [i for i, n in enumerate(done) if n.startswith("l")]
+            assert max(light_pos) <= 3, done
+        finally:
+            eng.close()
+
+    def test_fair_queuing_opt_out_restores_fifo(self, params):
+        eng = _engine(params, fair_queuing=False)
+        try:
+            assert eng.ledger is None
+        finally:
+            eng.close()
+
+
+# ---------------------------------------------------------------------------
+# engine: priority preemption (token-identical continuation)
+# ---------------------------------------------------------------------------
+class TestPreemption:
+    def test_preempted_batch_stream_token_identical(self, params):
+        eng = _engine(params, slots=1)
+        try:
+            prompt = list(range(1, 9))
+            want = eng.generate(prompt, max_new_tokens=24)  # uncontended ref
+            assert len(want) == 24
+
+            batch = eng.submit(GenRequest(
+                prompt, max_new_tokens=24, priority="batch", client="b",
+            ))
+            got: list[int] = []
+            t = threading.Thread(
+                target=lambda: got.extend(batch.stream(timeout=120))
+            )
+            t.start()
+            _wait(lambda: batch.emitted >= 4, 60, "batch mid-decode")
+            # interactive arrival with zero free slots: the batch slot is
+            # taken back and the interactive request served immediately
+            inter = eng.generate(
+                [9, 9, 2], max_new_tokens=4, priority="interactive",
+            )
+            assert len(inter) == 4
+            t.join(timeout=120)
+            assert not t.is_alive(), "batch consumer hung"
+            assert got == want, f"preempted stream diverged: {got} != {want}"
+            assert eng.preemptions >= 1
+            assert batch.preempted >= 1
+            assert batch.finish_reason == "length"
+        finally:
+            eng.close()
+
+    def test_interactive_never_preempts_interactive(self, params):
+        eng = _engine(params, slots=1)
+        try:
+            first = eng.submit(GenRequest(
+                list(range(1, 9)), max_new_tokens=24, client="a",
+            ))  # interactive occupant
+            _wait(lambda: first.emitted >= 2, 60, "first decoding")
+            second = eng.submit(GenRequest([5, 5], max_new_tokens=2,
+                                           client="b"))
+            out2 = second.tokens(timeout=120)
+            out1 = first.tokens(timeout=120)
+            assert len(out1) == 24 and len(out2) == 2
+            assert eng.preemptions == 0 and first.preempted == 0
+        finally:
+            eng.close()
+
+    def test_preemption_cap_stops_thrash(self, params):
+        """A batch request already evicted _PREEMPT_CAP times keeps its
+        slot — otherwise interactive arrivals oscillating around
+        capacity could thrash one batch request forever, re-running an
+        ever-growing continuation prefill under pressure."""
+        eng = _engine(params, slots=1)
+        try:
+            batch = eng.submit(GenRequest(
+                list(range(1, 9)), max_new_tokens=20, priority="batch",
+            ))
+            _wait(lambda: batch.emitted >= 2, 60, "batch decoding")
+            batch.preempted = eng._PREEMPT_CAP  # as if already thrashed
+            inter = eng.submit(GenRequest([3, 1], max_new_tokens=2))
+            out = inter.tokens(timeout=120)  # waits for the slot instead
+            assert len(out) == 2
+            assert eng.preemptions == 0
+            assert len(batch.tokens(timeout=120)) == 20
+        finally:
+            eng.close()
+
+    def test_preemption_opt_out(self, params):
+        eng = _engine(params, slots=1, preemption=False)
+        try:
+            batch = eng.submit(GenRequest(
+                list(range(1, 9)), max_new_tokens=16, priority="batch",
+            ))
+            _wait(lambda: batch.emitted >= 2, 60, "batch decoding")
+            inter = eng.submit(GenRequest([3, 1], max_new_tokens=2))
+            assert inter.tokens(timeout=120) and batch.tokens(timeout=120)
+            assert eng.preemptions == 0
+        finally:
+            eng.close()
+
+
+# ---------------------------------------------------------------------------
+# router: classification, fleet cap, retry budget
+# ---------------------------------------------------------------------------
+def _fleet(params, **kw) -> ReplicatedLLMEngine:
+    kw.setdefault("slots", 2)
+    kw.setdefault("max_seq_len", 128)
+    kw.setdefault("prefill_buckets", (8,))
+    kw.setdefault("prefill_chunk", 4)
+    kw.setdefault("step_token_budget", 4)
+    kw.setdefault("decode_chunk", 2)
+    kw.setdefault("lookahead", 1)
+    kw.setdefault("warmup", False)
+    kw.setdefault("supervise", False)
+    return ReplicatedLLMEngine(CFG, params, replicas=2, **kw)
+
+
+class TestRouter:
+    def test_overload_is_not_retried_across_replicas(self, params):
+        """Regression (overload amplification): one replica's 429 must
+        NOT send the router walking every other replica — the router
+        already picked the least-loaded one, so the rest are at least as
+        overloaded. Exactly one replica sees the rejection."""
+        rep = _fleet(params, max_queue=0)  # every submit rejects
+        try:
+            with pytest.raises(EngineOverloaded):
+                rep.submit(GenRequest([1, 2, 3], max_new_tokens=4))
+            assert sum(e.rejected for e in rep.engines) == 1
+        finally:
+            rep.close()
+
+    def test_draining_replica_is_retried(self, params):
+        """A drain beginning between pick and submit is retryable: the
+        OTHER replica serves the request."""
+        rep = _fleet(params)
+        try:
+            victim = rep.engines[0]
+            real_submit = victim.submit
+            calls = {"n": 0}
+
+            def racing_submit(req):
+                calls["n"] += 1
+                raise EngineDraining("drain began between pick and submit")
+
+            victim.submit = racing_submit
+            out = rep.generate([1, 2, 3, 4], max_new_tokens=4)
+            assert len(out) == 4
+            victim.submit = real_submit
+            # the draining replica was tried at most once before rerouting
+            assert calls["n"] <= 1
+        finally:
+            rep.close()
+
+    def test_fleet_cap_rejects_with_retry_after(self, params, monkeypatch):
+        rep = _fleet(params, fleet_max_queue_tokens=16)
+        try:
+            for e in rep.engines:
+                monkeypatch.setattr(e, "_admit", lambda: False)
+            rep.submit(GenRequest(list(range(1, 9)), max_new_tokens=20))
+            with pytest.raises(EngineOverloaded) as ei:
+                rep.submit(GenRequest(list(range(1, 9)), max_new_tokens=20))
+            assert "fleet queue full" in str(ei.value)
+            assert ei.value.retry_after is not None
+            assert 0 < ei.value.retry_after < float("inf")
+            assert rep.fleet_rejected == 1
+            # per-engine queues never saw the rejected request
+            assert sum(e.rejected for e in rep.engines) == 0
+        finally:
+            rep.close()
+
+    def test_retry_budget_exhaustion_surfaces_original_error(self, params):
+        rep = _fleet(params, retry_budget_per_s=0.0, retry_budget_burst=0.0)
+        try:
+            victim = rep.engines[0]
+
+            def dying_submit(req):
+                raise EngineStoppedError("replica died between pick+submit")
+
+            victim.submit = dying_submit
+            with pytest.raises(EngineStoppedError) as ei:
+                rep.submit(GenRequest([1, 2, 3], max_new_tokens=4))
+            assert "between pick" in str(ei.value)  # the ORIGINAL error
+            assert rep.retry_budget_exhausted == 1
+        finally:
+            rep.close()
+
+    def test_budgeted_retry_still_works(self, params):
+        # rate 0: the burst is the whole budget, so the retry's draw is
+        # visible in remaining() without racing the refill
+        rep = _fleet(params, retry_budget_per_s=0.0, retry_budget_burst=5.0)
+        try:
+            victim = rep.engines[0]
+
+            def dying_submit(req):
+                raise EngineStoppedError("boom")
+
+            victim.submit = dying_submit
+            out = rep.generate([1, 2, 3, 4], max_new_tokens=4)
+            assert len(out) == 4
+            assert rep.retry_budget.remaining() == pytest.approx(4.0)
+        finally:
+            rep.close()
+
+    def test_failover_draws_retry_budget(self, params):
+        """Replica kill with a zero retry budget: the rescue cannot
+        re-dispatch, so the rescued request surfaces an error instead of
+        silently retrying — budget exhaustion is visible, not masked."""
+        inj = FaultInjector()
+        rep = _fleet(
+            params, fault_injector=inj,
+            retry_budget_per_s=0.0, retry_budget_burst=0.0,
+        )
+        try:
+            req = rep.submit(GenRequest(
+                list(range(1, 9)), max_new_tokens=24, client="x",
+            ))
+            _wait(lambda: req.emitted >= 2, 60, "decoding")
+            serving = next(
+                e for e in rep.engines
+                if any(r is req for r in e._slot_req)
+            )
+            inj.arm("replica_kill", label=serving.label)
+            toks = req.tokens(timeout=60)
+            assert req.finish_reason == "error"
+            assert len(toks) < 24
+            assert rep.retry_budget_exhausted >= 1
+        finally:
+            rep.close()
+
+    def test_fleet_shares_one_ledger(self, params):
+        rep = _fleet(params)
+        try:
+            assert rep.ledger is not None
+            assert all(e.ledger is rep.ledger for e in rep.engines)
+            assert rep.stats()["fairness"] is not None
+            assert rep.debug_state()["retry_budget"]["burst"] == 10.0
+        finally:
+            rep.close()
+
+    def test_fair_weights_apply_to_provided_ledger(self, params):
+        """Regression: fair_weights used to be silently discarded when a
+        fair_ledger was also passed (setdefault evaluated the fallback
+        ledger eagerly, popping the weights into it and throwing both
+        away)."""
+        led = FairLedger()
+        rep = _fleet(params, fair_ledger=led, fair_weights={"vip": 4.0})
+        try:
+            assert rep.ledger is led
+            assert led.weight("vip") == pytest.approx(4.0)
+        finally:
+            rep.close()
+
+    def test_explicit_fair_kwarg_beats_env(self, params, monkeypatch):
+        """Precedence regression: fair_queuing=True with TPU_LLM_FAIR=0
+        in the env must still build the SHARED fleet ledger — the env
+        silently downgrading fleet fairness to per-replica would leave
+        no signal that the documented pooling property does not hold."""
+        monkeypatch.setenv("TPU_LLM_FAIR", "0")
+        rep = _fleet(params, fair_queuing=True)
+        try:
+            assert rep.ledger is not None
+            assert all(e.ledger is rep.ledger for e in rep.engines)
+        finally:
+            rep.close()
+
+
+# ---------------------------------------------------------------------------
+# edges: Retry-After over HTTP and gRPC, header mapping
+# ---------------------------------------------------------------------------
+class TestEdges:
+    def test_http_429_carries_retry_after(self):
+        from gofr_tpu.http.responder import respond
+
+        resp = respond(None, EngineOverloaded("full", retry_after=2.3))
+        assert resp.status == 429
+        assert ("Retry-After", "3") in resp.headers  # ceiled, never early
+
+    def test_http_503_draining_carries_retry_after(self):
+        from gofr_tpu.http.responder import respond
+
+        resp = respond(None, EngineDraining("draining"))
+        assert resp.status == 503
+        assert ("Retry-After", "5") in resp.headers
+
+    def test_http_error_types(self):
+        from gofr_tpu.http.errors import (
+            ErrorServiceUnavailable,
+            ErrorTooManyRequests,
+        )
+        from gofr_tpu.http.responder import respond
+
+        resp = respond(None, ErrorTooManyRequests(retry_after=0.2))
+        assert resp.status == 429
+        assert ("Retry-After", "1") in resp.headers  # floor: integer >= 1
+        resp = respond(None, ErrorServiceUnavailable("down", retry_after=9))
+        assert ("Retry-After", "9") in resp.headers
+
+    def test_no_retry_after_without_hint(self):
+        from gofr_tpu.http.errors import ErrorServiceUnavailable
+        from gofr_tpu.http.responder import respond
+
+        resp = respond(None, ErrorServiceUnavailable("down"))
+        assert not [h for h in resp.headers if h[0] == "Retry-After"]
+
+    def test_grpc_status_mapping(self):
+        import grpc
+
+        from gofr_tpu.grpcx import _STATUS_TO_GRPC, _abort_mapped
+
+        assert _STATUS_TO_GRPC[429] is grpc.StatusCode.RESOURCE_EXHAUSTED
+        assert _STATUS_TO_GRPC[503] is grpc.StatusCode.UNAVAILABLE
+
+        class FakeCtx:
+            def __init__(self):
+                self.trailers = None
+                self.aborted = None
+
+            def set_trailing_metadata(self, md):
+                self.trailers = md
+
+            def abort(self, code, details):
+                self.aborted = (code, details)
+                raise RuntimeError("abort")  # grpc abort raises
+
+        ctx = FakeCtx()
+        with pytest.raises(RuntimeError):
+            _abort_mapped(ctx, EngineOverloaded("full", retry_after=1.5))
+        assert ctx.aborted[0] is grpc.StatusCode.RESOURCE_EXHAUSTED
+        assert ctx.trailers == (("retry-after", "1.500"),)
+        # unmapped errors fall through to the INTERNAL recovery path
+        assert _abort_mapped(FakeCtx(), ValueError("x")) is False
+
+    def test_llm_request_kwargs_maps_headers(self):
+        from gofr_tpu.container import Container
+        from gofr_tpu.context import Context
+        from gofr_tpu.handler import llm_request_kwargs
+        from gofr_tpu.http.request import Request
+
+        container = Container.__new__(Container)
+
+        def ctx_for(headers, addr="10.0.0.9:1234"):
+            return Context(
+                Request("POST", "/g", headers, b"", remote_addr=addr),
+                container,
+            )
+
+        kw = llm_request_kwargs(ctx_for(
+            {"x-gofr-priority": "Batch", "x-gofr-client": "tenant-a"}
+        ))
+        assert kw == {"priority": "batch", "client": "tenant-a"}
+        # API key fallback for keyed deployments: HASHED, never verbatim
+        # — ledger client ids surface on the debug/stats routes, and a
+        # raw key there would be a credential disclosure
+        kw = llm_request_kwargs(ctx_for({"x-api-key": "k123"}))
+        assert kw["client"].startswith("key:")
+        assert "k123" not in kw["client"]
+        # deterministic: the same key maps to the same ledger row
+        assert kw["client"] == llm_request_kwargs(
+            ctx_for({"x-api-key": "k123"})
+        )["client"]
+        assert kw["priority"] == "interactive"
+        # peer-address fallback strips the ephemeral port
+        kw = llm_request_kwargs(ctx_for({}))
+        assert kw["client"] == "10.0.0.9"
+
+    def test_gen_request_normalizes_priority(self, params):
+        eng = _engine(params)
+        try:
+            r = eng.submit(GenRequest([1, 2], max_new_tokens=2,
+                                      priority="URGENT!!"))
+            assert r.priority == "interactive"  # typos degrade safe
+            r.tokens(timeout=60)
+        finally:
+            eng.close()
