@@ -261,8 +261,10 @@ class TPURuntime:
         self.default_llm_prefill_chunk = get("TPU_LLM_PREFILL_CHUNK", "")
         # resilience knobs (gofr_tpu.resilience): step-watchdog threshold
         # seconds ("" = engine default, which reads the same env var; 0
-        # disables) — docs/advanced-guide/resilience.md
+        # disables) and the numerical watchdog gate ("" = engine default,
+        # on) — docs/advanced-guide/resilience.md
         self.default_llm_step_watchdog = get("TPU_LLM_STEP_WATCHDOG_S", "")
+        self.default_llm_numeric_check = get("TPU_LLM_NUMERIC_CHECK", "")
         self._models: dict[str, _Model] = {}
         self._lock = threading.Lock()
         if metrics is not None:
@@ -447,7 +449,16 @@ class TPURuntime:
         fleet admission cap and retry budget — is on by default and
         tuned via the TPU_LLM_FAIR / TPU_LLM_PREEMPT /
         TPU_LLM_SHED_WAIT_S / TPU_LLM_BROWNOUT_* knobs or the matching
-        engine kwargs (docs/advanced-guide/overload.md)."""
+        engine kwargs (docs/advanced-guide/overload.md). Replicated
+        fleets also get device-health judgment by default: replica
+        deaths are classified into a per-device ledger, a device
+        crossing TPU_LLM_DEVICE_QUARANTINE_FAILURES is quarantined and
+        its slot rebuilt elastically on an alternate healthy device (or
+        parked, visibly), every rebuild passes a canary probe before
+        routing, the numerical watchdog (TPU_LLM_NUMERIC_CHECK) turns
+        NaN/Inf logits into a classified replica death, and a request in
+        flight across TPU_LLM_POISON_DEATHS deaths is refused further
+        failover (docs/advanced-guide/resilience.md)."""
         from ...llm import LLMEngine, ReplicatedLLMEngine
 
         engine_kw.setdefault("prefix_cache_mb", self.default_llm_prefix_cache_mb)
@@ -462,6 +473,10 @@ class TPURuntime:
         if self.default_llm_step_watchdog != "":
             engine_kw.setdefault(
                 "step_watchdog_s", float(self.default_llm_step_watchdog)
+            )
+        if self.default_llm_numeric_check != "":
+            engine_kw.setdefault(
+                "numeric_check", self.default_llm_numeric_check != "0"
             )
         engine_kw.setdefault("kv_label", name)  # metric-series label
         engine_kw.setdefault("tracer", self.tracer)  # lifecycle spans

@@ -142,7 +142,11 @@ def health_handler(ctx: Context) -> Any:
     thresholds configured, status flips to "degraded" (HTTP still 200 —
     this is a shed-before-saturation signal for load balancers, not a
     liveness failure) when the PR-2 engine gauges cross them. Unset
-    thresholds keep the legacy always-"UP" behavior.
+    thresholds keep the legacy always-"UP" behavior for those gauges —
+    but a replica slot PARKED for lack of a usable device or marked
+    permanently failed (gofr_tpu.resilience.supervisor) always reports
+    "degraded": the fleet is running short a replica by design, and the
+    operator must know without configuring anything.
 
     A DRAINING app answers 503: readiness must fail the instant a
     rolling deploy begins so the load balancer stops routing here while
@@ -164,6 +168,17 @@ def _serving_status(container) -> str:
     cfg = container.config
     if cfg is None or container.metrics_manager is None:
         return "UP"
+    m = container.metrics_manager
+    # capacity degradation is unconditional (no threshold to configure):
+    # a parked or permanently-failed replica slot means the fleet serves
+    # short-handed until a device reintegrates or an operator intervenes
+    try:
+        if m.gauge_total("app_llm_replicas_parked") > 0:
+            return "degraded"
+        if m.gauge_total("app_llm_replicas_failed") > 0:
+            return "degraded"
+    except Exception:  # noqa: BLE001 — health must not fail on metrics shape
+        pass
     try:
         depth_max = cfg.get_float("HEALTH_DEGRADED_QUEUE_DEPTH", 0.0)
         backlog_max = cfg.get_float("HEALTH_DEGRADED_ADMISSION_BACKLOG", 0.0)
@@ -171,7 +186,6 @@ def _serving_status(container) -> str:
         return "UP"
     if depth_max <= 0 and backlog_max <= 0:
         return "UP"
-    m = container.metrics_manager
     if depth_max > 0 and m.gauge_total("app_llm_queue_depth") >= depth_max:
         return "degraded"
     if backlog_max > 0 and m.gauge_total("app_llm_admission_backlog") >= backlog_max:

@@ -99,6 +99,7 @@ __all__ = [
     "EngineOverloaded",
     "EngineStoppedError",
     "EngineDraining",
+    "PoisonedRequestError",
 ]
 
 _EOS_DEFAULT = -1  # no EOS cut by default (random-weight models)
@@ -215,6 +216,35 @@ class EngineDraining(RuntimeError):
     retry_after: float | None = 5.0
 
 
+class PoisonedRequestError(RuntimeError):
+    """Raised by GenRequest.stream()/tokens() when the fleet refused a
+    request further failover: it was in flight across
+    ``TPU_LLM_POISON_DEATHS`` replica deaths, which makes its payload the
+    prime suspect for those crashes — retrying it again would let one
+    request kill every replica in turn. 500 via the statusCodeResponder
+    seam (gRPC surfaces INTERNAL): the caller must NOT retry the same
+    payload (docs/advanced-guide/resilience.md)."""
+
+    status_code = 500
+
+
+def finite_guard(logits, toks):
+    """Numerical-watchdog sentinel: replace each sampled token whose
+    logits row contains NaN/Inf with ``-1`` — an id no sampler can
+    produce (argmax and top-k indices are >= 0), so the sentinel rides
+    the existing token fetch at zero extra transfer cost and the
+    collector converts it into a replica death instead of streaming
+    garbage with status 200. One cheap on-device reduction per sampled
+    row, trivially amortized against the matmuls that produced the
+    logits. Traced into the engine's jitted programs when
+    ``TPU_LLM_NUMERIC_CHECK`` is on; module-level so tests drive it with
+    hand-built NaN logits."""
+    import jax.numpy as jnp
+
+    ok = jnp.isfinite(logits).all(axis=-1)
+    return jnp.where(ok, toks, jnp.int32(-1))
+
+
 @dataclass(eq=False)  # identity semantics: requests are handles, and the
 # engine's error path collects them in sets (dataclass __eq__ would make
 # them unhashable and value-compared)
@@ -243,6 +273,11 @@ class GenRequest:
     # "deadline") — a decode past its HTTP timeout burns chip time for a
     # client that already gave up. Handlers pass ctx.deadline here.
     deadline: float | None = None
+    # Chaos-only payload marker: a fault spec armed with the same tag
+    # fires exactly when THIS request's step dispatches (the
+    # deterministic stand-in for a payload that crashes the step
+    # program; gofr_tpu.resilience.faults). Empty for real traffic.
+    tag: str = ""
     id: int = field(default_factory=itertools.count().__next__)
 
     def __post_init__(self):
@@ -254,8 +289,9 @@ class GenRequest:
         self.preempted = 0  # times a slot was taken back for interactive work
         self._prompt_billed = False  # fairness ledger saw the prompt tokens
         self.finish_reason: str | None = None  # "eos" | "length" | "cancelled"
-        #   | "shed" | "deadline" | "error" ("failover" transiently marks a
-        #   request rescued off a dying replica so drain paths skip it)
+        #   | "shed" | "deadline" | "error" | "poison" ("failover"
+        #   transiently marks a request rescued off a dying replica so
+        #   drain paths skip it)
         self.submitted_at: float | None = None
         # -- failover state (gofr_tpu.resilience) --
         # tokens emitted since the last (re)submit: on replica death the
@@ -264,6 +300,11 @@ class GenRequest:
         # consumer left off (greedy streams are token-identical).
         self.history: list[int] = []
         self.retries = 0  # failover re-dispatches consumed
+        # replica deaths this request was IN FLIGHT for (slotted,
+        # prefilling, or riding a device snapshot at _die — queued-only
+        # bystanders are not implicated). At TPU_LLM_POISON_DEATHS the
+        # router refuses further failover (finish_reason "poison").
+        self.deaths = 0
         # -- chunked-prefill scheduler state (engine-maintained) --
         self.prefill_pos = 0  # prompt tokens already appended to slot KV
         self.prefill_done = False  # all prompt tokens resident; decoding
@@ -280,11 +321,24 @@ class GenRequest:
         self._observed = False  # terminal observability emitted (idempotence)
 
     # -- consumption ------------------------------------------------------
+    def _raise_terminal(self) -> None:
+        """End-of-stream classification: a poison refusal is an ERROR the
+        caller must see (500/INTERNAL — the payload is implicated in
+        replica deaths and will not be retried), not a quietly short
+        stream. Every other finish reason keeps the legacy
+        truncate-and-return contract."""
+        if self.finish_reason == "poison":
+            raise PoisonedRequestError(
+                f"request {self.id} implicated in {self.deaths} replica "
+                "deaths; failover refused (do not retry this payload)"
+            )
+
     def stream(self, timeout: float = 60.0) -> Iterator[int]:
         """Yield token ids until the engine signals completion."""
         while True:
             item = self.out.get(timeout=timeout)
             if item is None:
+                self._raise_terminal()
                 return
             if isinstance(item, list):
                 yield from item
@@ -298,6 +352,7 @@ class GenRequest:
         while True:
             item = await loop.run_in_executor(None, lambda: self.out.get(timeout=timeout))
             if item is None:
+                self._raise_terminal()
                 return
             if isinstance(item, list):
                 for t in item:
@@ -344,6 +399,7 @@ class LLMEngine:
         brownout_max_new: int | None = None,
         brownout_hold_s: float | None = None,
         step_watchdog_s: float | None = None,
+        numeric_check: bool | None = None,
         fault_injector=None,
         logger=None,
         metrics=None,
@@ -506,6 +562,16 @@ class LLMEngine:
             )
         self.step_watchdog_s = max(0.0, float(step_watchdog_s))
         self.watchdog = None  # started after the engine threads
+        # Numerical watchdog (docs/advanced-guide/resilience.md): trace
+        # the finite_guard sentinel into every sampling program so
+        # NaN/Inf logits become a replica death with reason "numerical"
+        # instead of a garbage stream with status 200. On by default —
+        # the on-device cost is one isfinite reduction per sampled row
+        # and the sentinel rides fetches that happen anyway.
+        if numeric_check is None:
+            numeric_check = _os.environ.get("TPU_LLM_NUMERIC_CHECK", "1") != "0"
+        self.numeric_check = bool(numeric_check)
+        self.numerical_trips = 0  # non-finite logits -> replica death
         self._draining = False  # drain(): admission closed, work finishes
         self._died = False  # _die ran (idempotence + stale-emission guard)
         self._die_guard = threading.Lock()
@@ -583,19 +649,25 @@ class LLMEngine:
         # -- jitted programs (one dispatch each) --------------------------
         topk = min(64, cfg.vocab_size)
 
+        _numeric_check = self.numeric_check
+
         def _sample(logits, temps, key):
             """Greedy for temp==0; temperature sampling restricted to the
             top-k logits otherwise. Full-vocab categorical would generate
             batch x vocab Gumbel draws per step (millions of threefry
             rounds for a 256k vocab) and dominates decode time; top-k keeps
-            the RNG work at batch x 64."""
+            the RNG work at batch x 64. With the numerical watchdog on,
+            a row whose logits went NaN/Inf samples the -1 sentinel
+            instead (finite_guard) — the collector converts it to a
+            replica death before anything is emitted."""
             greedy = jnp.argmax(logits, axis=-1)
             topv, topi = jax.lax.approx_max_k(logits, topk)
             local = jax.random.categorical(
                 key, topv / jnp.maximum(temps, 1e-4)[:, None], axis=-1
             )
             sampled = jnp.take_along_axis(topi, local[:, None], axis=1)[:, 0]
-            return jnp.where(temps > 0.0, sampled, greedy).astype(jnp.int32)
+            out = jnp.where(temps > 0.0, sampled, greedy).astype(jnp.int32)
+            return finite_guard(logits, out) if _numeric_check else out
 
         keep_logits = self.kv.prefix is not None
 
@@ -1049,6 +1121,7 @@ class LLMEngine:
                 ),
                 "draining": self._draining,
                 "watchdog_trips": self.watchdog.trips if self.watchdog else 0,
+                "numerical_trips": self.numerical_trips,
                 "kvcache": self.kv.stats(),
                 # recent-window phase latencies (seconds): exact p50/p99
                 # over the last ~512 observations per phase
@@ -1295,6 +1368,65 @@ class LLMEngine:
             self.metrics.increment_counter(
                 "app_llm_faults_injected_total", point=point, model=self.label
             )
+
+    def _poison_fault(self) -> bool:
+        """Poison-payload seam (scheduler pass): a ``device_step`` spec
+        armed WITH A TAG fires exactly when a resident request carries
+        the same tag — the deterministic stand-in for a payload whose
+        content reliably crashes the step program. Terminal like
+        replica_kill (the poison scenario is a replica-killing payload,
+        not a transient step error); the router's poison quarantine then
+        bounds the payload's blast radius. Disarmed cost: one dict
+        lookup."""
+        if not self.faults.has_tagged("device_step"):
+            return False
+        with self._lock:
+            resident = [r for r in self._slot_req if r is not None]
+            resident.extend(self._prefilling)
+        for r in resident:
+            tag = getattr(r, "tag", "")
+            if tag and self.faults.take("device_step", self.label, tag=tag):
+                self._count_fault("device_step")
+                self._die(
+                    f"poison payload: device_step fired for tagged request "
+                    f"(tag={tag!r})"
+                )
+                return True
+        return False
+
+    def _numeric_trip(self, where: str) -> None:
+        """Non-finite logits reached a fetched token array: convert the
+        garbage stream into a replica death with a distinct,
+        classifiable reason — the failover path re-seeds the in-flight
+        requests on a replica whose compute is not poisoned, and the
+        device ledger bills the trip as "numerical"."""
+        self.numerical_trips += 1
+        if self.metrics is not None:
+            self.metrics.increment_counter(
+                "app_llm_numerical_trips_total", model=self.label
+            )
+        self._die(f"numerical watchdog: non-finite logits ({where})")
+
+    def _numeric_check_fetch(self, arr, cols: list[int], where: str):
+        """Collector-side sentinel scan over one fetched token array
+        (``cols`` are the last-axis lanes owned by live requests —
+        inactive lanes legitimately carry garbage). Also hosts the
+        ``nan_logits`` chaos seam: an armed spec corrupts one live lane
+        with the sentinel, exactly what NaN logits produce on device —
+        with the watchdog disabled the corruption streams through to the
+        caller, which is the silent failure the watchdog exists to stop.
+        Returns ``(arr, tripped)``; on a trip the engine is already
+        dying and the caller must not emit."""
+        if not cols:
+            return arr, False
+        if self.faults.take("nan_logits", self.label) is not None:
+            self._count_fault("nan_logits")
+            arr = np.array(arr)  # device fetches can be read-only views
+            arr[..., cols[0]] = -1
+        if self.numeric_check and bool((arr[..., cols] == -1).any()):
+            self._numeric_trip(where)
+            return arr, True
+        return arr, False
 
     def _zero_state_gauges(self) -> None:
         """A stopped engine must not keep exporting its last live
@@ -2688,6 +2820,16 @@ class LLMEngine:
         if entry[0] == "prefill":
             _, first_dev, taken, info = entry
             first = np.asarray(first_dev)
+            # numerical watchdog: scan BEFORE any emission, outside the
+            # lock (_die must not run under our own lock — the failover
+            # hook submits into other engines)
+            first, tripped = self._numeric_check_fetch(
+                first,
+                [j for j, (_s, r) in enumerate(taken) if r is not None],
+                "prefill first token",
+            )
+            if tripped:
+                return
             now = time.perf_counter()
             if info["bucket"] is not None:  # miss wave: a device prefill ran
                 # (prefix-hit waves dispatch no prefill — no MFU to claim)
@@ -2728,6 +2870,12 @@ class LLMEngine:
         _, toks_dev, snapshot, k, t_dispatch = entry
         t0 = time.perf_counter()
         toks = np.asarray(toks_dev)  # [K, S] — blocks; device runs next chunk
+        toks, tripped = self._numeric_check_fetch(
+            toks, [s for s, r in enumerate(snapshot) if r is not None],
+            "decode chunk",
+        )
+        if tripped:
+            return
         now = time.perf_counter()
         if self.metrics is not None:
             self.metrics.record_histogram(
@@ -2789,6 +2937,19 @@ class LLMEngine:
         t0 = time.perf_counter()
         first = np.asarray(first_dev) if finishes else None
         toks = np.asarray(toks_dev)
+        # numerical watchdog: both fetched arrays, before any emission
+        if first is not None:
+            first, tripped = self._numeric_check_fetch(
+                first, [j for j, _s, _r in finishes], "step first token",
+            )
+            if tripped:
+                return
+        toks, tripped = self._numeric_check_fetch(
+            toks, [s for s, r in enumerate(snapshot) if r is not None],
+            "step decode",
+        )
+        if tripped:
+            return
         decoded = any(r is not None for r in snapshot)
         now = time.perf_counter()
         step_s = now - info["t0"]
@@ -2906,6 +3067,8 @@ class LLMEngine:
                     self._count_fault("replica_kill")
                     self._die("fault injection: replica_kill")
                     break
+                if self._poison_fault():
+                    break  # tagged payload killed this replica (terminal)
                 try:
                     did = self._admit()
                     if self._stop:
@@ -3031,21 +3194,29 @@ class LLMEngine:
         Call with the lock held. Returned in submit order (ids are a
         process-global monotone counter)."""
         rescued: dict[int, GenRequest] = {}
+        # Requests IN FLIGHT at death (slotted, mid-prefill, or riding a
+        # device snapshot) are implicated in it for the router's
+        # poison-request quarantine; queued-only bystanders are not — a
+        # request that merely waited behind a poison payload twice must
+        # not be refused service for it.
+        inflight_ids: set[int] = set()
 
-        def take(r: GenRequest | None) -> None:
+        def take(r: GenRequest | None, inflight: bool = False) -> None:
             if r is not None and r.finish_reason is None and not r.cancelled:
                 rescued[r.id] = r
+                if inflight:
+                    inflight_ids.add(r.id)
 
         for r in self._slot_req:
-            take(r)
+            take(r, inflight=True)
         entries = list(self._inflight)
         if self._processing is not None:
             entries.append(self._processing)
         for e in entries:
             for r in self._entry_requests(e):
-                take(r)
+                take(r, inflight=True)
         for r in self._prefilling:
-            take(r)
+            take(r, inflight=True)
         for r in self._waiting:
             take(r)
         # the admit queue must be drained here (not left to
@@ -3068,6 +3239,8 @@ class LLMEngine:
         out = [rescued[i] for i in sorted(rescued)]
         for r in out:
             r.finish_reason = "failover"
+            if r.id in inflight_ids:
+                r.deaths += 1
         return out
 
     def _recover_all(self) -> None:
@@ -3295,6 +3468,9 @@ class ReplicatedLLMEngine:
         fleet_max_queue_tokens: int | None = None,
         retry_budget_per_s: float | None = None,
         retry_budget_burst: float | None = None,
+        poison_deaths: int | None = None,
+        canary: bool | None = None,
+        health_ledger=None,
         **engine_kw,
     ):
         import jax
@@ -3393,6 +3569,39 @@ class ReplicatedLLMEngine:
             )
         self.retry_budget = RetryBudget(retry_budget_per_s, retry_budget_burst)
         self.retry_budget_exhausted = 0
+        # -- device health + poison quarantine (resilience.health;
+        # docs/advanced-guide/resilience.md) ------------------------------
+        # One ledger for the fleet: replica deaths and rebuild failures
+        # are classified and billed to the device the engine ran on, and
+        # a device that accumulates TPU_LLM_DEVICE_QUARANTINE_FAILURES
+        # inside the window is quarantined — the supervisor then rebuilds
+        # the slot elastically on an alternate healthy device (or parks
+        # it, visibly, when none exists).
+        from .resilience import DeviceHealthLedger, spec_device_key
+
+        self.health = (
+            health_ledger if health_ledger is not None
+            else DeviceHealthLedger(
+                metrics=self.metrics, model=self.label, logger=logger,
+            )
+        )
+        self._device_keys = [spec_device_key(s) for s in specs]  # home devices
+        self._current_keys = list(self._device_keys)  # where each slot runs NOW
+        # Poison-request quarantine: a request in flight across this many
+        # replica deaths is refused further failover (finish_reason
+        # "poison" -> 500/INTERNAL) — one payload's blast radius is
+        # bounded to poison_deaths replicas, never the fleet. 0 disables.
+        if poison_deaths is None:
+            poison_deaths = int(_os.environ.get("TPU_LLM_POISON_DEATHS", "2") or 0)
+        self.poison_deaths = max(0, int(poison_deaths))
+        self.poisoned = 0  # requests refused failover as poison
+        # Canary gate: a rebuilt/reintegrated replica must reproduce the
+        # fixed greedy probe (token-compared against a healthy replica's
+        # cached output when one exists) before it re-enters routing.
+        if canary is None:
+            canary = _os.environ.get("TPU_LLM_CANARY", "1") != "0"
+        self._canary_enabled = bool(canary)
+        self._canary_ref: list[int] | None = None  # healthy replica's probe tokens
         # build replicas concurrently: XLA releases the GIL while compiling,
         # so N warmups overlap instead of serializing construction N-fold.
         # On any failure, close the replicas that DID come up — each holds
@@ -3433,19 +3642,114 @@ class ReplicatedLLMEngine:
                 ),
             )
 
-    def _build_replica(self, i: int) -> "LLMEngine":
+    def _build_replica(self, i: int, spec: dict | None = None) -> "LLMEngine":
         """Construct (and warm) replica slot i from its retained spec —
-        the same path at first build and at supervised restart. Wires the
-        failover hook so the new replica's deaths rescue in-flight work
-        too. Per-replica kv label: N replicas sharing one label set would
-        clobber each other's resident-bytes gauges."""
+        the same path at first build and at supervised restart. ``spec``
+        overrides the home placement for elastic rebuilds (the
+        supervisor passes an alternate healthy device when the home
+        device is quarantined). Wires the failover hook so the new
+        replica's deaths rescue in-flight work too. Per-replica kv
+        label: N replicas sharing one label set would clobber each
+        other's resident-bytes gauges."""
+        from .resilience import InjectedFault, default_injector, spec_device_key
+
+        spec = self._specs[i] if spec is None else spec
+        inj = self._engine_kw.get("fault_injector") or default_injector()
+        key = spec_device_key(spec)
+        if inj.take("device_sick", key) is not None:
+            # chaos: a persistently sick chip — construction (param
+            # placement / warmup) fails on this device, as an HBM or ICI
+            # fault would, until the spec is disarmed or exhausted
+            if self.logger is not None:
+                self.logger.warn(f"fault injection: device_sick fired on {key}")
+            if self.metrics is not None:
+                self.metrics.increment_counter(
+                    "app_llm_faults_injected_total",
+                    point="device_sick", model=self.label,
+                )
+            raise InjectedFault(f"device_sick: build refused on {key}")
         eng = LLMEngine(
             self._cfg, self._params, logger=self.logger,
-            kv_label=f"{self.label}/r{i}", **self._specs[i],
+            kv_label=f"{self.label}/r{i}", **spec,
             **self._engine_kw,
         )
         eng.failover_hook = self._failover
         return eng
+
+    def _spec_for_rebuild(self, i: int) -> tuple[dict, str] | None:
+        """Placement policy for rebuilding slot i, consulting the device
+        ledger: the home device when it is usable (healthy, or in
+        probation — the canary gate guards the probe) and not occupied
+        by another live replica; otherwise an alternate same-platform
+        device that is usable and unoccupied ({"device": d} specs only —
+        a tensor-parallel submesh has no drop-in alternate, so a
+        quarantined submesh parks its slot). None = park."""
+        home = self._specs[i]
+        hkey = self._device_keys[i]
+        used = {
+            self._current_keys[j]
+            for j, e in enumerate(self.engines)
+            if j != i and e.alive()
+        }
+        if self.health.usable(hkey) and hkey not in used:
+            return home, hkey
+        dev = home.get("device")
+        if dev is None:
+            return None  # mesh spec: park until the home submesh reintegrates
+        import jax
+
+        from .resilience import device_key
+
+        platform = getattr(dev, "platform", None)
+        for d in jax.devices():
+            if getattr(d, "platform", None) != platform:
+                continue
+            k = device_key(d)
+            if k == hkey or k in used or not self.health.usable(k):
+                continue
+            return {"device": d}, k
+        return None
+
+    def _canary_check(self, replacement: "LLMEngine") -> tuple[bool, str]:
+        """Gate a rebuilt replica before it enters routing: the fixed
+        greedy probe, token-compared against a healthy replica's cached
+        output when the fleet has (ever had) one, else against
+        completeness/vocabulary checks (resilience.health.canary_check).
+        The reference is computed once and cached — greedy decode is
+        deterministic per params+config, so it never goes stale."""
+        if not self._canary_enabled:
+            return True, "disabled"
+        from .resilience.health import CANARY_MAX_NEW, CANARY_PROMPT, canary_check
+
+        ref = self._canary_ref
+        has_peer = False
+        if ref is None:
+            for e in self.engines:
+                if e is replacement or not e.accepting():
+                    continue
+                has_peer = True
+                try:
+                    ref = e.generate(
+                        list(CANARY_PROMPT), max_new_tokens=CANARY_MAX_NEW,
+                        temperature=0.0, eos_token=-1,
+                    )
+                    if len(ref) == CANARY_MAX_NEW:
+                        self._canary_ref = ref
+                        break
+                    ref = None
+                except Exception:  # noqa: BLE001 — a sick reference is no reference
+                    ref = None
+        ok, detail, toks = canary_check(replacement, ref)
+        if ok and ref is None and not has_peer:
+            # TRULY no healthy replica existed: the gated candidate's own
+            # passing output seeds the reference for future canaries.
+            # When a peer exists but its reference fetch failed
+            # transiently (saturated, draining race), do NOT self-seed —
+            # caching an unverified candidate's tokens would poison the
+            # permanent reference and canary-reject every honest rebuild
+            # after it; the next canary simply retries the peer.
+            self._canary_ref = toks
+        return ok, detail
 
     # -- routing -----------------------------------------------------------
     def _pick(self, exclude: set | frozenset = frozenset()) -> "LLMEngine":
@@ -3558,6 +3862,29 @@ class ReplicatedLLMEngine:
         # request serially on the dying engine's thread
         batch_deadline = time.perf_counter() + 5.0
         for r in reqs:
+            if self.poison_deaths and r.deaths >= self.poison_deaths:
+                # poison-request quarantine: this payload was in flight
+                # for poison_deaths replica deaths — the router stops
+                # treating it as an innocent bystander and errors it to
+                # its caller (500/INTERNAL via PoisonedRequestError)
+                # instead of letting it kill another replica
+                self.poisoned += 1
+                if self.metrics is not None:
+                    self.metrics.increment_counter(
+                        "app_llm_poison_requests_total", model=self.label
+                    )
+                if self.logger is not None:
+                    self.logger.error(
+                        f"poison quarantine: request {r.id} implicated in "
+                        f"{r.deaths} replica deaths; failover refused"
+                    )
+                r.finish_reason = "poison"
+                if r.span is not None and r.span.end_ns == 0:
+                    r.span.set_attribute("llm.finish_reason", "poison")
+                    r.span.set_status("ERROR")
+                    r.span.end()
+                r.out.put(None)
+                continue
             r.retries += 1
             placed = False
             budget_ok = True
@@ -3650,6 +3977,15 @@ class ReplicatedLLMEngine:
             "failovers": self.failovers,
             "failover_errors": self.failover_errors,
             "restarts": self.supervisor.restarts if self.supervisor else 0,
+            # device health + poison quarantine (resilience.health)
+            "poisoned": self.poisoned,
+            "devices_quarantined": self.health.quarantined_count(),
+            "replicas_parked": (
+                self.supervisor.parked_count() if self.supervisor else 0
+            ),
+            "replicas_failed": (
+                self.supervisor.failed_count() if self.supervisor else 0
+            ),
             # fleet overload control (docs/advanced-guide/overload.md)
             "preemptions": sum(s.get("preemptions", 0) for s in per),
             "sheds_predicted": sum(s.get("sheds_predicted", 0) for s in per),
@@ -3748,6 +4084,14 @@ class ReplicatedLLMEngine:
                 self.supervisor.snapshot()
                 if self.supervisor is not None else None
             ),
+            "health": self.health.snapshot(),
+            "devices": {
+                "home": list(self._device_keys),
+                "current": list(self._current_keys),
+            },
+            "poison_deaths": self.poison_deaths,
+            "poisoned": self.poisoned,
+            "canary": self._canary_enabled,
             "phases": self._merged_phases(),
             "per_replica": [e.debug_state() for e in self.engines],
         }
@@ -3775,7 +4119,12 @@ class ReplicatedLLMEngine:
             e.close()
         if self.metrics is not None:
             # a closed fleet must not keep exporting its last budget
-            # level (the dead-engine gauge bug class)
-            self.metrics.set_gauge(
-                "app_llm_retry_budget_remaining", 0.0, model=self.label
-            )
+            # level or capacity-degradation state (the dead-engine gauge
+            # bug class)
+            for name in (
+                "app_llm_retry_budget_remaining",
+                "app_llm_devices_quarantined",
+                "app_llm_replicas_parked",
+                "app_llm_replicas_failed",
+            ):
+                self.metrics.set_gauge(name, 0.0, model=self.label)

@@ -17,9 +17,17 @@ Pieces (docs/advanced-guide/resilience.md has the failure model):
   device step exceeding ``TPU_LLM_STEP_WATCHDOG_S`` into a replica death
   with a distinct reason (a hang used to block invisibly forever).
 - :class:`ReplicaSupervisor` (supervisor.py) — rebuild dead replicas
-  (construct + warm on the same device/submesh) under capped exponential
-  backoff and return them to the routing set.
-- In-flight failover and graceful drain live in ``gofr_tpu.llm`` /
+  under capped exponential backoff and return them to the routing set,
+  elastically: placement consults the device-health ledger, rebuilds
+  land on alternate healthy devices, and every replacement passes the
+  canary gate before it is routed.
+- :class:`DeviceHealthLedger` / :func:`canary_check` (health.py) — the
+  per-device failure ledger (classify, quarantine, cooldown, probe,
+  reintegrate — the circuit-breaker state machine at TPU-device level)
+  and the fixed greedy probe that keeps a half-sick rebuild out of the
+  routing set.
+- In-flight failover, poison-request quarantine, the numerical
+  watchdog, and graceful drain live in ``gofr_tpu.llm`` /
   ``gofr_tpu.app`` (they ARE the engine/app lifecycle); this package
   owns their metrics registration so the series exist wherever any
   resilience feature is active.
@@ -30,12 +38,14 @@ from __future__ import annotations
 import threading
 
 from .faults import FAULT_POINTS, FaultInjector, InjectedFault, default_injector
+from .health import DeviceHealthLedger, canary_check, device_key, spec_device_key
 from .overload import FairLedger, OverloadController, RetryBudget
 from .supervisor import ReplicaSupervisor
 from .watchdog import Heartbeat, StepWatchdog
 
 __all__ = [
     "FAULT_POINTS",
+    "DeviceHealthLedger",
     "FairLedger",
     "FaultInjector",
     "Heartbeat",
@@ -44,8 +54,11 @@ __all__ = [
     "ReplicaSupervisor",
     "RetryBudget",
     "StepWatchdog",
+    "canary_check",
     "default_injector",
+    "device_key",
     "register_resilience_metrics",
+    "spec_device_key",
 ]
 
 # Serializes registration across engines (replicas register concurrently;
@@ -84,6 +97,16 @@ def register_resilience_metrics(metrics) -> None:
             ("app_llm_fleet_rejected_total",
              "llm requests rejected at the fleet queued-token admission "
              "cap (429 + Retry-After)"),
+            ("app_llm_device_quarantines_total",
+             "TPU devices quarantined by the health ledger (K "
+             "attributable failures inside the sliding window)"),
+            ("app_llm_numerical_trips_total",
+             "llm device steps whose logits went non-finite, converted "
+             "to replica death by the numerical watchdog"),
+            ("app_llm_poison_requests_total",
+             "llm requests refused further failover after being in "
+             "flight across the poison death threshold (500/INTERNAL "
+             "to the caller)"),
         ):
             if not metrics.has(name):
                 metrics.new_counter(name, desc)
@@ -99,6 +122,17 @@ def register_resilience_metrics(metrics) -> None:
             ("app_llm_retry_budget_remaining",
              "router retry-budget tokens remaining (token bucket; 0 "
              "means retries surface the original error)"),
+            ("app_llm_devices_quarantined",
+             "TPU devices currently quarantined or awaiting a "
+             "successful reintegration probe"),
+            ("app_llm_replicas_parked",
+             "llm replica slots parked for lack of a usable device "
+             "(capacity-degraded, not crash-looping; health reports "
+             "degraded)"),
+            ("app_llm_replicas_failed",
+             "llm replica slots permanently failed after "
+             "TPU_LLM_RESTART_MAX_ATTEMPTS consecutive rebuild "
+             "failures (operator attention required)"),
         ):
             if not metrics.has(name):
                 metrics.new_gauge(name, desc)

@@ -30,12 +30,30 @@ Named failure points (armed per point, optionally per engine label):
                        predicted-wait shedding, Retry-After computation,
                        and brownout engagement without constructing real
                        queue pressure).
+- ``nan_logits``     — corrupt one fetched step's sampled tokens with
+                       the numerical-watchdog sentinel (what NaN/Inf
+                       logits produce on device; exercises the
+                       numerical watchdog -> replica death -> failover
+                       path, or — with the watchdog disabled — the
+                       silent-garbage-with-200 failure it exists to
+                       prevent).
+- ``device_sick``    — raise at replica (re)build on a matching device
+                       (label-match against the device key, e.g.
+                       "cpu:0"); persistent arming (count=-1) models a
+                       chip that fails every rebuild, driving device
+                       quarantine, elastic rebuild on an alternate
+                       device, and slot parking deterministically.
+
+A spec may carry a ``tag``: it then fires only for a request whose
+``GenRequest.tag`` equals it (the poison-payload marker — a tagged
+``device_step`` kills exactly the replica serving the tagged request,
+driving the router's poison-request quarantine).
 
 Arming: the Python API (``injector.arm(point, ...)``) for tests and the
 chaos smoke, or the ``TPU_LLM_FAULTS`` env var for a black-box process —
-a comma list of ``point[=count[:delay_s]]`` entries parsed once when the
-process-default injector is first built, e.g.
-``TPU_LLM_FAULTS="replica_kill=1,step_latency=1:5.0"``.
+a comma list of ``point[=count[:delay_s]][@label]`` entries parsed once
+when the process-default injector is first built, e.g.
+``TPU_LLM_FAULTS="replica_kill=1,step_latency=1:5.0,device_sick=3@cpu:0"``.
 
 A disarmed injector costs one dict lookup per check — the seams stay in
 production code (the same argument as the reference keeping its circuit
@@ -57,6 +75,8 @@ FAULT_POINTS = (
     "admission_oom",
     "replica_kill",
     "overload_pressure",
+    "nan_logits",
+    "device_sick",
 )
 
 
@@ -76,6 +96,12 @@ class _Spec:
     label: str | None = None
     delay: float = 0.0  # step_latency sleep seconds
     message: str = ""
+    # Poison-payload marker: a tagged spec fires ONLY when take() is
+    # given the same tag (read off the request being dispatched), and an
+    # untagged spec never fires for a tagged take — the two populations
+    # are disjoint so arming a poison payload cannot leak into the plain
+    # device_step chaos seam or vice versa.
+    tag: str | None = None
 
     def matches(self, label: str) -> bool:
         return (
@@ -106,13 +132,14 @@ class FaultInjector:
         label: str | None = None,
         delay: float = 0.0,
         message: str = "",
+        tag: str | None = None,
     ) -> None:
         if point not in FAULT_POINTS:
             raise ValueError(
                 f"unknown fault point {point!r}; known: {FAULT_POINTS}"
             )
         spec = _Spec(point=point, count=count, label=label, delay=delay,
-                     message=message or f"injected fault: {point}")
+                     message=message or f"injected fault: {point}", tag=tag)
         with self._lock:
             self._armed.setdefault(point, []).append(spec)
 
@@ -123,10 +150,12 @@ class FaultInjector:
             else:
                 self._armed.pop(point, None)
 
-    def take(self, point: str, label: str = "") -> _Spec | None:
+    def take(self, point: str, label: str = "", tag: str | None = None) -> _Spec | None:
         """One seam check: the first armed spec matching this engine label
-        fires (its count decrements); None when nothing is armed — the
-        disarmed fast path is a single dict lookup under no lock."""
+        (and tag population — tagged specs fire only for the same tag,
+        untagged specs only for tagless takes) fires (its count
+        decrements); None when nothing is armed — the disarmed fast path
+        is a single dict lookup under no lock."""
         if not self._armed:  # benign race: worst case one extra locked check
             return None
         with self._lock:
@@ -134,6 +163,8 @@ class FaultInjector:
             if not specs:
                 return None
             for spec in specs:
+                if spec.tag != tag:
+                    continue
                 if not spec.matches(label):
                     continue
                 if spec.count == 0:
@@ -154,15 +185,28 @@ class FaultInjector:
                 return self._fired.get(point, 0)
             return sum(self._fired.values())
 
+    def has_tagged(self, point: str) -> bool:
+        """Any tagged spec armed for this point? The scheduler's poison
+        seam pre-check — keeps the per-pass cost at one dict lookup
+        while nothing is armed."""
+        if not self._armed:
+            return False
+        with self._lock:
+            return any(s.tag for s in self._armed.get(point, ()))
+
     def snapshot(self) -> dict:
         """Armed/fired view for debug_state()."""
+
+        def row(s: _Spec) -> dict:
+            out = {"count": s.count, "label": s.label, "delay": s.delay}
+            if s.tag is not None:
+                out["tag"] = s.tag
+            return out
+
         with self._lock:
             return {
                 "armed": {
-                    p: [
-                        {"count": s.count, "label": s.label, "delay": s.delay}
-                        for s in specs
-                    ]
+                    p: [row(s) for s in specs]
                     for p, specs in self._armed.items()
                 },
                 "fired": dict(self._fired),
@@ -183,7 +227,11 @@ def _arm_from_env(inj: FaultInjector, raw: str, logger=None) -> None:
         part = part.strip()
         if not part:
             continue
-        point, _, rest = part.partition("=")
+        # ``@label`` is split FIRST: device keys ("cpu:0") contain the
+        # count/delay separator, so the label must come off before the
+        # left side is parsed as count[:delay]
+        body, _, label = part.partition("@")
+        point, _, rest = body.partition("=")
         count, delay = 1, 0.0
         if rest:
             cnt, _, d = rest.partition(":")
@@ -196,7 +244,8 @@ def _arm_from_env(inj: FaultInjector, raw: str, logger=None) -> None:
                     logger.warn(f"TPU_LLM_FAULTS: unparseable entry {part!r}")
                 continue
         try:
-            inj.arm(point.strip(), count=count, delay=delay)
+            inj.arm(point.strip(), count=count, delay=delay,
+                    label=label.strip() or None)
         except ValueError as e:
             if logger is not None:
                 logger.warn(f"TPU_LLM_FAULTS: {e}")

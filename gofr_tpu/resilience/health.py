@@ -1,0 +1,345 @@
+"""Device health: failure ledger, quarantine state machine, canary gate.
+
+PR 5 gave the fleet a supervisor that rebuilds a dead replica — on the
+SAME device, forever, under capped backoff. That closes the loop for
+transient faults (an XLA abort, a watchdog-killed hang) but inverts it
+for a persistently sick chip: an HBM bank throwing ECC errors, a wedged
+ICI link, a driver fault that survives process restarts. There the
+rebuild loop never converges; the fleet silently runs one replica short
+while the supervisor burns a core re-warming executables that die on
+first dispatch. This module is the missing judgment layer, mirroring the
+reference repo's circuit breaker (trip, isolate, probe, reintegrate) at
+the TPU-device level:
+
+- :class:`DeviceHealthLedger` — a per-device sliding-window failure
+  ledger. Replica deaths and rebuild failures are CLASSIFIED
+  (``step_fault`` / ``watchdog_hang`` / ``numerical`` /
+  ``rebuild_failure``) and recorded against the device the engine ran
+  on; ``TPU_LLM_DEVICE_QUARANTINE_FAILURES`` attributable failures
+  inside ``TPU_LLM_DEVICE_QUARANTINE_WINDOW_S`` trip the device into
+  QUARANTINE. A quarantined device serves nothing until its cooldown
+  (``TPU_LLM_DEVICE_COOLDOWN_S``, doubling per re-trip, capped) elapses
+  — it then enters PROBATION: the next rebuild may use it, but only
+  behind the canary gate, and the outcome reintegrates the device or
+  re-quarantines it with a longer cooldown.
+- :func:`canary_check` — the gate itself: a fixed greedy probe prompt
+  run on a candidate engine BEFORE it enters routing. When reference
+  tokens from a healthy replica exist the candidate must match them
+  token-for-token (greedy decode is deterministic, so divergence means
+  broken compute, not randomness); without a reference the stream must
+  still be complete and in-vocabulary (the numerical-watchdog sentinel
+  ``-1`` is out-of-vocabulary by construction, so NaN logits fail here
+  too). A half-sick rebuild never receives live traffic.
+
+The supervisor (supervisor.py) drives both; ``ReplicatedLLMEngine``
+owns the ledger and exposes it in ``debug_state()["health"]``. The
+ledger takes a ``now_fn`` so tier-1 tests drive the window and cooldown
+with faked clocks (the overload.py convention).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+__all__ = [
+    "CANARY_MAX_NEW",
+    "CANARY_PROMPT",
+    "DeviceHealthLedger",
+    "canary_check",
+    "device_key",
+    "spec_device_key",
+]
+
+# Failure classes the ledger tallies. Everything a replica death can be
+# attributed to maps onto one of these (classify()); "unknown" covers a
+# thread that died without a recorded reason — still a death, still
+# counted (a sick device does not owe us a tidy stack trace).
+FAILURE_REASONS = (
+    "step_fault",
+    "watchdog_hang",
+    "numerical",
+    "rebuild_failure",
+    "unknown",
+)
+
+# Fixed greedy probe: short enough to cost one prefill chunk + one
+# decode chunk, long enough that a divergent matmul cannot stay hidden
+# behind a lucky argmax (8 sampled positions over the full vocab).
+CANARY_PROMPT = (3, 1, 4, 1, 5, 9, 2, 6)
+CANARY_MAX_NEW = 8
+
+
+def device_key(dev) -> str:
+    """Stable string identity for one jax device ("cpu:0", "tpu:3")."""
+    return f"{getattr(dev, 'platform', 'dev')}:{getattr(dev, 'id', 0)}"
+
+
+def spec_device_key(spec: dict) -> str:
+    """Identity of the device (or submesh) a replica spec pins to. A
+    tensor-parallel submesh is one health unit: its chips fail together
+    as far as the replica is concerned (any sick member kills the
+    replica), so they quarantine together."""
+    dev = spec.get("device")
+    if dev is not None:
+        return device_key(dev)
+    mesh = spec.get("mesh")
+    if mesh is not None:
+        try:
+            devs = list(mesh.devices.flat)
+        except AttributeError:  # duck-typed test meshes
+            devs = list(getattr(mesh, "devices", []) or [])
+        if devs:
+            return "+".join(sorted(device_key(d) for d in devs))
+    return "default"
+
+
+class DeviceHealthLedger:
+    """Sliding-window failure ledger with quarantine / probation states.
+
+    States per device (:meth:`state`):
+
+    - ``healthy``     — full member of the placement pool.
+    - ``quarantined`` — tripped; no placement until cooldown elapses.
+    - ``probation``   — cooldown elapsed; placement allowed but ONLY
+      behind the canary gate. :meth:`probe_ok` reintegrates (state back
+      to healthy, failure window cleared); any recorded failure while
+      quarantined/probation re-trips with a doubled (capped) cooldown.
+
+    Thread-safe; all mutation under one lock. Reads used on the
+    placement path (:meth:`usable`) are a dict lookup plus a clock
+    read."""
+
+    def __init__(
+        self,
+        *,
+        failures: int | None = None,
+        window_s: float | None = None,
+        cooldown_s: float | None = None,
+        cooldown_max_s: float | None = None,
+        now_fn=time.monotonic,
+        metrics=None,
+        model: str = "llm",
+        logger=None,
+    ):
+        if failures is None:
+            failures = int(
+                os.environ.get("TPU_LLM_DEVICE_QUARANTINE_FAILURES", "3")
+            )
+        if window_s is None:
+            window_s = float(
+                os.environ.get("TPU_LLM_DEVICE_QUARANTINE_WINDOW_S", "60")
+            )
+        if cooldown_s is None:
+            cooldown_s = float(
+                os.environ.get("TPU_LLM_DEVICE_COOLDOWN_S", "30")
+            )
+        if cooldown_max_s is None:
+            cooldown_max_s = max(cooldown_s, 8 * cooldown_s)
+        self.failures_limit = max(1, failures)
+        self.window_s = max(0.001, window_s)
+        self.cooldown_s = max(0.001, cooldown_s)
+        self.cooldown_max_s = cooldown_max_s
+        self.now = now_fn
+        self.metrics = metrics
+        self.model = model
+        self.logger = logger
+        self.quarantines = 0  # total trips (counter twin)
+        self._lock = threading.Lock()
+        # per-device: {"events": [(t, reason)], "state": str, "until": t,
+        #              "cooldown": s, "trips": n, "by_reason": {r: n}}
+        self._devices: dict[str, dict] = {}
+
+    # -- classification ---------------------------------------------------
+    @staticmethod
+    def classify(died_reason: str | None) -> str:
+        """Map an engine's ``died_reason`` onto a ledger failure class.
+        The strings are the ones ``LLMEngine._die`` callers use; anything
+        unrecognized is a plain step fault (the engine's scheduler or
+        collector lost the device mid-dispatch)."""
+        if not died_reason:
+            return "unknown"
+        r = died_reason.lower()
+        if r.startswith("step watchdog"):
+            return "watchdog_hang"
+        if r.startswith("numerical watchdog"):
+            return "numerical"
+        if "rebuild" in r or "canary" in r or "device_sick" in r:
+            return "rebuild_failure"
+        return "step_fault"
+
+    # -- recording --------------------------------------------------------
+    def record_failure(self, device: str, reason: str, detail: str = "") -> bool:
+        """Record one attributable failure against ``device``. Returns
+        True when this record newly trips (or re-trips) quarantine."""
+        if reason not in FAILURE_REASONS:
+            reason = "unknown"
+        with self._lock:
+            now = self.now()
+            d = self._devices.setdefault(
+                device,
+                {"events": [], "state": "healthy", "until": 0.0,
+                 "cooldown": self.cooldown_s, "trips": 0,
+                 "by_reason": {}},
+            )
+            d["by_reason"][reason] = d["by_reason"].get(reason, 0) + 1
+            d["events"].append((now, reason))
+            lo = now - self.window_s
+            d["events"] = [e for e in d["events"] if e[0] >= lo]
+            if d["state"] == "quarantined":
+                # a failure while quarantined (a failed probe rebuild, a
+                # death raced into the ledger late): re-trip with a
+                # doubled cooldown — repeated offenders wait longer
+                d["cooldown"] = min(d["cooldown"] * 2.0, self.cooldown_max_s)
+                d["until"] = now + d["cooldown"]
+                d["trips"] += 1
+                tripped = True
+            elif len(d["events"]) >= self.failures_limit:
+                d["state"] = "quarantined"
+                d["until"] = now + d["cooldown"]
+                d["trips"] += 1
+                tripped = True
+            else:
+                tripped = False
+            if tripped:
+                self.quarantines += 1
+        if tripped:
+            if self.logger is not None:
+                self.logger.error(
+                    f"device {device} quarantined ({reason}: {detail or 'n/a'}; "
+                    f"trip {self.quarantines}, cooldown "
+                    f"{self._devices[device]['cooldown']:.1f}s)"
+                )
+            if self.metrics is not None:
+                self.metrics.increment_counter(
+                    "app_llm_device_quarantines_total", model=self.model
+                )
+        self._observe_gauge()
+        return tripped
+
+    def probe_ok(self, device: str) -> None:
+        """A canary-gated rebuild on ``device`` passed: reintegrate.
+        No-op for a healthy device (the common rebuild path)."""
+        reintegrated = False
+        with self._lock:
+            d = self._devices.get(device)
+            if d is not None and d["state"] == "quarantined":
+                d["state"] = "healthy"
+                d["events"] = []  # a clean probe resets the window
+                d["cooldown"] = self.cooldown_s
+                reintegrated = True
+        if reintegrated and self.logger is not None:
+            self.logger.info(f"device {device} reintegrated (canary passed)")
+        self._observe_gauge()
+
+    # -- reads ------------------------------------------------------------
+    def state(self, device: str) -> str:
+        with self._lock:
+            return self._state_locked(device)
+
+    def _state_locked(self, device: str) -> str:
+        d = self._devices.get(device)
+        if d is None or d["state"] == "healthy":
+            return "healthy"
+        if self.now() >= d["until"]:
+            return "probation"  # cooldown served; next rebuild may probe
+        return "quarantined"
+
+    def usable(self, device: str) -> bool:
+        """May a rebuild target this device? Healthy always; probation
+        too (that IS the probe — the canary gate guards the outcome)."""
+        return self.state(device) != "quarantined"
+
+    def quarantined_count(self) -> int:
+        """Devices currently not healthy (quarantined or awaiting a
+        successful probe in probation) — the gauge's definition: a
+        probation device has NOT yet proven itself back."""
+        with self._lock:
+            return sum(
+                1 for k in self._devices
+                if self._state_locked(k) != "healthy"
+            )
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            now = self.now()
+            devices = {}
+            for k, d in self._devices.items():
+                st = self._state_locked(k)
+                row = {
+                    "state": st,
+                    "recent_failures": len(
+                        [e for e in d["events"] if e[0] >= now - self.window_s]
+                    ),
+                    "trips": d["trips"],
+                    "by_reason": dict(d["by_reason"]),
+                }
+                if st == "quarantined":
+                    row["cooldown_remaining_s"] = round(d["until"] - now, 2)
+                devices[k] = row
+            return {
+                "quarantines": self.quarantines,
+                "failures_limit": self.failures_limit,
+                "window_s": self.window_s,
+                "cooldown_s": self.cooldown_s,
+                "devices": devices,
+            }
+
+    def _observe_gauge(self) -> None:
+        if self.metrics is not None:
+            self.metrics.set_gauge(
+                "app_llm_devices_quarantined",
+                float(self.quarantined_count()), model=self.model,
+            )
+
+
+def canary_check(
+    candidate,
+    reference_tokens: list[int] | None = None,
+    *,
+    prompt=CANARY_PROMPT,
+    max_new: int = CANARY_MAX_NEW,
+    timeout: float = 60.0,
+) -> tuple[bool, str, list[int]]:
+    """Run the fixed greedy probe on ``candidate`` (an LLMEngine that is
+    NOT yet routed) and judge the result. Returns ``(ok, detail,
+    tokens)`` — detail is a human reason on rejection, tokens are the
+    candidate's output (a passing no-reference run becomes the cached
+    fleet reference).
+
+    With ``reference_tokens`` (a healthy replica's output for the same
+    prompt): exact token equality — greedy decode is deterministic per
+    params+config, so any divergence is broken device compute. Without:
+    the stream must complete (``max_new`` tokens — the probe sets no
+    eos, a short stream means a died/hung engine) and stay inside the
+    vocabulary (non-finite logits surface as the numerical-watchdog
+    sentinel ``-1``, or as a dead engine)."""
+    from ..llm import GenRequest
+
+    try:
+        req = candidate.submit(GenRequest(
+            list(prompt), max_new_tokens=max_new, temperature=0.0,
+            eos_token=-1,
+        ))
+        toks = req.tokens(timeout=timeout)
+    except Exception as e:  # noqa: BLE001 — a crashing probe IS the verdict
+        return False, f"probe crashed: {e!r}", []
+    if len(toks) != max_new:
+        return (
+            False,
+            f"probe stream incomplete ({len(toks)}/{max_new} tokens, "
+            f"finish={req.finish_reason!r})",
+            toks,
+        )
+    vocab = getattr(getattr(candidate, "cfg", None), "vocab_size", None)
+    if vocab is not None and any(t < 0 or t >= vocab for t in toks):
+        return False, f"probe emitted out-of-vocabulary token: {toks}", toks
+    if reference_tokens is not None and toks != list(reference_tokens):
+        return (
+            False,
+            f"probe diverged from healthy reference: {toks} != "
+            f"{list(reference_tokens)}",
+            toks,
+        )
+    return True, "ok", toks

@@ -1,14 +1,31 @@
-"""Replica supervisor: rebuild dead replicas under capped backoff.
+"""Replica supervisor: rebuild dead replicas — elastically, behind a
+canary gate, with device-health judgment.
 
 Before this module a dead replica was permanent: ``ReplicatedLLMEngine``
 stopped routing NEW work to it (llm.py ``_pick``) but nothing ever
-rebuilt it, so one XLA fault cost a replica's worth of fleet capacity
-for the rest of the process lifetime. The supervisor closes the loop the
-way the reference repo's circuit breaker does for outbound services —
-background probes that return a recovered endpoint to rotation — except
-a dead engine cannot "recover": its threads are gone, so recovery means
-CONSTRUCTING a replacement (params re-placed on the same device/submesh,
-executables re-warmed) and swapping it into the routing set.
+rebuilt it. The first supervisor closed that loop with capped backoff on
+the SAME device/submesh — which re-opened it for a persistently sick
+chip: an HBM ECC fault or wedged ICI link turns same-device restart into
+an infinite crash loop that silently costs the fleet a replica. This
+version adds the judgment layer (gofr_tpu.resilience.health, mirroring
+the reference repo's circuit breaker: trip, isolate, probe,
+reintegrate):
+
+- every replica death is CLASSIFIED and recorded against the device the
+  engine ran on; the :class:`DeviceHealthLedger` quarantines a device
+  after K attributable failures in a sliding window.
+- **elastic rebuild**: a replica whose device is quarantined rebuilds
+  from the retained host params on an alternate healthy device; when no
+  alternate exists the slot is PARKED (capacity-degraded and visible as
+  such — ``app_llm_replicas_parked``, health "degraded") instead of
+  crash-looping, and restored the moment a device becomes usable again.
+- **canary gate**: every rebuilt replica must pass the fixed greedy
+  probe (health.canary_check — token-compared against a healthy replica
+  when one exists) BEFORE it re-enters routing; a passing probe on a
+  probation device reintegrates it, a failing one re-quarantines it.
+- ``TPU_LLM_RESTART_MAX_ATTEMPTS`` consecutive failed rebuilds mark the
+  slot permanently failed (``app_llm_replicas_failed``) — an operator
+  page, not an eternal backoff.
 
 Policy: capped exponential backoff per replica slot
 (``TPU_LLM_RESTART_BACKOFF_S`` doubling to
@@ -21,6 +38,7 @@ replica there would fight the rolling deploy. Restarts are counted in
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 
@@ -30,10 +48,14 @@ __all__ = ["ReplicaSupervisor"]
 class ReplicaSupervisor:
     """Monitor thread over a ReplicatedLLMEngine's replica slots.
 
-    The fleet owns construction (``fleet._build_replica(i)`` carries the
-    per-slot device/mesh spec and the failover-hook wiring); the
-    supervisor owns only the WHEN: detect death, wait out the backoff,
-    swap the replacement in, escalate the backoff on a failed build.
+    The fleet owns construction and placement policy
+    (``fleet._build_replica(i, spec=...)`` carries the device/mesh spec
+    and failover-hook wiring; ``fleet._spec_for_rebuild(i)`` consults
+    the health ledger for the target device; ``fleet._canary_check``
+    judges the result); the supervisor owns the WHEN and the slot state
+    machine: detect death, record it, wait out the backoff, gate the
+    replacement, swap it in — or park/permanently-fail the slot when
+    placement or the gate says no.
     """
 
     def __init__(
@@ -43,16 +65,23 @@ class ReplicaSupervisor:
         interval_s: float = 0.5,
         backoff_s: float = 1.0,
         backoff_max_s: float = 30.0,
+        max_attempts: int | None = None,
     ):
         self.fleet = fleet
         self.interval = interval_s
         self.backoff0 = backoff_s
         self.backoff_max = backoff_max_s
+        if max_attempts is None:
+            max_attempts = int(
+                os.environ.get("TPU_LLM_RESTART_MAX_ATTEMPTS", "8") or 0
+            )
+        self.max_attempts = max(0, max_attempts)  # 0 = unlimited
         self.restarts = 0
         self.restart_failures = 0
+        self.canary_rejects = 0  # rebuilds refused routing by the gate
         self._stop = False
         # per-slot restart state: {slot: {"backoff": s, "next_try": t,
-        # "building": bool, "failures": n}}
+        # "failures": n, "parked": bool, "failed": bool, "reason": str}}
         self._state: dict[int, dict] = {}
         self._thread = threading.Thread(
             target=self._run, name="llm-replica-supervisor", daemon=True
@@ -75,14 +104,40 @@ class ReplicaSupervisor:
         if self._stop or getattr(fleet, "_draining", False):
             return
         now = time.perf_counter()
+        health = getattr(fleet, "health", None)
         for i, eng in enumerate(list(fleet.engines)):
             if eng.alive():
-                self._state.pop(i, None)
+                if self._state.pop(i, None) is not None:
+                    self._observe_slots()
                 continue
-            st = self._state.setdefault(
-                i, {"backoff": self.backoff0, "next_try": now + self.backoff0,
-                    "failures": 0},
-            )
+            st = self._state.get(i)
+            if st is None:
+                st = {"backoff": self.backoff0,
+                      "next_try": now + self.backoff0,
+                      "failures": 0, "parked": False, "failed": False,
+                      "reason": None}
+                self._state[i] = st
+                # classify this death and bill the device the engine was
+                # actually running on (elastic rebuilds may have moved it
+                # off its home device)
+                if health is not None:
+                    why = getattr(eng, "died_reason", None)
+                    health.record_failure(
+                        fleet._current_keys[i], health.classify(why),
+                        detail=why or "",
+                    )
+            if st["failed"]:
+                continue  # permanently failed: operator territory
+            if st["parked"]:
+                # reintegration restores capacity: the instant ANY device
+                # becomes usable for this slot (home cooldown elapsed, an
+                # alternate freed/reintegrated), leave the parking lot
+                if fleet._spec_for_rebuild(i) is None:
+                    continue
+                st["parked"] = False
+                st["reason"] = None
+                st["next_try"] = now
+                self._observe_slots()
             if now < st["next_try"]:
                 continue
             self._rebuild(i, st)
@@ -90,21 +145,44 @@ class ReplicaSupervisor:
     def _rebuild(self, i: int, st: dict) -> None:
         fleet = self.fleet
         log = getattr(fleet, "logger", None)
+        picked = fleet._spec_for_rebuild(i)
+        if picked is None:
+            # no usable device anywhere: park — a visible capacity
+            # degradation (gauge + degraded health), NOT a crash loop;
+            # the scan re-checks placement every interval
+            st["parked"] = True
+            st["reason"] = "parked: no usable device (home quarantined, no alternate)"
+            self._observe_slots()
+            if log is not None:
+                log.error(f"replica {i} parked: no usable device for rebuild")
+            return
+        spec, key = picked
         if log is not None:
-            log.warn(f"replica supervisor: rebuilding dead replica {i}")
+            home = key == fleet._device_keys[i]
+            log.warn(
+                f"replica supervisor: rebuilding dead replica {i} on "
+                f"{key}{'' if home else ' (alternate device)'}"
+            )
         t0 = time.perf_counter()
         try:
-            replacement = fleet._build_replica(i)
+            replacement = fleet._build_replica(i, spec=spec)
         except Exception as e:  # noqa: BLE001 — the device may still be sick
-            self.restart_failures += 1
-            st["failures"] += 1
-            st["backoff"] = min(st["backoff"] * 2.0, self.backoff_max)
-            st["next_try"] = time.perf_counter() + st["backoff"]
-            if log is not None:
-                log.error(
-                    f"replica {i} rebuild failed ({e!r}); next attempt in "
-                    f"{st['backoff']:.1f}s"
-                )
+            self._rebuild_failed(i, st, key, f"build failed: {e!r}")
+            return
+        try:
+            ok, detail = fleet._canary_check(replacement)
+        except Exception as e:  # noqa: BLE001 — a crashing gate must not leak the engine
+            ok, detail = False, f"canary crashed: {e!r}"
+        if not ok:
+            # a half-sick rebuild must never receive live traffic: close
+            # it and treat the gate rejection exactly like a failed build
+            # (device billed, backoff escalated, attempts counted)
+            self.canary_rejects += 1
+            try:
+                replacement.close()
+            except Exception:  # noqa: BLE001 — teardown must not mask the verdict
+                pass
+            self._rebuild_failed(i, st, key, f"canary rejected: {detail}")
             return
         if self._stop or getattr(fleet, "_draining", False):
             # raced a close/drain: the fleet is going down — do not route
@@ -112,35 +190,99 @@ class ReplicaSupervisor:
             replacement.close()
             return
         fleet.engines[i] = replacement  # atomic item swap: routers see old or new
+        fleet._current_keys[i] = key
+        health = getattr(fleet, "health", None)
+        if health is not None:
+            health.probe_ok(key)  # reintegrates a probation device; no-op else
         self._state.pop(i, None)
         self.restarts += 1
+        self._observe_slots()
         if fleet.metrics is not None:
             fleet.metrics.increment_counter(
                 "app_llm_replica_restarts_total", model=fleet.label
             )
         if log is not None:
             log.info(
-                f"replica {i} restarted and routed back in "
+                f"replica {i} restarted on {key} and routed back in "
                 f"{time.perf_counter() - t0:.1f}s"
             )
 
+    def _rebuild_failed(self, i: int, st: dict, key: str, why: str) -> None:
+        fleet = self.fleet
+        log = getattr(fleet, "logger", None)
+        self.restart_failures += 1
+        st["failures"] += 1
+        health = getattr(fleet, "health", None)
+        if health is not None:
+            # a failed rebuild is an attributable device failure: enough
+            # of them quarantine the device, which reroutes the NEXT
+            # attempt to an alternate instead of retrying the sick chip
+            health.record_failure(key, "rebuild_failure", detail=why)
+        if self.max_attempts and st["failures"] >= self.max_attempts:
+            st["failed"] = True
+            st["reason"] = (
+                f"permanently failed after {st['failures']} rebuild "
+                f"attempts (last: {why})"
+            )
+            self._observe_slots()
+            if log is not None:
+                log.error(f"replica {i} {st['reason']}")
+            return
+        st["backoff"] = min(st["backoff"] * 2.0, self.backoff_max)
+        st["next_try"] = time.perf_counter() + st["backoff"]
+        if log is not None:
+            log.error(
+                f"replica {i} rebuild on {key} failed ({why}); next attempt "
+                f"in {st['backoff']:.1f}s"
+            )
+
     # -- introspection / lifecycle ---------------------------------------
+    def parked_count(self) -> int:
+        return sum(1 for st in list(self._state.values()) if st.get("parked"))
+
+    def failed_count(self) -> int:
+        return sum(1 for st in list(self._state.values()) if st.get("failed"))
+
+    def _observe_slots(self) -> None:
+        """Keep the capacity-degradation gauges live: parked and
+        permanently-failed slots are exactly what the health endpoint
+        and dashboards alert on."""
+        metrics = getattr(self.fleet, "metrics", None)
+        if metrics is None:
+            return
+        metrics.set_gauge(
+            "app_llm_replicas_parked", float(self.parked_count()),
+            model=self.fleet.label,
+        )
+        metrics.set_gauge(
+            "app_llm_replicas_failed", float(self.failed_count()),
+            model=self.fleet.label,
+        )
+
     def snapshot(self) -> dict:
         # list() guards against the supervisor thread resizing the dict
         # mid-iteration; the values are read torn-tolerantly (debug view)
-        per_slot = {
-            i: {
+        per_slot = {}
+        for i, st in list(self._state.items()):
+            row = {
                 "backoff_s": round(st["backoff"], 2),
                 "failures": st["failures"],
                 "retry_in_s": round(
                     max(0.0, st["next_try"] - time.perf_counter()), 2
                 ),
+                "parked": st["parked"],
+                "failed": st["failed"],
             }
-            for i, st in list(self._state.items())
-        }
+            if st.get("reason"):
+                row["reason"] = st["reason"]
+            per_slot[i] = row
         return {
             "restarts": self.restarts,
             "restart_failures": self.restart_failures,
+            "canary_rejects": self.canary_rejects,
+            "max_attempts": self.max_attempts,
+            "parked": self.parked_count(),
+            "failed": self.failed_count(),
             "interval_s": self.interval,
             "pending": per_slot,
         }

@@ -1,0 +1,194 @@
+#!/usr/bin/env python
+"""CI quarantine smoke: sick device -> quarantine -> park -> reintegrate,
+over real sockets.
+
+Boots a 2-replica CPU fleet (two virtual devices — deliberately no spare,
+so losing a device parks its slot) behind a tiny-model app, kills replica
+0 with a persistently sick home device (``device_sick`` fault armed for
+its device key), and asserts the device-health contract
+(docs/advanced-guide/resilience.md):
+
+- the device is quarantined within the failure window (no infinite
+  same-device restart loop),
+- with no alternate device the slot PARKS: /.well-known/health reports
+  "degraded" and app_llm_replicas_parked=1 on /metrics while the
+  survivor keeps answering 200s with token-identical greedy output,
+- after the cooldown the device is probed, passes the canary gate, and
+  is REINTEGRATED: capacity returns to 2 replicas, the gauges clear,
+  and health reports UP again,
+- app_llm_device_quarantines_total is visible on /metrics.
+
+Usage: JAX_PLATFORMS=cpu python scripts/smoke_quarantine.py
+Exit codes: 0 clean, non-zero assertion failure (message on stderr).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# two virtual CPU devices for the two replicas (no spare: the park path
+# is the point), fast supervisor/quarantine cadence — BEFORE jax import
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=2"
+    ).strip()
+os.environ.setdefault("TPU_LLM_SUPERVISOR_INTERVAL_S", "0.05")
+os.environ.setdefault("TPU_LLM_RESTART_BACKOFF_S", "0.1")
+os.environ.setdefault("TPU_LLM_DEVICE_QUARANTINE_FAILURES", "2")
+os.environ.setdefault("TPU_LLM_DEVICE_QUARANTINE_WINDOW_S", "60")
+# long enough that the parked-state assertions (health probe + three
+# socket round trips) cannot race reintegration, short enough for CI
+os.environ.setdefault("TPU_LLM_DEVICE_COOLDOWN_S", "8.0")
+
+
+def _wait(pred, timeout: float, what: str) -> None:
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def main() -> int:
+    import jax
+
+    from gofr_tpu import App
+    from gofr_tpu.config import new_mock_config
+    from gofr_tpu.llm import LLMEngine
+    from gofr_tpu.models import TransformerConfig, init_params
+    from gofr_tpu.resilience import FaultInjector
+
+    cfg = TransformerConfig.tiny()
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    assert len(jax.devices()) >= 2, jax.devices()
+    inj = FaultInjector()
+    app = App(config=new_mock_config({
+        "APP_NAME": "quarantine-smoke", "HTTP_PORT": "0", "METRICS_PORT": "0",
+        "LOG_LEVEL": "ERROR", "TPU_TELEMETRY_INTERVAL_S": "0",
+        "REQUEST_TIMEOUT": "60",
+    }))
+    app.container.tpu().register_llm(
+        "tiny", cfg, params, replicas=2, slots=2, max_seq_len=128,
+        prefill_buckets=(8,), prefill_chunk=4, step_token_budget=4,
+        decode_chunk=2, lookahead=1, warmup=False, fault_injector=inj,
+    )
+
+    def gen(ctx):
+        body = ctx.bind()
+        out = ctx.tpu().llm("tiny").generate(
+            list(body["tokens"]),
+            max_new_tokens=int(body.get("max_new_tokens", 16)),
+        )
+        return {"tokens": out}
+
+    app.post("/generate", gen)
+    app.run_in_background()
+    base = f"http://127.0.0.1:{app.http_server.port}"
+    mbase = f"http://127.0.0.1:{app.metrics_server.port}"
+
+    def post_generate(tokens, n):
+        req = urllib.request.Request(
+            f"{base}/generate",
+            data=json.dumps({"tokens": tokens, "max_new_tokens": n}).encode(),
+            headers={"Content-Type": "application/json"}, method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=60) as r:
+            # POST carries the framework's 201 envelope; either way the
+            # request SUCCEEDED — the survivor absorbed it
+            assert r.status in (200, 201), r.status
+            return json.loads(r.read())["data"]["tokens"]
+
+    def health_status():
+        with urllib.request.urlopen(
+            f"{base}/.well-known/health", timeout=10
+        ) as r:
+            return json.load(r)["data"]["status"]
+
+    def metrics_text():
+        with urllib.request.urlopen(f"{mbase}/metrics", timeout=15) as r:
+            return r.read().decode()
+
+    try:
+        rep = app.container.tpu().llm("tiny")
+        prompt = list(range(1, 17))
+
+        # unfaulted reference: a bare single engine on the same params
+        mono = LLMEngine(
+            cfg, params, slots=2, max_seq_len=128, prefill_buckets=(8,),
+            prefill_chunk=4, step_token_budget=4, decode_chunk=2,
+            warmup=False,
+        )
+        try:
+            want = mono.generate(prompt, max_new_tokens=24)
+        finally:
+            mono.close()
+        assert post_generate(prompt, 24) == want, "pre-fault output diverged"
+        assert health_status() == "UP"
+
+        # replica 0's home device is persistently sick: its next rebuild
+        # fails, and with the death that makes 2 attributable failures
+        # inside the window -> quarantine (the smoke's K)
+        home = rep._device_keys[0]
+        corpse = rep.engines[0]
+        inj.arm("device_sick", label=home, count=1)
+        inj.arm("replica_kill", label="/r0")
+        _wait(lambda: not corpse.alive(), 15, "replica 0 death")
+        _wait(
+            lambda: rep.health.state(home) == "quarantined", 30,
+            "device quarantine within the window",
+        )
+        print(f"quarantine OK: {home} quarantined "
+              f"(trips={rep.health.quarantines})")
+
+        # no alternate device exists -> the slot parks (visible capacity
+        # degradation, not a crash loop) while the survivor keeps serving
+        _wait(lambda: rep.supervisor.parked_count() == 1, 30, "slot parked")
+        assert health_status() == "degraded", "health must report degraded"
+        for _ in range(3):
+            assert post_generate(prompt, 24) == want, (
+                "survivor output diverged during quarantine"
+            )
+        expo = metrics_text()
+        assert "app_llm_device_quarantines_total" in expo
+        assert 'app_llm_replicas_parked{model="tiny"} 1' in expo, (
+            "parked gauge missing/zero on /metrics"
+        )
+        print("parked OK: degraded health, survivor serving 200s, "
+              "counters on /metrics")
+
+        # cooldown elapses -> probation -> probe rebuild passes the
+        # canary -> device reintegrated, capacity restored
+        _wait(
+            lambda: rep.engines[0] is not corpse and rep.engines[0].alive(),
+            60, "reintegration rebuild",
+        )
+        _wait(lambda: rep.health.state(home) == "healthy", 15, "reintegration")
+        _wait(lambda: rep.supervisor.parked_count() == 0, 10, "unpark")
+        assert rep.stats()["replicas_alive"] == 2
+        assert health_status() == "UP", "health did not recover"
+        assert post_generate(prompt, 24) == want, "post-reintegration diverged"
+        expo = metrics_text()
+        assert 'app_llm_replicas_parked{model="tiny"} 0' in expo
+        print(f"reintegration OK: {home} healthy, replicas_alive=2, "
+              f"restarts={rep.supervisor.restarts}")
+        print("smoke_quarantine: OK")
+        return 0
+    finally:
+        app.shutdown()
+
+
+if __name__ == "__main__":
+    rc = main()
+    sys.stdout.flush()
+    sys.stderr.flush()
+    # _exit skips interpreter teardown (see smoke_profiling.py: XLA
+    # destructors intermittently abort after all work completed)
+    os._exit(rc)
