@@ -744,6 +744,16 @@ def bench_serving(args) -> dict:
             ceiling_sust_qps,
         )
 
+    # speculative-decoding operating point (BENCH_r12+): spec-on vs
+    # spec-off decode tokens/s on a greedy repetitive-suffix mix (the
+    # n-gram drafter's home turf) and a natural-text mix (the adaptive
+    # backoff's no-regression check), acceptance rate alongside
+    # (gofr_tpu.spec; docs/advanced-guide/speculative-decoding.md)
+    if on_tpu and not args.no_spec:
+        detail["speculative"] = _bench_speculative(
+            args, cfg, eng.params if quantize else params, quantize
+        )
+
     # prefix-cache operating point: 50% shared-prefix traffic — hits skip
     # the prefill wave entirely, so the engine can exceed the NO-CACHE
     # device ceiling (per-request prefill is the larger serial share at
@@ -1012,6 +1022,86 @@ def _bench_prefix_cache(args, cfg, params, quantize: bool, ceiling_qps: float) -
     finally:
         eng.close()
     return point
+
+
+def _bench_speculative(args, cfg, params, quantize: bool) -> dict:
+    """Speculative-decoding point (BENCH_r12+): decode-heavy closed runs
+    (short prompts, long completions — decode wall dominates) on two
+    prompt mixes, spec-on vs spec-off, same engine shapes. The
+    repetitive-suffix mix (prompt tail = a repeating 4-gram; greedy
+    continuations extend the pattern) is where prompt-lookup drafting
+    pays — the adjudicated number is its tokens/s speedup, with the
+    measured acceptance rate alongside. The natural mix (uniform random
+    tokens, ~0% self-similarity) checks the adaptive backoff's
+    no-regression claim: spec-on must hold ~1x, not collapse."""
+    from gofr_tpu.llm import GenRequest, LLMEngine
+
+    S = args.prefill_len
+    new_tokens = max(4 * args.new_tokens, 64)  # decode-dominated requests
+    n_req = 2 * args.batch
+    rng = np.random.default_rng(11)
+    pattern = rng.integers(1, cfg.vocab_size, 4).tolist()
+    rep_prompts = []
+    nat_prompts = []
+    for i in range(n_req):
+        head = np.random.default_rng(1000 + i).integers(
+            1, cfg.vocab_size, size=max(1, S - 8 - 24),
+        ).tolist()
+        rep_prompts.append((head + pattern * 6)[-(S - 8):])
+        nat_prompts.append(np.random.default_rng(2000 + i).integers(
+            1, cfg.vocab_size, size=S - 8,
+        ).tolist())
+
+    def run(spec_on: bool, prompts: list[list[int]]) -> tuple[float, dict]:
+        eng = LLMEngine(
+            cfg, params, slots=min(args.batch, 64),
+            max_seq_len=S + new_tokens + 2 * args.decode_chunk,
+            prefill_buckets=(S,), decode_chunk=args.decode_chunk,
+            admit_cap=args.admit_cap, quantize=quantize,
+            speculative=spec_on, spec_draft=4,
+        )
+        try:
+            # warm every dispatch path on a short burst before timing
+            warm = [eng.submit(GenRequest(list(p), max_new_tokens=8))
+                    for p in prompts[:8]]
+            for r in warm:
+                r.tokens()
+            st0 = eng.stats()["spec"]
+            t0 = time.perf_counter()
+            reqs = [eng.submit(GenRequest(list(p), max_new_tokens=new_tokens))
+                    for p in prompts]
+            total = sum(len(r.tokens(timeout=600)) for r in reqs)
+            wall = time.perf_counter() - t0
+            # diff over the timed window only: stats()["spec"] is
+            # cumulative and the warm burst's drafting would otherwise
+            # pollute the acceptance rate printed next to this speedup
+            st1 = eng.stats()["spec"]
+            st = {
+                k: st1[k] - st0[k]
+                for k in ("proposed", "accepted", "plain_lanes", "steps")
+            }
+            st["accept_rate"] = (
+                round(st["accepted"] / st["proposed"], 3)
+                if st["proposed"] else None
+            )
+        finally:
+            eng.close()
+        return total / wall, st
+
+    out: dict = {"new_tokens": new_tokens, "requests": n_req, "draft": 4}
+    for name, prompts in (("repetitive", rep_prompts), ("natural", nat_prompts)):
+        base_tok_s, _ = run(False, prompts)
+        spec_tok_s, st = run(True, prompts)
+        out[name] = {
+            "base_tok_s": round(base_tok_s, 0),
+            "spec_tok_s": round(spec_tok_s, 0),
+            "speedup": round(spec_tok_s / max(base_tok_s, 1e-9), 2),
+            "accept_rate": st["accept_rate"],
+            "proposed": st["proposed"],
+            "accepted": st["accepted"],
+            "plain_lanes": st["plain_lanes"],
+        }
+    return out
 
 
 def _bench_interactive_slo(args, cfg, params, quantize: bool) -> dict:
@@ -1437,6 +1527,9 @@ def main() -> None:
                     help="skip the 4k-prompt sliding-window operating point")
     ap.add_argument("--no-prefix-cache", action="store_true",
                     help="skip the 50%%-shared-prefix prefix-cache point")
+    ap.add_argument("--no-spec", action="store_true",
+                    help="skip the speculative-decoding point (spec-on vs "
+                         "spec-off tokens/s + acceptance rate)")
     ap.add_argument("--no-interactive-slo", action="store_true",
                     help="skip the mixed-prompt interactive-SLO point")
     ap.add_argument("--no-degraded", action="store_true",
@@ -1535,6 +1628,14 @@ def _summary_line(result: dict) -> dict:
         pc = d["prefix_cache"]
         s["prefix_cache_qps"] = pc.get("qps")
         s["prefix_vs_ceiling"] = pc.get("qps_vs_no_cache_ceiling")
+    if d.get("speculative"):  # BENCH_r12+: spec-on vs spec-off decode
+        sp = d["speculative"]
+        s["speculative"] = {
+            "rep_speedup": (sp.get("repetitive") or {}).get("speedup"),
+            "rep_accept_rate": (sp.get("repetitive") or {}).get("accept_rate"),
+            "rep_spec_tok_s": (sp.get("repetitive") or {}).get("spec_tok_s"),
+            "nat_speedup": (sp.get("natural") or {}).get("speedup"),
+        }
     if d.get("interactive_slo"):  # BENCH_r08+: chunked-prefill tail view
         isl = d["interactive_slo"]
         s["interactive_slo"] = {

@@ -28,6 +28,22 @@ import gofr_tpu
 TOKENIZER = None  # set at build time when configured
 
 
+def _spec_kw() -> dict:
+    """Speculative-decoding kwargs from LLM_SPEC / LLM_SPEC_DRAFT —
+    only the keys the operator actually set, so register_llm's
+    app-config defaulting (TPU_LLM_SPEC*) still applies when unset."""
+    kw: dict = {}
+    v = os.environ.get("LLM_SPEC", "").lower()
+    if v in ("1", "true"):
+        kw["speculative"] = True
+    elif v in ("0", "false"):
+        kw["speculative"] = False
+    d = int(os.environ.get("LLM_SPEC_DRAFT", "0") or 0)
+    if d:
+        kw["spec_draft"] = d
+    return kw
+
+
 def build_engine(app):
     global TOKENIZER
     import jax
@@ -89,6 +105,19 @@ def build_engine(app):
         # decode) — halves the HBM stream decode is bound by, and the only
         # way 7B fits one v5e chip
         quantize=os.environ.get("GEMMA_INT8", "").lower() in ("1", "true"),
+        # LLM_SPEC=1: speculative decoding — the host-side n-gram
+        # drafter with fused on-device verification. Greedy outputs are
+        # token-identical to spec-off and temperature outputs keep their
+        # distribution; repetitive/structured output (code, JSON,
+        # extraction) decodes multiple tokens per forward pass
+        # (docs/advanced-guide/speculative-decoding.md). Draft length
+        # via LLM_SPEC_DRAFT (default 4). The kwargs ride **_spec_kw and
+        # are OMITTED when the env vars are unset — passing None would
+        # defeat register_llm's setdefault of the documented
+        # TPU_LLM_SPEC / TPU_LLM_SPEC_DRAFT app-config knobs (the
+        # prefix_cache_mb precedent below); an explicit LLM_SPEC=0 still
+        # forces OFF even when the fleet-wide config knob is on.
+        **_spec_kw(),
         # prefix_cache_mb is NOT passed here: register_llm defaults it
         # from the documented TPU_LLM_PREFIX_CACHE_MB config knob
         # (docs/references/configs.md). Set it >0 to retain prefill KV

@@ -259,6 +259,11 @@ class TPURuntime:
         # defaults, which also honor the same names as process env vars)
         self.default_llm_step_budget = get("TPU_LLM_STEP_TOKEN_BUDGET", "")
         self.default_llm_prefill_chunk = get("TPU_LLM_PREFILL_CHUNK", "")
+        # speculative decoding knobs (gofr_tpu.spec; "" = engine
+        # defaults, which read the same names as process env vars) —
+        # docs/advanced-guide/speculative-decoding.md
+        self.default_llm_spec = get("TPU_LLM_SPEC", "")
+        self.default_llm_spec_draft = get("TPU_LLM_SPEC_DRAFT", "")
         # resilience knobs (gofr_tpu.resilience): step-watchdog threshold
         # seconds ("" = engine default, which reads the same env var; 0
         # disables) and the numerical watchdog gate ("" = engine default,
@@ -443,7 +448,11 @@ class TPURuntime:
         comes from gofr_tpu.kvcache; `prefix_cache_mb` defaults to the
         TPU_LLM_PREFIX_CACHE_MB config knob, and the token-budget step
         scheduler honors TPU_LLM_STEP_TOKEN_BUDGET / TPU_LLM_PREFILL_CHUNK
-        (docs/advanced-guide/scheduling.md). Overload control — priority
+        (docs/advanced-guide/scheduling.md). Speculative decoding — a
+        host-side n-gram drafter with fused on-device verification,
+        greedy-token-identical and distribution-preserving — is enabled
+        per engine with TPU_LLM_SPEC=1 (draft length TPU_LLM_SPEC_DRAFT;
+        docs/advanced-guide/speculative-decoding.md). Overload control — priority
         classes with batch preemption, per-client weighted fair queuing
         (`fair_weights`), predicted-wait shedding and brownout, the
         fleet admission cap and retry budget — is on by default and
@@ -469,6 +478,14 @@ class TPURuntime:
         if self.default_llm_prefill_chunk != "":
             engine_kw.setdefault(
                 "prefill_chunk", int(self.default_llm_prefill_chunk)
+            )
+        if self.default_llm_spec != "":
+            engine_kw.setdefault(
+                "speculative", self.default_llm_spec != "0"
+            )
+        if self.default_llm_spec_draft != "":
+            engine_kw.setdefault(
+                "spec_draft", int(self.default_llm_spec_draft)
             )
         if self.default_llm_step_watchdog != "":
             engine_kw.setdefault(

@@ -43,6 +43,19 @@ Design (all shapes static; a bounded set of compiled executables):
   cache seeds `prefill_pos` mid-prompt and only the unshared chunks run.
   step_token_budget=0 restores the monolithic wave path (the A/B lever
   the equality tests drive).
+- **Speculative decoding (opt-in, TPU_LLM_SPEC=1).** A host-side
+  n-gram/prompt-lookup drafter (gofr_tpu.spec) proposes up to
+  TPU_LLM_SPEC_DRAFT tokens per decoding slot; ONE fused verify program
+  (llm.step_v, models.transformer.verify_chunk) scores every draft
+  position against the slot KV in a single write-then-attend pass,
+  samples each with the regular top-k machinery, accepts the longest
+  agreeing prefix ON DEVICE (tail/cursors stay chained; rejected rows
+  roll back behind the cursor), and the host emits the accepted span as
+  one multi-token push. Greedy spec-on is token-identical to spec-off;
+  temperature is distribution-preserving. Verifies pipeline against
+  their own optimistic draft stream; when nothing drafts the engine
+  falls back to the plain chunk pipeline and periodically re-probes
+  (docs/advanced-guide/speculative-decoding.md).
 - **Admission without stalling decode.** Monolithic-path prefill waves
   dispatch asynchronously BETWEEN decode chunks; the first sampled token
   is merged into the on-device tail vector by a jitted scatter (no host
@@ -143,6 +156,23 @@ def _register_phase_metrics(metrics) -> None:
                 (8.0, 16.0, 32.0, 64.0, 128.0, 256.0, 512.0, 1024.0,
                  2048.0, 4096.0, 8192.0),
             )
+        # speculative decoding (gofr_tpu.spec;
+        # docs/advanced-guide/speculative-decoding.md)
+        for name, desc in (
+            ("app_llm_spec_proposed_total",
+             "llm speculative draft tokens proposed (n-gram drafter)"),
+            ("app_llm_spec_accepted_total",
+             "llm speculative draft tokens accepted by verification"),
+        ):
+            if not metrics.has(name):
+                metrics.new_counter(name, desc)
+        if not metrics.has("app_llm_spec_tokens_per_step"):
+            metrics.new_histogram(
+                "app_llm_spec_tokens_per_step",
+                "llm tokens emitted per slot per speculative verify step "
+                "(accepted draft + 1 bonus; 1 = nothing accepted)",
+                (1.0, 2.0, 3.0, 4.0, 5.0, 6.0, 8.0, 12.0, 16.0),
+            )
         for name, desc in (
             ("app_llm_slots_in_use", "llm decode slots holding a live request"),
             ("app_llm_queue_depth", "llm requests waiting for a slot"),
@@ -160,6 +190,9 @@ def _register_phase_metrics(metrics) -> None:
             ("app_llm_roofline_ratio",
              "compute_time/memory_time per phase (>1 compute-bound, "
              "<1 HBM-bandwidth-bound)"),
+            ("app_llm_spec_accept_rate",
+             "llm cumulative speculative draft acceptance rate 0..1 "
+             "(accepted/proposed; zeroed at engine close)"),
         ):
             if not metrics.has(name):
                 metrics.new_gauge(name, desc)
@@ -312,6 +345,19 @@ class GenRequest:
         self._rows_hi = 0  # highest slot row ever written (prefix trim)
         self._prefill_t0: float | None = None  # first chunk dispatch time
         self._load_acct = 0  # outstanding token estimate (router weighting)
+        # -- speculative decoding (gofr_tpu.spec; engine-maintained) --
+        # acceptance-rate EMA driving the adaptive draft length, and the
+        # plain-pass streak that paces the backed-off re-probe. Starts
+        # optimistic: the first verify measures the request's real rate.
+        self._spec_ema = 1.0
+        self._spec_plain = 0
+        # optimistic pipelining state: predicted-but-unconfirmed tokens
+        # (one span per in-flight verify) the drafter extends so the
+        # next verify can DISPATCH before the previous one is fetched —
+        # the verify program chains tail/cursor from device state, so a
+        # stale draft costs acceptance, never correctness
+        self._spec_pending: list[int] = []
+        self._spec_inflight = 0
         # -- observability (engine-maintained; read by debug/stats/traces) --
         self.phase = "new"  # new -> queued -> prefill -> decode -> done
         self.prefix_hit = False
@@ -370,6 +416,10 @@ class GenRequest:
 class LLMEngine:
     _FETCH_FAIL_LIMIT = 3  # consecutive fetch failures before full reset
     _PREEMPT_CAP = 2  # max evictions per batch request (then it keeps its slot)
+    # plain decode chunks bought by one failed clean-pipe drafting probe
+    # (speculative mode): the chunk pipeline then drains and speculation
+    # re-probes — ~one exposed fetch RTT per this many chunks of overhead
+    _SPEC_REPROBE_CHUNKS = 16
 
     def __init__(
         self,
@@ -382,6 +432,8 @@ class LLMEngine:
         decode_chunk: int = 8,
         prefill_chunk: int | None = None,
         step_token_budget: int | None = None,
+        speculative: bool | None = None,
+        spec_draft: int | None = None,
         lookahead: int = 3,
         admit_cap: int = 8,
         admit_delay_ms: float = 40.0,
@@ -477,6 +529,35 @@ class LLMEngine:
         shapes.discard(0)
         self.chunk_shapes = tuple(sorted(shapes)) or (
             min(self.prefill_chunk, max_seq_len),
+        )
+        # -- speculative decoding (gofr_tpu.spec;
+        # docs/advanced-guide/speculative-decoding.md) --------------------
+        # A host-side n-gram/prompt-lookup drafter proposes up to
+        # spec_draft tokens per decoding slot; ONE fused verify program
+        # scores all draft+1 positions against the slot KV, samples each
+        # with the regular top-k machinery, accepts the longest agreeing
+        # prefix ON DEVICE (tail/cursors stay device-resident), and rolls
+        # the KV cursor back past rejected rows. Greedy spec-on is
+        # token-identical to spec-off; temperature>0 is
+        # distribution-preserving (Leviathan rejection sampling for a
+        # deterministic drafter). OFF by default: disabled, no verify
+        # program exists and no scheduler path changes — a true no-op.
+        if speculative is None:
+            speculative = _os.environ.get("TPU_LLM_SPEC", "0") not in ("", "0")
+        self.speculative = bool(speculative)
+        if spec_draft is None:
+            spec_draft = int(_os.environ.get("TPU_LLM_SPEC_DRAFT", "") or 0)
+        if not spec_draft:
+            from .spec import SPEC_DRAFT_DEFAULT
+
+            spec_draft = SPEC_DRAFT_DEFAULT
+        # verify transiently writes draft+1 rows past a slot's length;
+        # submit()'s decode-room cap reserves 2*decode_chunk rows of
+        # slack, so the draft must fit it (dense scatters drop overflow,
+        # but a silent clamp beats silent garbage)
+        self.spec_draft = (
+            max(1, min(int(spec_draft), 2 * decode_chunk))
+            if self.speculative else 0
         )
         # SLO-aware overload control (both optional, both mutable at
         # runtime): max_queue bounds requests waiting for a slot — beyond
@@ -626,10 +707,19 @@ class LLMEngine:
         # registered model name, and replicated serving suffixes a replica
         # index — otherwise N replicas' resident-bytes gauges share one
         # label set and clobber each other on /metrics.
+        # Ring-capacity slack must cover every append width the engine
+        # dispatches: the largest prefill chunk shape AND the speculative
+        # verify width (draft + 1) — a rolling slot's capacity bound is
+        # what guarantees an append can never overwrite an in-window row
+        # (and that rolled-back stale rows reconstruct a full lap behind
+        # every query's window; ops.chunk_prefill_attention).
+        kv_slack = max(self.chunk_shapes) if self.chunked else 0
+        if self.speculative:
+            kv_slack = max(kv_slack, self.spec_draft + 1)
         self.kv = CacheManager(
             cfg, slots, max_seq_len, decode_chunk,
             window=kv_window, prefix_cache_mb=prefix_cache_mb,
-            prefill_chunk=max(self.chunk_shapes) if self.chunked else 0,
+            prefill_chunk=kv_slack,
             metrics=metrics, model=kv_label,
         )
         self._sharded = mesh is not None and param_specs is not None
@@ -868,6 +958,77 @@ class LLMEngine:
         if self.chunked:
             for shape in self.chunk_shapes:
                 self._step_ops[shape] = _make_step_op(shape)
+
+        # -- fused speculative verify program (gofr_tpu.spec) -------------
+        # ONE full-batch program in the step family (llm.step_v{W}):
+        # score all W = draft+1 positions of every selected slot's draft
+        # in one write-then-attend forward pass
+        # (models.transformer.verify_chunk), sample each position with
+        # the engine's regular _sample, accept the longest agreeing
+        # prefix ON DEVICE, advance tail/length to the accepted state —
+        # so the device batch state stays chained exactly as decode
+        # chunks leave it, and the host fetch only feeds emission and the
+        # drafter. Rejected rows stay above the rolled-back cursor,
+        # masked until overwritten (ops.chunk_prefill_attention's
+        # rollback contract). Built ONLY when speculation is on: spec-off
+        # engines compile and register nothing new.
+        self.drafter = None
+        self._verify_op = None
+        if self.speculative:
+            from .models.transformer import verify_chunk as verify_fn
+            from .spec import NGramDrafter
+
+            self.drafter = NGramDrafter()
+            Kd = self.spec_draft
+            Wv = Kd + 1
+
+            def _verify(params, cache, tail, temps, pack, rng):
+                """pack [S, Kd+2] int32: draft tokens | n_draft | selected.
+                Unselected lanes write nothing (n_in 0 drops every
+                scatter index) and keep their tail/length — the program
+                is safe to run over the full slot batch."""
+                drafts = pack[:, :Kd]
+                n_draft = pack[:, Kd]
+                sel = pack[:, Kd + 1] == 1
+                n_in = jnp.where(sel, n_draft + 1, 0)
+                toks = jnp.concatenate([tail[:, None], drafts], axis=1)
+                logits, new_cache = verify_fn(
+                    params, cfg, toks, cache, cache.length, n_in,
+                    ring=self.kv.ring,
+                )
+                rng, sub = jax.random.split(rng)
+                keys = jax.random.split(sub, Wv)
+                ys = jnp.stack(
+                    [
+                        _sample(logits[:, j], temps, keys[j])
+                        for j in range(Wv)
+                    ],
+                    axis=1,
+                )  # [S, W] int32
+                # longest-agreeing-prefix acceptance (== Leviathan
+                # rejection sampling for the deterministic drafter:
+                # ys[j] ~ p_j via _sample, so draft j is accepted with
+                # probability p_j(draft) and a rejection emits the
+                # residual-distribution sample)
+                agree = (ys[:, :Kd] == drafts) & (
+                    jnp.arange(Kd, dtype=jnp.int32)[None, :]
+                    < n_draft[:, None]
+                )
+                acc = jnp.cumprod(agree.astype(jnp.int32), axis=1).sum(
+                    axis=1
+                )  # [S] accepted draft tokens
+                bonus = jnp.take_along_axis(ys, acc[:, None], axis=1)[:, 0]
+                new_len = jnp.where(
+                    sel, cache.length + acc + 1, cache.length
+                )
+                cache = new_cache._replace(length=new_len)
+                tail = jnp.where(sel, bonus, tail)
+                return ys, acc, cache, tail, rng
+
+            self._verify_op = instrument_jit(
+                f"llm.step_v{Wv}", _verify, model=self.label,
+                metrics=metrics, donate_argnums=(1, 2),
+            )
         self._rng = jax.random.PRNGKey(0)
 
         self.cache = self.kv.init_cache(slots)
@@ -898,6 +1059,13 @@ class LLMEngine:
         self._stat_wave_reqs = 0  # requests admitted via waves
         self._stat_steps = 0  # unified steps dispatched (chunked scheduler)
         self._stat_step_tokens = 0  # tokens packed into unified steps
+        # speculative-decoding telemetry (gofr_tpu.spec)
+        self.spec_steps = 0  # verify dispatches
+        self.spec_proposed = 0  # draft tokens proposed
+        self.spec_accepted = 0  # draft tokens accepted
+        self.spec_plain = 0  # verify lanes run with zero draft (plain decode)
+        self._spec_hold = 0  # plain-chunk burst left before the next probe
+        self._spec_rr = 0  # budget-cut rotation cursor (verify slot fairness)
         self._prefilling: deque[GenRequest] = deque()  # resident, not decoding
         self._load_tokens = 0  # outstanding token estimate (router weighting)
         self._last_submit_t: float | None = None
@@ -1019,6 +1187,10 @@ class LLMEngine:
         now = time.perf_counter()
         req.submitted_at = now
         req.phase = "queued"
+        # continuations (failover re-submits) carry engine-side spec
+        # state from their previous replica; it is meaningless here
+        req._spec_pending = []
+        req._spec_inflight = 0
         if self.tracer is not None and req.span is None:
             # span is None except for failover continuations, whose
             # llm.request span from the original submit stays open across
@@ -1048,9 +1220,14 @@ class LLMEngine:
         self.submitted += 1  # routing/diagnostic counter (GIL-atomic enough)
         with self._lock:
             # outstanding-token estimate for the replica router: prompt
-            # remainder + expected decode, credited back as chunks append
-            # and tokens emit (load_tokens())
-            req._load_acct = plen + req.max_new_tokens
+            # remainder + expected REMAINING decode, credited back as
+            # chunks append and tokens emit (load_tokens()). max_new
+            # minus emitted, not max_new: a failover continuation
+            # re-submits with emitted > 0, and billing the already-
+            # emitted tokens again would overweight the replica for work
+            # nobody will do — multi-token speculative spans make that
+            # drift material (docs/advanced-guide/speculative-decoding.md)
+            req._load_acct = plen + max(0, req.max_new_tokens - req.emitted)
             self._load_tokens += req._load_acct
             # EMA update under the lock: concurrent submitters racing the
             # read-modify-write could blend NEGATIVE gaps into the estimate
@@ -1106,6 +1283,8 @@ class LLMEngine:
                 "step_token_budget": self.step_token_budget,
                 "chunk_shapes": list(self.chunk_shapes),
                 "prefilling": len(self._prefilling),
+                # speculative decoding (gofr_tpu.spec)
+                "spec": self._spec_summary(),
                 "load_tokens": self.load_tokens(),
                 "rejected": self.rejected,
                 "shed": self.shed,
@@ -1187,6 +1366,14 @@ class LLMEngine:
                         "active": e[6]["active"],
                         "age_ms": round((now - e[6]["t0"]) * 1e3, 1),
                     })
+                elif e[0] == "verify":
+                    inflight.append({
+                        "kind": "verify",
+                        "requests": [r.id for _s, r in e[3]],
+                        "draft": e[4]["W"] - 1,
+                        "proposed": e[4]["proposed"],
+                        "age_ms": round((now - e[4]["t0"]) * 1e3, 1),
+                    })
                 else:
                     inflight.append({
                         "kind": "chunk",
@@ -1224,6 +1411,7 @@ class LLMEngine:
             "step_token_budget": self.step_token_budget,
             "chunk_shapes": list(self.chunk_shapes),
             "prefilling": len(self._prefilling),
+            "spec": self._spec_summary(),
             "slot_table": slot_table,
             "inflight": inflight,
             "waiting_total": waiting_total,
@@ -1239,6 +1427,22 @@ class LLMEngine:
             "rejected": self.rejected,
             "shed": self.shed,
             "kvcache": self.kv.stats(),
+        }
+
+    def _spec_summary(self) -> dict:
+        """Speculative-decoding telemetry block for stats()/debug_state:
+        cheap counter reads, no lock requirements (GIL-atomic ints)."""
+        return {
+            "enabled": self.speculative,
+            "draft": self.spec_draft,
+            "steps": self.spec_steps,
+            "proposed": self.spec_proposed,
+            "accepted": self.spec_accepted,
+            "plain_lanes": self.spec_plain,
+            "accept_rate": (
+                round(self.spec_accepted / self.spec_proposed, 3)
+                if self.spec_proposed else None
+            ),
         }
 
     def load(self) -> int:
@@ -1443,6 +1647,7 @@ class LLMEngine:
             "app_llm_drain_state",
             "app_llm_brownout_state",
             "app_llm_fairness_debt",
+            "app_llm_spec_accept_rate",
         ):
             self.metrics.set_gauge(name, 0.0, model=self.label)
 
@@ -1574,6 +1779,14 @@ class LLMEngine:
                         self.params, cache, tail, active, temps,
                         pack, smeta, zero_rng,
                     )
+            if self._verify_op is not None:
+                # speculative verify program: one full-batch executable,
+                # chained through the donated cache/tail like the rest.
+                # All-unselected pack: no lane writes, state unchanged.
+                vpack = jnp.zeros((self.slots, self.spec_draft + 2), jnp.int32)
+                _ys, _acc, cache, tail, _ = self._verify_op(
+                    self.params, cache, tail, temps, vpack, zero_rng,
+                )
             for op in self._chunk_ops.values():
                 toks, last, cache, _ = op(
                     self.params, tail, cache, active, temps, zero_rng,
@@ -1589,6 +1802,8 @@ class LLMEngine:
             n_tasks = 1 + n_step_tasks
         else:
             n_tasks = len(self.prefill_buckets) * len(nbs) + 1
+        if self._verify_op is not None:
+            n_tasks += 1  # the speculative verify program (either scheduler)
         if self._hit_first_op is not None:
             n_tasks += len(nbs)
         # Sharded programs on the CPU backend (8-virtual-device test mesh)
@@ -1667,6 +1882,17 @@ class LLMEngine:
                 # dispatched
                 for slot, r in e[2]:
                     if r is not None and r is self._slot_req[slot]:
+                        steps[slot] = steps.get(slot, 0) + 1
+                continue
+            if e[0] == "verify":
+                # a verify's yield is data-dependent (1..draft+1 tokens);
+                # count the GUARANTEED minimum of one — overcounting
+                # could virtually free a slot on tokens that never
+                # arrive, stranding the request without an end-of-stream.
+                # The 1-token floor also keeps the slot ineligible for
+                # another verify until this one is fetched.
+                for slot, r in e[3]:
+                    if r is self._slot_req[slot]:
                         steps[slot] = steps.get(slot, 0) + 1
                 continue
             if e[0] == "step":
@@ -1894,6 +2120,8 @@ class LLMEngine:
                     for i, rr in enumerate(e[4]):
                         if rr is r:
                             e[4][i] = None
+            elif e[0] == "verify":
+                e[3][:] = [t for t in e[3] if t[1] is not r]
             else:
                 for i, rr in enumerate(e[2]):
                     if rr is r:
@@ -1912,6 +2140,8 @@ class LLMEngine:
         r.prefill_done = False
         r._rows_hi = 0
         r._prefill_t0 = None
+        r._spec_pending = []
+        r._spec_inflight = 0
         r.phase = "queued"
         r.preempted += 1
         # fresh wait epoch, mirroring failover's path through submit():
@@ -2811,9 +3041,183 @@ class LLMEngine:
             self._work_cv.notify()
             return True
 
+    def _spec_drafts(self, r: GenRequest) -> tuple[list[int], list[int]]:
+        """(draft, predicted emitted span) for one decoding slot: draft
+        length adapts to the request's acceptance EMA
+        (gofr_tpu.spec.draft_len — backed-off requests run plain decode
+        with a periodic 1-token probe), capped at the tokens the request
+        can still emit; proposals come from the n-gram drafter over the
+        OPTIMISTIC stream — prompt + emitted history + the predicted
+        spans of verifies still in flight — which is what lets verify
+        steps pipeline to `lookahead` depth instead of exposing a full
+        dispatch->fetch round trip per step. The predicted span
+        (draft + one predicted bonus token) is what the verify will emit
+        if everything is accepted; a misprediction only mis-aims LATER
+        drafts (they get rejected), never the emitted stream. Call with
+        the lock held."""
+        from .spec import draft_len
+
+        emitted_opt = r.emitted + len(r._spec_pending)
+        kmax = min(self.spec_draft, r.max_new_tokens - emitted_opt - 1)
+        k = draft_len(r._spec_ema, kmax, r._spec_plain)
+        if k <= 0:
+            r._spec_plain += 1
+            last = (
+                r._spec_pending[-1] if r._spec_pending
+                else r.history[-1] if r.history
+                else r.prompt_tokens[-1] if r.prompt_tokens else 0
+            )
+            return [], [last]
+        # ONE drafter call for k+1 tokens: the first k are the draft,
+        # the overhang predicts the bonus token for the optimistic
+        # pending stream — a second full-stream scan just to aim one
+        # token would double the per-slot host cost on the scheduler
+        # thread (the drafter's byte-scan design exists to keep this
+        # cheap)
+        stream = r.prompt_tokens + r.history + r._spec_pending
+        d_full = self.drafter.draft(stream, k + 1)
+        d = d_full[:k]
+        if not d:
+            r._spec_plain += 1
+            return [], [stream[-1] if stream else 0]
+        bonus = d_full[k : k + 1] or d[-1:]
+        return d, d + bonus
+
+    def _dispatch_verify(self) -> bool:
+        """Dispatch one fused speculative verify step (gofr_tpu.spec):
+        every decoding slot whose in-flight coverage is verify-only gets
+        its draft packed into one full-batch llm.step_v program; lanes
+        whose drafter proposed nothing ride as draft-0 plain decode, so
+        speculation never splits the batch. Verifies PIPELINE to
+        `lookahead` depth: the program chains tail/cursor from device
+        state, so a verify dispatched before its predecessor's fetch is
+        still an exact continuation — only its drafts (aimed by the
+        optimistic pending stream) can go stale, costing acceptance,
+        never correctness. Selected lanes charge W = draft+1 tokens each
+        against the step token budget (floored at one lane — the budget
+        bounds the step, it is not a stall gate). Returns False when no
+        slot was eligible OR nothing was drafted anywhere — the caller
+        then runs the plain chunk pipeline, which is the adaptive
+        backoff's no-regression guarantee at engine scope."""
+        jnp = self._jnp
+        self._fault("device_step")
+        with self._work_cv:
+            steps = self._inflight_steps()
+            # verify-only coverage per slot: a slot whose ENTIRE in-flight
+            # coverage is verify entries may pipeline another verify (its
+            # optimistic pending stream tracks those); any chunk/step
+            # coverage means un-predicted tokens are coming — wait for
+            # the fetch
+            ver_cover: dict[int, int] = {}
+            entries = list(self._inflight)
+            if self._processing is not None:
+                entries.append(self._processing)
+            for e in entries:
+                if e[0] == "verify":
+                    for slot, r in e[3]:
+                        if r is self._slot_req[slot]:
+                            ver_cover[slot] = ver_cover.get(slot, 0) + 1
+            Kd = self.spec_draft
+            W = Kd + 1
+            budget = self.step_token_budget or self.slots * W
+            pack = np.zeros((self.slots, Kd + 2), np.int32)
+            sel: list[tuple[int, GenRequest]] = []
+            proposed = 0
+            cursors: dict[int, int] = {}
+            n_draft: dict[int, int] = {}
+            pred: dict[int, list[int]] = {}
+            # Rotated scan: when the step budget cuts the selection short,
+            # the next dispatch starts where this one stopped — without
+            # the rotation, slots past floor(budget/W) would NEVER be
+            # selected (and the chunk pipeline is blocked while verifies
+            # fly), starving their requests under sustained admissions
+            # into the low slots.
+            start = self._spec_rr % self.slots
+            cut: int | None = None
+            for slot in (
+                list(range(start, self.slots)) + list(range(0, start))
+            ):
+                r = self._slot_req[slot]
+                if (
+                    r is None
+                    or not r.prefill_done
+                    or r.cancelled
+                    or r.finish_reason is not None
+                    or steps.get(slot, 0) != ver_cover.get(slot, 0)
+                    or r.emitted + len(r._spec_pending) >= r.max_new_tokens
+                ):
+                    continue
+                if sel and (len(sel) + 1) * W > budget:
+                    cut = slot
+                    break
+                d, p = self._spec_drafts(r)
+                pack[slot, : len(d)] = d
+                pack[slot, Kd] = len(d)
+                pack[slot, Kd + 1] = 1
+                sel.append((slot, r))
+                proposed += len(d)
+                n_draft[slot] = len(d)
+                pred[slot] = p
+                cursors[slot] = (
+                    len(r.prompt_tokens) + r.emitted + len(r._spec_pending)
+                )
+            if not sel or not proposed:
+                # nothing drafted anywhere: plain decode through the
+                # chunk pipeline is strictly better (chained dispatches
+                # hide the fetch RTT a 1-wide verify would expose) — the
+                # scheduler falls back to _dispatch for this pass
+                return False
+            if cut is not None:
+                self._spec_rr = cut  # resume the budget-cut scan here
+            for slot, r in sel:
+                r._spec_pending = r._spec_pending + pred[slot]
+                r._spec_inflight += 1
+                if not n_draft[slot]:
+                    self.spec_plain += 1
+            t0 = time.perf_counter()
+            with self._hb_dispatch.beat("dispatch:verify"):
+                ys, acc, cache, tail, self._rng = self._verify_op(
+                    self.params, self.cache, self._tail, self._temps,
+                    jnp.asarray(pack), self._rng,
+                )
+            self.cache, self._tail = cache, tail
+            self._start_fetch(ys)
+            self._start_fetch(acc)
+            step_tokens = W * len(sel)
+            info = {
+                "t0": t0, "W": W, "proposed": proposed,
+                "n_draft": n_draft, "cursors": cursors, "pred": pred,
+            }
+            self._inflight.append(("verify", ys, acc, sel, info))
+            self.spec_steps += 1
+            self.spec_proposed += proposed
+            self._stat_steps += 1
+            self._stat_step_tokens += step_tokens
+            if self.metrics is not None:
+                if proposed:
+                    self.metrics.increment_counter(
+                        "app_llm_spec_proposed_total", by=float(proposed),
+                        model=self.label,
+                    )
+                self.metrics.record_histogram(
+                    "app_llm_step_tokens", float(step_tokens),
+                    model=self.label,
+                )
+                if self.step_token_budget:
+                    self.metrics.set_gauge(
+                        "app_llm_step_budget_utilization",
+                        step_tokens / self.step_token_budget,
+                        model=self.label,
+                    )
+            self._work_cv.notify()
+            return True
+
     def _process_entry(self, entry: tuple) -> None:
         """Fetch one device result (outside the lock — the blocking RTT
         must not stall the scheduler) and emit tokens (under the lock)."""
+        if entry[0] == "verify":
+            self._process_verify_entry(entry)
+            return
         if entry[0] == "step":
             self._process_step_entry(entry)
             return
@@ -3043,6 +3447,121 @@ class LLMEngine:
         if self.logger is not None:
             self._flush_wide_events()
 
+    def _process_verify_entry(self, entry: tuple) -> None:
+        """Fetch and emit one speculative verify step: per selected slot,
+        the accepted draft tokens plus the bonus token (``ys[:acc+1]``)
+        feed the existing emit path as ONE multi-token push — max_new /
+        eos truncation, load_tokens credit, and the fairness ledger all
+        see exactly the emitted count. Acceptance telemetry updates the
+        per-request EMA that sizes the next draft, and MFU bills only
+        the accepted tokens (verified-but-rejected positions are
+        non-useful work — profiling.mfu.spec_verify_flops)."""
+        _, ys_dev, acc_dev, sel, info = entry
+        ys = np.asarray(ys_dev)  # [S, W]
+        acc = np.asarray(acc_dev)  # [S]
+        # numerical watchdog: live lanes scanned BEFORE any emission
+        # (lanes are rows here; the helper scans last-axis columns)
+        ys_t, tripped = self._numeric_check_fetch(
+            ys.T, [slot for slot, _r in sel], "spec verify",
+        )
+        if tripped:
+            return
+        ys = ys_t.T
+        now = time.perf_counter()
+        dt = now - info["t0"]
+        w = self._costs.sliding_window
+        emitted_total = 0
+        accepted_total = 0
+        spans: list[tuple[int, int]] = []
+        ctx_sum = 0
+        for slot, _r in sel:
+            n = int(acc[slot]) + 1
+            emitted_total += n
+            accepted_total += int(acc[slot])
+            cur = info["cursors"].get(slot, 0)
+            spans.append((cur, n))
+            ctx_sum += min(cur, w) if w else cur
+        self.spec_accepted += accepted_total
+        self._observe_tput(emitted_total, dt)
+        self._phases["step"].observe(dt)
+        # per-token cadence the accepted spans actually delivered
+        per_tok = dt / max(1.0, emitted_total / max(1, len(sel)))
+        self._phases["decode_step"].observe(per_tok)
+        self._observe_mfu(
+            "decode",
+            tokens=emitted_total,
+            flops=self._mfu_mod.spec_verify_flops(self._costs, spans),
+            bytes_moved=(
+                self._costs.params_bytes
+                + ctx_sum * self._costs.kv_bytes_per_ctx_token
+            ),
+            dt=dt,
+        )
+        if self.metrics is not None:
+            if accepted_total:
+                self.metrics.increment_counter(
+                    "app_llm_spec_accepted_total",
+                    by=float(accepted_total), model=self.label,
+                )
+            self.metrics.set_gauge(
+                "app_llm_spec_accept_rate",
+                self.spec_accepted / max(1, self.spec_proposed),
+                model=self.label,
+            )
+            self.metrics.record_histogram(
+                "app_llm_step_seconds", dt, model=self.label
+            )
+            wave = 1 << max(0, len(sel) - 1).bit_length() if sel else 0
+            # chunk label "v{W}" marks verify walls: per-token cost here
+            # includes the whole W-wide pass, not a chunk's K serial steps
+            self.metrics.record_histogram(
+                "app_llm_decode_step_seconds", per_tok,
+                model=self.label, chunk=f"v{info['W']}", wave=str(wave),
+                fused="0",
+            )
+        from .spec import SPEC_EMA_ALPHA
+
+        with self._lock:
+            for slot, r in sel:
+                a = int(acc[slot])
+                toks = [int(t) for t in ys[slot, : a + 1]]
+                if self.metrics is not None:
+                    self.metrics.record_histogram(
+                        "app_llm_spec_tokens_per_step", float(len(toks)),
+                        model=self.label,
+                    )
+                if r.span is not None and r.finish_reason is None:
+                    self._phase_span(
+                        r, "llm.decode", info["t0"], now,
+                        attrs={
+                            "llm.spec_draft": info["n_draft"].get(slot, 0),
+                            "llm.spec_accepted": a,
+                            "llm.slot": slot,
+                        },
+                    )
+                nd = info["n_draft"].get(slot, 0)
+                if nd:
+                    r._spec_ema = (
+                        (1 - SPEC_EMA_ALPHA) * r._spec_ema
+                        + SPEC_EMA_ALPHA * (a / nd)
+                    )
+                    r._spec_plain = 0
+                # optimistic-pipeline reconciliation: a fully-correct
+                # prediction pops its span off the pending stream; any
+                # misprediction invalidates the whole remainder (later
+                # in-flight verifies still emit VALID tokens — their
+                # drafts were simply mis-aimed and will be rejected)
+                p = info["pred"].get(slot, [])
+                if toks == p and r._spec_pending[: len(p)] == p:
+                    r._spec_pending = r._spec_pending[len(p):]
+                else:
+                    r._spec_pending = []
+                r._spec_inflight = max(0, r._spec_inflight - 1)
+                self._emit_to(r, slot, toks, now)
+            self._processing = None  # same acquisition as the emits
+        if self.logger is not None:
+            self._flush_wide_events()
+
     def _abort_all(self) -> None:
         jnp = self._jnp
         with self._lock:
@@ -3076,11 +3595,11 @@ class LLMEngine:
                     with self._lock:
                         depth = sum(
                             1 for e in self._inflight
-                            if e[0] in ("chunk", "step")
+                            if e[0] in ("chunk", "step", "verify")
                         )
                         if (
                             self._processing is not None
-                            and self._processing[0] in ("chunk", "step")
+                            and self._processing[0] in ("chunk", "step", "verify")
                         ):
                             depth += 1
                         needed = self._needed_steps()
@@ -3094,13 +3613,57 @@ class LLMEngine:
                         if stepped:
                             depth += 1
                             needed = max(0, needed - self.decode_chunk)
-                    want = min(
-                        -(-needed // self.decode_chunk),
-                        self.lookahead - depth,
-                    )
-                    for _ in range(max(0, want)):
-                        needed = max(0, needed - self._dispatch(needed))
-                    if not did and not stepped and want <= 0:
+                    did_v = False
+                    chunk_ok = True
+                    if self.speculative:
+                        # Speculative regime policy: decode advances
+                        # through fused verify steps whenever anything
+                        # drafts (verifies pipeline to lookahead depth —
+                        # see _dispatch_verify). When a CLEAN-pipe
+                        # drafting attempt yields nothing — cold slots,
+                        # or every request backed off — the engine buys a
+                        # bounded burst of plain chunks (_spec_hold), the
+                        # chunk pipeline hiding the fetch RTT a 1-wide
+                        # verify would expose; at the end of the burst
+                        # the pipe drains and speculation re-probes, so a
+                        # stream whose tail turns repetitive recovers.
+                        # Chunks and verifies never interleave: a chunk
+                        # advances EVERY device-active slot from the
+                        # on-device tail and would double-advance a
+                        # verify's slots.
+                        with self._lock:
+                            inflight_kinds = {
+                                e[0] for e in self._inflight
+                            }
+                            if self._processing is not None:
+                                inflight_kinds.add(self._processing[0])
+                            ver_fly = "verify" in inflight_kinds
+                            dec_fly = bool(
+                                inflight_kinds & {"chunk", "step", "verify"}
+                            )
+                        if (
+                            not stepped and depth < self.lookahead
+                            and self._spec_hold <= 0
+                        ):
+                            did_v = self._dispatch_verify()
+                            if not did_v and not dec_fly:
+                                # clean attempt, nothing drafted: plain
+                                # decode burst before the next probe
+                                self._spec_hold = self._SPEC_REPROBE_CHUNKS
+                        chunk_ok = (
+                            not ver_fly and not did_v and self._spec_hold > 0
+                        )
+                    want = 0
+                    if chunk_ok:
+                        want = min(
+                            -(-needed // self.decode_chunk),
+                            self.lookahead - depth,
+                        )
+                        for _ in range(max(0, want)):
+                            needed = max(0, needed - self._dispatch(needed))
+                            if self.speculative:
+                                self._spec_hold -= 1
+                    if not did and not stepped and not did_v and want <= 0:
                         self._kick.wait(timeout=0.005)
                         self._kick.clear()
                 except Exception as e:  # noqa: BLE001 — engine must not die silently
@@ -3313,7 +3876,7 @@ class LLMEngine:
                     self._jumped = True
                 else:
                     entry = self._inflight.popleft()
-                    if entry[0] == "chunk" or (
+                    if entry[0] in ("chunk", "verify") or (
                         entry[0] == "step" and entry[5]
                     ):
                         self._jumped = False
@@ -3368,6 +3931,8 @@ class LLMEngine:
         """Requests carried by an in-flight entry (all entry kinds)."""
         if entry[0] == "prefill":
             return [r for _, r in entry[2] if r is not None]
+        if entry[0] == "verify":
+            return [r for _s, r in entry[3]]
         if entry[0] == "step":
             out = [r for _j, _s, r in entry[2]]
             if entry[4] is not None:
@@ -3397,6 +3962,13 @@ class LLMEngine:
                 return
             cover: dict = {}
             for e in self._inflight:
+                if e[0] == "verify":
+                    # mirror _inflight_steps' guaranteed-minimum: a verify
+                    # covers at least the bonus token per selected slot
+                    for r in self._entry_requests(e):
+                        if r in lost:
+                            cover[r] = cover.get(r, 0) + 1
+                    continue
                 if e[0] == "step":
                     # mirror _inflight_steps (finishes and snapshot
                     # iterated SEPARATELY — a finishing row appears in
@@ -3996,6 +4568,18 @@ class ReplicatedLLMEngine:
             "fairness": (
                 self.ledger.snapshot() if self.ledger is not None else None
             ),
+            # fleet speculative-decoding totals (per-replica in per_replica)
+            "spec": {
+                "enabled": any(
+                    (s.get("spec") or {}).get("enabled") for s in per
+                ),
+                "proposed": sum(
+                    (s.get("spec") or {}).get("proposed", 0) for s in per
+                ),
+                "accepted": sum(
+                    (s.get("spec") or {}).get("accepted", 0) for s in per
+                ),
+            },
             "slots": sum(s["slots"] for s in per),
             "active": sum(s["active"] for s in per),
             "waiting": sum(s["waiting"] for s in per),

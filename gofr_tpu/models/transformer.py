@@ -577,39 +577,20 @@ def decode_chunk(
     return toks, last, KVCache(k=new_k, v=new_v, length=new_len), rng
 
 
-def prefill_append(
+def _append_forward(
     params: dict,
     cfg: TransformerConfig,
-    tokens: jnp.ndarray,  # [b, c] — one prefill chunk per sequence
+    tokens: jnp.ndarray,  # [b, c]
     cache: KVCache,  # [L, b, capacity, hkv, hd] slot rows (gathered)
-    cursors: jnp.ndarray,  # [b] int32 — prompt tokens already resident
+    cursors: jnp.ndarray,  # [b] int32 — tokens already resident
     n_new: jnp.ndarray,  # [b] int32 — valid tokens in this chunk (<= c)
     *,
-    ring: int = 0,  # >0: cache is a rolling ring of this capacity
-) -> tuple[jnp.ndarray, KVCache]:
-    """Append one prefill chunk into an existing per-slot KV cache.
-
-    The chunked-prefill half of the serving engine's token-budget step
-    (gofr_tpu.llm): instead of prefilling a whole prompt in one
-    bucket-padded wave, prompts advance `n_new` tokens per step through a
-    fixed [b, c] chunk shape. Each layer writes the chunk's K/V rows at
-    the per-sequence cursor (dense: row index = absolute position; ring:
-    position mod capacity) via a masked scatter — indices for i >= n_new
-    are pushed out of bounds and DROPPED, so padding lanes never write —
-    then attends with ops.chunk_prefill_attention (all resident keys +
-    the chunk's causal triangle). Token-equality with the monolithic
-    prefill path holds because every (query, key) pair sees exactly the
-    same dot products and mask set, only batched differently.
-
-    Unlike decode_chunk there is no per-step ring buffer: the whole chunk
-    is one forward pass (c token rows, MXU-bound like prefill), so the
-    scatter amortizes over c tokens and the cache restack through the
-    layer scan costs what the gather already paid.
-
-    Returns (last-valid-token logits [b, vocab] f32, updated cache with
-    length = cursors + n_new). Rows with n_new == 0 return garbage logits
-    (callers only read logits for rows whose prompt just completed).
-    """
+    ring: int = 0,
+) -> tuple[jnp.ndarray, tuple[jnp.ndarray, jnp.ndarray]]:
+    """Shared write-then-attend chunk append (prefill_append and
+    verify_chunk): write the chunk's K/V rows at the per-sequence cursor,
+    attend over all resident keys + the chunk's causal triangle, return
+    the final hidden states [b, c, d] plus the updated (k, v) stacks."""
     b, c = tokens.shape
     hq, hkv, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
     capacity = cache.k.shape[2]
@@ -654,9 +635,90 @@ def prefill_append(
         return x, (kc, vc)
 
     x, (ks, vs) = jax.lax.scan(layer, x, (params["layers"], cache.k, cache.v))
+    return x, (ks, vs)
+
+
+def prefill_append(
+    params: dict,
+    cfg: TransformerConfig,
+    tokens: jnp.ndarray,  # [b, c] — one prefill chunk per sequence
+    cache: KVCache,  # [L, b, capacity, hkv, hd] slot rows (gathered)
+    cursors: jnp.ndarray,  # [b] int32 — prompt tokens already resident
+    n_new: jnp.ndarray,  # [b] int32 — valid tokens in this chunk (<= c)
+    *,
+    ring: int = 0,  # >0: cache is a rolling ring of this capacity
+) -> tuple[jnp.ndarray, KVCache]:
+    """Append one prefill chunk into an existing per-slot KV cache.
+
+    The chunked-prefill half of the serving engine's token-budget step
+    (gofr_tpu.llm): instead of prefilling a whole prompt in one
+    bucket-padded wave, prompts advance `n_new` tokens per step through a
+    fixed [b, c] chunk shape. Each layer writes the chunk's K/V rows at
+    the per-sequence cursor (dense: row index = absolute position; ring:
+    position mod capacity) via a masked scatter — indices for i >= n_new
+    are pushed out of bounds and DROPPED, so padding lanes never write —
+    then attends with ops.chunk_prefill_attention (all resident keys +
+    the chunk's causal triangle). Token-equality with the monolithic
+    prefill path holds because every (query, key) pair sees exactly the
+    same dot products and mask set, only batched differently.
+
+    Unlike decode_chunk there is no per-step ring buffer: the whole chunk
+    is one forward pass (c token rows, MXU-bound like prefill), so the
+    scatter amortizes over c tokens and the cache restack through the
+    layer scan costs what the gather already paid.
+
+    Returns (last-valid-token logits [b, vocab] f32, updated cache with
+    length = cursors + n_new). Rows with n_new == 0 return garbage logits
+    (callers only read logits for rows whose prompt just completed).
+    """
+    b, c = tokens.shape
+    x, (ks, vs) = _append_forward(
+        params, cfg, tokens, cache, cursors, n_new, ring=ring
+    )
     last = jnp.clip(n_new - 1, 0, c - 1)
     x_last = jnp.take_along_axis(x, last[:, None, None].astype(jnp.int32), axis=1)
     logits = _unembed_last(params, cfg, x_last)  # [b, vocab] f32
+    new_cache = KVCache(k=ks, v=vs, length=cursors + n_new)
+    return logits, new_cache
+
+
+def verify_chunk(
+    params: dict,
+    cfg: TransformerConfig,
+    tokens: jnp.ndarray,  # [b, c] — [last accepted token | draft tokens]
+    cache: KVCache,  # [L, b, capacity, hkv, hd] slot rows (gathered)
+    cursors: jnp.ndarray,  # [b] int32 — tokens already resident
+    n_new: jnp.ndarray,  # [b] int32 — valid tokens (1 + drafts; <= c)
+    *,
+    ring: int = 0,  # >0: cache is a rolling ring of this capacity
+) -> tuple[jnp.ndarray, KVCache]:
+    """Score every position of a speculative-decoding draft in ONE
+    forward pass (gofr_tpu.spec; docs/advanced-guide/speculative-decoding.md).
+
+    Identical to prefill_append — the same write-then-attend chunk
+    append against the slot KV, so position i's logits see exactly the
+    keys a sequential decode of tokens[:i+1] would have seen — except
+    ALL c positions are unembedded, not just the last: the engine's
+    verify program samples each position with its regular top-k
+    machinery and accepts the longest prefix agreeing with the draft.
+
+    On rejection the engine rolls the slot cursor back to
+    cursor + accepted + 1; rows written here for rejected draft
+    positions sit ABOVE the rolled-back cursor and are never attended —
+    causally masked on the dense layout, window-masked on the ring
+    (capacity >= window + c guarantees their reconstructed positions
+    land a full lap behind every later query's window) — until the next
+    append overwrites them (ops.chunk_prefill_attention).
+
+    Returns (per-position logits [b, c, vocab] f32, updated cache with
+    length = cursors + n_new — callers roll length back to the accepted
+    count). Positions >= n_new carry garbage logits the engine ignores.
+    """
+    x, (ks, vs) = _append_forward(
+        params, cfg, tokens, cache, cursors, n_new, ring=ring
+    )
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    logits = _unembed(params, cfg, x)  # [b, c, vocab] f32
     new_cache = KVCache(k=ks, v=vs, length=cursors + n_new)
     return logits, new_cache
 

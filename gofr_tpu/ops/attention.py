@@ -540,7 +540,25 @@ def chunk_prefill_attention(
     Dots run at the cache's stored dtype with f32 accumulation (the
     decode_attention convention); on the TPU backend with cleanly tiling
     shapes the dense path lowers to the Pallas flash kernel via
-    q_offsets.
+    q_offsets (chunks narrower than one 8-row sublane tile — the
+    speculative-decoding verify widths, draft + 1 queries — stay on the
+    XLA path: a sub-tile block_q has no MXU-aligned lowering).
+
+    SPECULATIVE-DECODING ROLLBACK CONTRACT (gofr_tpu.spec): the verify
+    path appends draft rows with this same write-then-attend call and,
+    on rejection, rolls the slot cursor back BELOW rows already written.
+    Those stale rows are invisible by construction, on both layouts:
+
+    - dense: stale rows sit at positions > every later query's cursor
+      until overwritten, and the causal mask (p <= cursors + i) hides
+      them — the same property that hides a previous slot occupant's
+      rows above the cursor;
+    - ring: ring_positions reconstructs row j's position as the LAST
+      position congruent to j below the current length, so a stale row
+      reads as one full lap (capacity) behind its true position; with
+      capacity >= window + chunk that reconstructed position is always
+      outside every query's window, and the row is masked until the
+      cursor re-reaches it and overwrites it (write-then-attend order).
     """
     b, c, hq, d = q.shape
     hkv = k_cache.shape[2]
@@ -562,7 +580,11 @@ def chunk_prefill_attention(
         mask = (pos[:, None, :] >= 0) & (pos[:, None, :] <= qpos[:, :, None])
         mask = mask & (pos[:, None, :] > qpos[:, :, None] - window)
     else:
-        if _flash_ok(q, k_cache, min(128, c), 128) and c % min(128, c) == 0:
+        if (
+            _flash_ok(q, k_cache, min(128, c), 128)
+            and c % min(128, c) == 0
+            and c % 8 == 0  # sub-sublane widths (spec verify) stay on XLA
+        ):
             # dense path on TPU: the flash kernel accepts the query block
             # via per-batch offsets (block_q clamped to the chunk length)
             return flash_attention(

@@ -34,6 +34,7 @@ __all__ = [
     "decode_flops",
     "prefill_flops",
     "chunk_prefill_flops",
+    "spec_verify_flops",
     "device_peak_flops",
     "device_hbm_bandwidth",
     "roofline_ratio",
@@ -206,6 +207,42 @@ def chunk_prefill_flops(costs: ModelCosts, spans: list[tuple[int, int]]) -> floa
         total += (
             2 * n * costs.layer_params
             + 2 * costs.embed_params
+            + costs.attn_flops_per_token_per_ctx * attended
+        )
+    return total
+
+
+def spec_verify_flops(costs: ModelCosts, spans: list[tuple[int, int]]) -> float:
+    """USEFUL FLOPs for one speculative-decoding verify step over `spans`
+    of (cursor, n_emitted): the tokens the step actually produced —
+    accepted draft tokens plus the bonus token per lane.
+
+    The useful-work convention, applied to speculation: a verify
+    forward pass computes draft+1 positions per lane but only
+    n_emitted of them advanced the stream, so VERIFIED-BUT-REJECTED
+    positions bill ZERO here — exactly like padding rows in
+    prefill_flops. MFU (useful FLOPs / wall / peak) then reads LOW when
+    acceptance is poor instead of being flattered by throwaway compute,
+    which is the honest signal: a spec engine at 0% acceptance burns
+    the wall of a (draft+1)-wide pass for one token of progress.
+    Per-token accounting matches decode_flops (full matmul stack +
+    unembed per emitted token — every emitted token's position WAS
+    sampled from its own unembed) with position-exact attention per
+    accepted position, the chunk_prefill_flops span convention."""
+    total = 0.0
+    w = costs.sliding_window
+
+    def attended_below(p: int) -> float:
+        if not w or p <= w:
+            return p * (p + 1) / 2
+        return w * (w + 1) / 2 + (p - w) * w
+
+    for cursor, n in spans:
+        if n <= 0:
+            continue
+        attended = attended_below(cursor + n) - attended_below(cursor)
+        total += (
+            n * costs.matmul_flops_per_token
             + costs.attn_flops_per_token_per_ctx * attended
         )
     return total

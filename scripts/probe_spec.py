@@ -1,0 +1,118 @@
+#!/usr/bin/env python
+"""Draft-length sweep for speculative decoding (gofr_tpu.spec).
+
+Measures decode tokens/s and acceptance rate at TPU_LLM_SPEC_DRAFT
+values 0 (spec off, the baseline) through --max-draft, on a
+repetitive-suffix prompt mix and a natural (random-token) mix — the
+probe-style counterpart of bench.py's `speculative` point, for picking
+the draft length on a real chip (scripts/probe_decode* lineage: one
+JSON line per configuration, runnable standalone on CPU or TPU).
+
+Usage:
+  python scripts/probe_spec.py                    # tiny model, CPU ok
+  python scripts/probe_spec.py --model 2b --prefill-len 128  # on TPU
+
+Output: one JSON object per (mix, draft) with tok_s, speedup vs draft 0,
+accept_rate, proposed/accepted, then a `best` summary line per mix.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", choices=("tiny", "2b"), default="tiny")
+    ap.add_argument("--prefill-len", type=int, default=32)
+    ap.add_argument("--new-tokens", type=int, default=48)
+    ap.add_argument("--requests", type=int, default=16)
+    ap.add_argument("--slots", type=int, default=8)
+    ap.add_argument("--decode-chunk", type=int, default=8)
+    ap.add_argument("--max-draft", type=int, default=8)
+    ap.add_argument("--quantize", action="store_true")
+    args = ap.parse_args()
+
+    import jax
+
+    from gofr_tpu.llm import GenRequest, LLMEngine
+    from gofr_tpu.models import TransformerConfig, init_params
+
+    cfg = (
+        TransformerConfig.gemma_2b() if args.model == "2b"
+        else TransformerConfig.tiny()
+    )
+    params = jax.jit(init_params, static_argnums=1)(jax.random.PRNGKey(0), cfg)
+
+    S = args.prefill_len
+    rng = np.random.default_rng(11)
+    pattern = rng.integers(1, cfg.vocab_size, 4).tolist()
+    mixes = {"repetitive": [], "natural": []}
+    for i in range(args.requests):
+        head = np.random.default_rng(1000 + i).integers(
+            1, cfg.vocab_size, size=max(1, S - 24),
+        ).tolist()
+        mixes["repetitive"].append((head + pattern * 6)[-S:])
+        mixes["natural"].append(np.random.default_rng(2000 + i).integers(
+            1, cfg.vocab_size, size=S,
+        ).tolist())
+
+    def run(draft: int, prompts: list[list[int]]) -> tuple[float, dict]:
+        eng = LLMEngine(
+            cfg, params, slots=args.slots,
+            max_seq_len=S + args.new_tokens + 2 * args.decode_chunk + 8,
+            prefill_buckets=(S,), decode_chunk=args.decode_chunk,
+            quantize=args.quantize and jax.default_backend() == "tpu",
+            speculative=draft > 0, spec_draft=draft or None,
+        )
+        try:
+            warm = [eng.submit(GenRequest(list(p), max_new_tokens=4))
+                    for p in prompts[:4]]
+            for r in warm:
+                r.tokens()
+            t0 = time.perf_counter()
+            reqs = [
+                eng.submit(GenRequest(list(p), max_new_tokens=args.new_tokens))
+                for p in prompts
+            ]
+            total = sum(len(r.tokens(timeout=600)) for r in reqs)
+            wall = time.perf_counter() - t0
+            st = eng.stats()["spec"]
+        finally:
+            eng.close()
+        return total / wall, st
+
+    for mix, prompts in mixes.items():
+        base = None
+        best = (0, 0.0)
+        for draft in range(0, args.max_draft + 1):
+            tok_s, st = run(draft, prompts)
+            if draft == 0:
+                base = tok_s
+            if tok_s > best[1]:
+                best = (draft, tok_s)
+            print(json.dumps({
+                "mix": mix, "draft": draft, "tok_s": round(tok_s, 1),
+                "speedup": round(tok_s / max(base, 1e-9), 3),
+                "accept_rate": st["accept_rate"],
+                "proposed": st["proposed"], "accepted": st["accepted"],
+                "plain_lanes": st["plain_lanes"],
+            }), flush=True)
+        print(json.dumps({
+            "mix": mix, "best_draft": best[0],
+            "best_tok_s": round(best[1], 1),
+            "best_speedup": round(best[1] / max(base, 1e-9), 3),
+        }), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

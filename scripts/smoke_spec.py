@@ -1,0 +1,163 @@
+#!/usr/bin/env python
+"""CI smoke: speculative decoding end-to-end over real sockets.
+
+Boots a tiny-model app on the CPU backend with TWO registered engines on
+a 2-replica fleet each — "spec" (speculative decoding on, draft 4) and
+"control" (spec off) — serves the SAME repetitive greedy prompt through
+both HTTP routes, and asserts the speculative contract
+(docs/advanced-guide/speculative-decoding.md):
+
+- the spec response body is byte-identical to the spec-off control body
+  (greedy spec-on == spec-off, over the full HTTP path),
+- acceptance actually happened: app_llm_spec_{proposed,accepted}_total
+  are live and nonzero on /metrics and the accept-rate gauge is sane,
+- the compile registry lists the fused verify program (llm.step_v*) for
+  the spec engine and nothing of the sort for the control engine (the
+  spec-off no-op guarantee).
+
+Usage: JAX_PLATFORMS=cpu python scripts/smoke_spec.py
+Exit codes: 0 clean, non-zero assertion failure (message on stderr).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# two virtual CPU devices for the 2-replica fleets — BEFORE jax import
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=2"
+    ).strip()
+
+
+def main() -> int:
+    import jax
+
+    from gofr_tpu import App
+    from gofr_tpu.config import new_mock_config
+    from gofr_tpu.models import TransformerConfig, init_params
+
+    cfg = TransformerConfig.tiny()
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    assert len(jax.devices()) >= 2, jax.devices()
+    app = App(config=new_mock_config({
+        "APP_NAME": "spec-smoke", "HTTP_PORT": "0", "METRICS_PORT": "0",
+        "LOG_LEVEL": "ERROR", "TPU_TELEMETRY_INTERVAL_S": "0",
+        "REQUEST_TIMEOUT": "60",
+    }))
+    kw = dict(
+        replicas=2, slots=2, max_seq_len=96, prefill_buckets=(8,),
+        prefill_chunk=8, step_token_budget=16, decode_chunk=4,
+        warmup=False,
+    )
+    app.container.tpu().register_llm(
+        "spec", cfg, params, speculative=True, spec_draft=4, **kw
+    )
+    app.container.tpu().register_llm("control", cfg, params, **kw)
+
+    def gen(name):
+        def handler(ctx):
+            body = ctx.bind()
+            out = ctx.tpu().llm(name).generate(
+                list(body["tokens"]),
+                max_new_tokens=int(body.get("max_new_tokens", 16)),
+            )
+            return {"tokens": out}
+
+        return handler
+
+    app.post("/spec", gen("spec"))
+    app.post("/control", gen("control"))
+    app.run_in_background()
+    base = f"http://127.0.0.1:{app.http_server.port}"
+    mbase = f"http://127.0.0.1:{app.metrics_server.port}"
+    try:
+        prompt = ([5, 6, 7, 8] * 6)[:20]  # repetitive: the drafter's case
+
+        def post(route):
+            req = urllib.request.Request(
+                f"{base}/{route}",
+                data=json.dumps(
+                    {"tokens": prompt, "max_new_tokens": 24}
+                ).encode(),
+                headers={"Content-Type": "application/json"}, method="POST",
+            )
+            with urllib.request.urlopen(req, timeout=60) as r:
+                return r.read()
+
+        spec_body = post("spec")
+        control_body = post("control")
+        assert spec_body == control_body, (
+            f"spec body diverged:\n  spec    {spec_body!r}\n"
+            f"  control {control_body!r}"
+        )
+        toks = json.loads(spec_body)["data"]["tokens"]
+        assert len(toks) == 24, toks
+        print(f"byte-identical bodies ({len(spec_body)} bytes, "
+              f"{len(toks)} tokens)")
+
+        # acceptance counters over the real /metrics socket
+        with urllib.request.urlopen(f"{mbase}/metrics", timeout=15) as r:
+            expo = r.read().decode()
+        for name in ("app_llm_spec_proposed_total",
+                     "app_llm_spec_accepted_total",
+                     "app_llm_spec_accept_rate",
+                     "app_llm_spec_tokens_per_step"):
+            assert name in expo, f"{name} missing from /metrics"
+
+        def series_total(name):
+            return sum(
+                float(ln.rsplit(" ", 1)[1])
+                for ln in expo.splitlines()
+                if ln.startswith(name + "{") and "spec/r" in ln
+            )
+
+        proposed = series_total("app_llm_spec_proposed_total")
+        accepted = series_total("app_llm_spec_accepted_total")
+        assert proposed > 0, "no draft tokens proposed"
+        assert 0 < accepted <= proposed, (accepted, proposed)
+        print(f"acceptance counters: proposed={proposed:.0f} "
+              f"accepted={accepted:.0f}")
+        st = app.container.tpu().llm("spec").stats()["spec"]
+        assert st["enabled"] and st["accepted"] > 0, st
+
+        # compile registry: verify program for spec engine only (the
+        # spec-off engine must register no llm.step_v program — the
+        # TPU_LLM_SPEC=0 no-op guarantee)
+        with urllib.request.urlopen(
+            f"{base}/.well-known/debug/compiles", timeout=15
+        ) as r:
+            progs = json.loads(r.read())["data"]["programs"]
+        spec_rows = [
+            e for e in progs
+            if e["program"].startswith("llm.step_v")
+            and e["model"].startswith("spec")
+        ]
+        control_rows = [
+            e for e in progs
+            if e["program"].startswith("llm.step_v")
+            and e["model"].startswith("control")
+        ]
+        assert spec_rows, {e["program"] for e in progs}
+        assert not control_rows, control_rows
+        print(f"compile registry: {len(spec_rows)} verify rows for spec, "
+              "0 for control")
+        print("smoke_spec: OK")
+        return 0
+    finally:
+        app.shutdown()
+
+
+if __name__ == "__main__":
+    rc = main()
+    sys.stdout.flush()
+    sys.stderr.flush()
+    # _exit skips interpreter teardown (see smoke_profiling.py: XLA
+    # destructors intermittently abort after all work completed)
+    os._exit(rc)

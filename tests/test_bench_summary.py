@@ -152,6 +152,31 @@ def test_summary_line_carries_interactive_slo():
     assert "interactive_slo" not in bench._summary_line(_serving_result())
 
 
+def test_summary_line_carries_speculative():
+    """BENCH_r12+: the speculative-decoding point rides the summary as a
+    compact block (repetitive-mix speedup + acceptance rate, natural-mix
+    no-regression speedup)."""
+    r = _serving_result()
+    r["detail"]["speculative"] = {
+        "new_tokens": 64, "requests": 256, "draft": 4,
+        "repetitive": {"base_tok_s": 5600.0, "spec_tok_s": 9100.0,
+                       "speedup": 1.62, "accept_rate": 0.78,
+                       "proposed": 9000, "accepted": 7020,
+                       "plain_lanes": 12},
+        "natural": {"base_tok_s": 5600.0, "spec_tok_s": 5540.0,
+                    "speedup": 0.99, "accept_rate": 0.02,
+                    "proposed": 400, "accepted": 8, "plain_lanes": 9000},
+    }
+    s = bench._summary_line(r)
+    assert s["speculative"] == {
+        "rep_speedup": 1.62, "rep_accept_rate": 0.78,
+        "rep_spec_tok_s": 9100.0, "nat_speedup": 0.99,
+    }
+    assert len(json.dumps(s)) < 1500
+    # absent block (--no-spec / CPU runs) must not leak a key
+    assert "speculative" not in bench._summary_line(_serving_result())
+
+
 def test_phase_breakdown_from_histogram_deltas():
     """p50/p99 come from the count DELTAS between two snapshots, so the
     SLO window is attributed without the warmup/probe traffic that also

@@ -1,0 +1,564 @@
+"""Speculative decoding: n-gram drafter + fused on-device verification.
+
+The load-bearing invariant mirrors the chunked-prefill suite's: speculation
+is a SCHEDULING/verification change, never a model change — a spec-on
+engine must emit exactly the tokens the spec-off engine emits for greedy
+decodes, across dense KV, rolling-window KV, prefix-cache hits,
+chunked-prefill admission, and every draft length; temperature > 0 must
+preserve the output distribution (Leviathan rejection sampling for the
+deterministic drafter). Rollback must leave no attendable stale KV row,
+adaptive backoff must degrade adversarial inputs to plain decode, and the
+accounting surfaces (load_tokens, FairLedger, metrics) must be identical
+spec-on vs spec-off (docs/advanced-guide/speculative-decoding.md).
+"""
+
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from gofr_tpu.llm import GenRequest, LLMEngine
+from gofr_tpu.models import TransformerConfig, init_params
+from gofr_tpu.spec import (
+    SPEC_BACKOFF_EMA,
+    SPEC_PROBE_EVERY,
+    NGramDrafter,
+    accept_length,
+    draft_len,
+)
+
+CFG = TransformerConfig.tiny()
+CFGW = TransformerConfig.tiny_mistral()  # sliding window 8
+
+REPETITIVE = ([5, 6, 7, 8] * 8)[:20]
+NATURAL = list(range(1, 21))
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(jax.random.PRNGKey(0), CFG)
+
+
+@pytest.fixture(scope="module")
+def params_w():
+    return init_params(jax.random.PRNGKey(3), CFGW)
+
+
+def _engine(params, cfg=CFG, **kw):
+    kw.setdefault("slots", 2)
+    kw.setdefault("max_seq_len", 96)
+    kw.setdefault("prefill_buckets", (8,))
+    kw.setdefault("prefill_chunk", 8)
+    kw.setdefault("step_token_budget", 16)
+    kw.setdefault("decode_chunk", 4)
+    kw.setdefault("warmup", False)
+    return LLMEngine(cfg, params, **kw)
+
+
+# ---------------------------------------------------------------------------
+# Unit: drafter, acceptance rule, adaptive length
+# ---------------------------------------------------------------------------
+
+
+class TestNGramDrafter:
+    def test_proposes_continuation_of_trailing_ngram(self):
+        d = NGramDrafter()
+        # ... 1 2 3 9 9 | 1 2 3 -> continuation after the earlier "1 2 3"
+        assert d.draft([1, 2, 3, 9, 9, 1, 2, 3], 2) == [9, 9]
+
+    def test_most_recent_occurrence_wins(self):
+        d = NGramDrafter()
+        # "7 1" appears twice; the later one continues with 5, not 4
+        assert d.draft([7, 1, 4, 7, 1, 5, 8, 7, 1], 1) == [5]
+
+    def test_longer_ngram_preferred(self):
+        d = NGramDrafter(max_ngram=2)
+        # 2-gram "2 3" matches (-> 8); the 1-gram "3" alone would hit the
+        # more recent "3 -> 9" — the longer context must win
+        toks = [2, 3, 8, 0, 3, 9, 0, 2, 3]
+        assert d.draft(toks, 1) == [8]
+
+    def test_self_extension_of_repeating_pattern(self):
+        d = NGramDrafter()
+        # continuation truncates at the sequence end…
+        assert d.draft([5, 6, 5, 6, 5, 6], 3) == [5, 6]
+        # …and a longer history yields the full k
+        assert d.draft([5, 6] * 4, 3) == [5, 6, 5]
+
+    def test_no_match_returns_empty(self):
+        d = NGramDrafter()
+        assert d.draft([1, 2, 3, 4, 5], 4) == []
+        assert d.draft([], 4) == []
+        assert d.draft([1], 4) == []
+
+    def test_k_caps_proposal_length(self):
+        d = NGramDrafter()
+        assert d.draft([1, 2, 9, 9, 9, 9, 1, 2], 2) == [9, 9]
+        assert d.draft([1, 2, 9, 9, 9, 9, 1, 2], 0) == []
+
+    def test_unaligned_byte_match_rejected(self):
+        """0x01000000 followed by 0x00000001 contains the little-endian
+        byte image of 257 at an UNALIGNED offset — a naive byte scan
+        would 'match' across token boundaries and propose garbage."""
+        d = NGramDrafter(max_ngram=1)
+        assert d.draft([16777216, 1, 999, 257], 2) == []
+
+    def test_aligned_match_beyond_unaligned_decoy(self):
+        # a real aligned occurrence EARLIER than an unaligned decoy must
+        # still be found (the re-search walks below the false hit)
+        d = NGramDrafter(max_ngram=1)
+        assert d.draft([257, 42, 16777216, 1, 999, 257], 2) == [42, 16777216]
+
+
+class TestAcceptance:
+    @pytest.mark.parametrize("draft,sampled,want", [
+        ([], [9], 0),
+        ([4], [4, 7], 1),
+        ([4], [5, 7], 0),
+        ([4, 5, 6], [4, 5, 6, 1], 3),
+        ([4, 5, 6], [4, 9, 6, 1], 1),
+        ([4, 5], [4, 5], 2),
+    ])
+    def test_longest_agreeing_prefix(self, draft, sampled, want):
+        assert accept_length(draft, sampled) == want
+
+    def test_draft_len_scales_with_ema(self):
+        assert draft_len(1.0, 4, 0) == 4
+        assert draft_len(0.5, 4, 0) == 2
+        assert draft_len(0.25, 4, 0) == 1
+        assert draft_len(1.0, 0, 0) == 0
+
+    def test_draft_len_backoff_and_probe(self):
+        low = SPEC_BACKOFF_EMA / 2
+        assert draft_len(low, 4, 0) == 0
+        assert draft_len(low, 4, SPEC_PROBE_EVERY - 1) == 0
+        assert draft_len(low, 4, SPEC_PROBE_EVERY) == 1  # periodic re-probe
+
+
+# ---------------------------------------------------------------------------
+# Engine: greedy token equality spec-on vs spec-off
+# ---------------------------------------------------------------------------
+
+
+class TestGreedyEquality:
+    @pytest.mark.parametrize("spec_draft", [1, 2, 4, 5])
+    def test_dense_chunked(self, params, spec_draft):
+        base = _engine(params)
+        want = [base.generate(p, max_new_tokens=12)
+                for p in (REPETITIVE, NATURAL)]
+        base.close()
+        eng = _engine(params, speculative=True, spec_draft=spec_draft)
+        try:
+            got = [eng.generate(p, max_new_tokens=12)
+                   for p in (REPETITIVE, NATURAL)]
+            st = eng.stats()["spec"]
+        finally:
+            eng.close()
+        assert got == want
+        assert st["enabled"] and st["steps"] > 0
+
+    def test_wave_scheduler(self, params):
+        base = _engine(params, step_token_budget=0)
+        want = base.generate(REPETITIVE, max_new_tokens=12)
+        base.close()
+        eng = _engine(params, step_token_budget=0,
+                      speculative=True, spec_draft=4)
+        try:
+            assert eng.generate(REPETITIVE, max_new_tokens=12) == want
+        finally:
+            eng.close()
+
+    def test_rolling_ring(self, params_w):
+        """Rolling layout: verify appends + rollbacks wrap mod capacity;
+        max_new larger than the window forces ring laps."""
+        base = _engine(params_w, cfg=CFGW, kv_window=8)
+        want = [base.generate(p, max_new_tokens=24)
+                for p in (REPETITIVE, NATURAL)]
+        base.close()
+        eng = _engine(params_w, cfg=CFGW, kv_window=8,
+                      speculative=True, spec_draft=4)
+        try:
+            got = [eng.generate(p, max_new_tokens=24)
+                   for p in (REPETITIVE, NATURAL)]
+        finally:
+            eng.close()
+        assert got == want
+
+    def test_prefix_hit_slots(self, params):
+        """A prefix-cache exact hit seeds the slot from retained KV and
+        re-sampled first tokens; speculative decode after a hit must
+        still be token-identical (and the hit must actually occur)."""
+        base = _engine(params, prefix_cache_mb=4)
+        want = base.generate(REPETITIVE, max_new_tokens=12)
+        assert base.generate(REPETITIVE, max_new_tokens=12) == want
+        base.close()
+        eng = _engine(params, prefix_cache_mb=4,
+                      speculative=True, spec_draft=4)
+        try:
+            assert eng.generate(REPETITIVE, max_new_tokens=12) == want
+            assert eng.generate(REPETITIVE, max_new_tokens=12) == want
+            assert eng.kv.prefix.hits >= 1
+        finally:
+            eng.close()
+
+    @pytest.mark.parametrize("plen", [5, 8, 9, 17])
+    def test_chunk_boundary_prompts(self, params, plen):
+        rng = np.random.default_rng(plen)
+        prompt = rng.integers(1, CFG.vocab_size, plen).tolist()
+        base = _engine(params)
+        want = base.generate(prompt, max_new_tokens=10)
+        base.close()
+        eng = _engine(params, speculative=True, spec_draft=4)
+        try:
+            assert eng.generate(prompt, max_new_tokens=10) == want
+        finally:
+            eng.close()
+
+    def test_concurrent_requests(self, params):
+        """Several slots speculating at once — per-slot drafts, shared
+        full-batch verify program — each stream token-identical."""
+        prompts = [REPETITIVE, NATURAL, [3, 4] * 8, [9] * 12]
+        base = _engine(params, slots=4)
+        want = [base.submit(GenRequest(p, max_new_tokens=10)) for p in prompts]
+        want = [r.tokens() for r in want]
+        base.close()
+        eng = _engine(params, slots=4, speculative=True, spec_draft=4)
+        try:
+            got = [eng.submit(GenRequest(p, max_new_tokens=10)) for p in prompts]
+            got = [r.tokens() for r in got]
+        finally:
+            eng.close()
+        assert got == want
+
+
+# ---------------------------------------------------------------------------
+# Rollback: rejected rows leave no attendable stale KV
+# ---------------------------------------------------------------------------
+
+
+class _WrongDrafter:
+    """Guaranteed-rejected proposals: draft the token one off from the
+    KNOWN greedy continuation at each position — the first draft token
+    always disagrees with the verifier's sample, so every verify writes
+    draft rows that MUST be rolled back (acceptance is exactly 0)."""
+
+    def __init__(self, prompt_len: int, expected: list[int], vocab: int):
+        self.prompt_len = prompt_len
+        self.expected = expected
+        self.vocab = vocab
+
+    def draft(self, tokens: list[int], k: int) -> list[int]:
+        if k <= 0:
+            return []
+        pos = len(tokens) - self.prompt_len  # tokens already emitted
+        nxt = self.expected[pos : pos + k] or self.expected[-1:] * k
+        return [(t + 1) % self.vocab for t in nxt]
+
+
+class TestRollback:
+    def _force_rejections(self, params, cfg, want, **kw):
+        eng = _engine(params, cfg=cfg, speculative=True, spec_draft=4, **kw)
+        try:
+            eng.drafter = _WrongDrafter(len(REPETITIVE), want, cfg.vocab_size)
+            got = eng.generate(REPETITIVE, max_new_tokens=len(want))
+            st = eng.stats()["spec"]
+            # a fresh request decoded AFTER the rollbacks reuses the same
+            # slot rows — stale K/V would corrupt its stream
+            again = eng.generate(NATURAL, max_new_tokens=8)
+        finally:
+            eng.close()
+        return got, again, st
+
+    def test_dense_rollback_token_equal(self, params):
+        base = _engine(params)
+        want = base.generate(REPETITIVE, max_new_tokens=12)
+        want2 = base.generate(NATURAL, max_new_tokens=8)
+        base.close()
+        got, again, st = self._force_rejections(params, CFG, want)
+        assert got == want
+        assert again == want2
+        assert st["proposed"] > 0 and st["accepted"] == 0  # every draft rejected
+
+    def test_ring_rollback_token_equal(self, params_w):
+        base = _engine(params_w, cfg=CFGW, kv_window=8)
+        want = base.generate(REPETITIVE, max_new_tokens=20)
+        want2 = base.generate(NATURAL, max_new_tokens=8)
+        base.close()
+        got, again, st = self._force_rejections(
+            params_w, CFGW, want, kv_window=8
+        )
+        assert got == want
+        assert again == want2
+        assert st["accepted"] == 0
+
+
+# ---------------------------------------------------------------------------
+# Adaptive backoff, budget, preemption, accounting
+# ---------------------------------------------------------------------------
+
+
+class TestAdaptiveAndScheduling:
+    def test_backoff_to_plain_decode(self, params):
+        """0%-acceptance input: the EMA must drive the draft to 0 (plain
+        decode lanes) instead of paying a rejected verify forever."""
+        base = _engine(params)
+        want = base.generate(NATURAL, max_new_tokens=40)
+        base.close()
+        eng = _engine(params, max_seq_len=128, speculative=True, spec_draft=4)
+        try:
+            eng.drafter = _WrongDrafter(len(NATURAL), want, CFG.vocab_size)
+            req = eng.submit(GenRequest(list(NATURAL), max_new_tokens=40))
+            got = req.tokens()
+            st = eng.stats()["spec"]
+        finally:
+            eng.close()
+        assert got == want
+        assert st["accepted"] == 0
+        # EMA decayed below the backoff threshold: later decode ran as
+        # plain chunks (or draft-0 lanes), not rejected verifies
+        assert req._spec_ema < SPEC_BACKOFF_EMA
+        # backoff bounds the waste: far fewer proposals (and verify
+        # steps) than tokens decoded
+        assert st["proposed"] < 40
+        assert st["steps"] < 40
+
+    def test_step_budget_charges_draft_tokens(self, params):
+        """Verify lanes draw W = draft+1 tokens each from the step token
+        budget: a budget of one lane serializes speculating slots but
+        every request still completes token-identically."""
+        prompts = [REPETITIVE, [3, 4] * 8, [9] * 12]
+        base = _engine(params, slots=3)
+        want = [base.submit(GenRequest(p, max_new_tokens=8)) for p in prompts]
+        want = [r.tokens() for r in want]
+        base.close()
+        eng = _engine(params, slots=3, step_token_budget=5,
+                      speculative=True, spec_draft=4)
+        try:
+            st0 = eng.stats()
+            got = [eng.submit(GenRequest(p, max_new_tokens=8)) for p in prompts]
+            got = [r.tokens() for r in got]
+            st = eng.stats()
+        finally:
+            eng.close()
+        assert got == want
+        # draft tokens were charged against the budget (5 per verify lane)
+        verify_steps = st["spec"]["steps"] - st0["spec"]["steps"]
+        assert verify_steps > 0
+        assert st["step_tokens"] >= st0["step_tokens"] + 5 * verify_steps
+
+    def test_budget_rotation_no_slot_starvation(self, params):
+        """A step budget smaller than slots x (draft+1) caps the lanes
+        per verify; the selection must ROTATE across dispatches — scanning
+        from slot 0 every time would starve high slots of all decode
+        (chunks are blocked while verifies fly) for as long as admissions
+        keep refilling the low slots."""
+        import threading
+
+        eng = _engine(params, slots=2, step_token_budget=5, max_seq_len=128,
+                      speculative=True, spec_draft=4)
+        done: list[str] = []
+        lock = threading.Lock()
+
+        def consume(r, name):
+            r.tokens(timeout=60)
+            with lock:
+                done.append(name)
+
+        try:
+            first = eng.submit(GenRequest(list(REPETITIVE), max_new_tokens=4))
+            long_req = eng.submit(GenRequest(
+                ([5, 6, 7, 8] * 8)[:24], max_new_tokens=24,
+            ))
+            shorts = [
+                eng.submit(GenRequest(list(REPETITIVE), max_new_tokens=4))
+                for _ in range(8)
+            ]
+            threads = [
+                threading.Thread(target=consume, args=(r, n))
+                for r, n in [(first, "s0"), (long_req, "long")]
+                + [(s, f"s{i + 1}") for i, s in enumerate(shorts)]
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=60)
+            assert all(not t.is_alive() for t in threads), done
+        finally:
+            eng.close()
+        # the long request (high slot) must interleave with the short
+        # stream refilling the low slot, not drain after ALL of it
+        assert "long" in done
+        assert done.index("long") < len(done) - 1, done
+
+    def test_preemption_mid_verify_token_identical(self, params):
+        """An interactive arrival preempts a speculating batch request;
+        the continuation (re-prefill + resumed verify) must stream the
+        exact uncontended tokens — no duplicate, no gap, no stale-row
+        corruption."""
+        base = _engine(params, max_seq_len=160)
+        want = base.generate(REPETITIVE, max_new_tokens=24)
+        base.close()
+        eng = _engine(params, slots=1, max_seq_len=160,
+                      speculative=True, spec_draft=4, preemption=True)
+        try:
+            batch = eng.submit(GenRequest(
+                list(REPETITIVE), max_new_tokens=24, priority="batch",
+            ))
+            # let the batch request slot in and start verifying
+            deadline = time.time() + 5
+            while batch.emitted < 4 and time.time() < deadline:
+                time.sleep(0.005)
+            inter = eng.submit(GenRequest(
+                list(NATURAL), max_new_tokens=4, priority="interactive",
+            ))
+            assert len(inter.tokens()) == 4
+            got = batch.tokens()
+            assert batch.preempted >= 1
+        finally:
+            eng.close()
+        assert got == want
+
+    def test_load_tokens_and_ledger_parity(self, params):
+        """Fleet routing + VTC fairness must see identical totals spec-on
+        vs spec-off: multi-token accepted spans credit exactly the
+        emitted count (the load_tokens fix this PR pins)."""
+        from gofr_tpu.resilience import FairLedger
+
+        def run(spec: bool):
+            led = FairLedger()
+            eng = _engine(params, speculative=spec, spec_draft=4,
+                          fair_queuing=True, fair_ledger=led)
+            try:
+                reqs = [
+                    eng.submit(GenRequest(
+                        list(p), max_new_tokens=10, client=c,
+                    ))
+                    for p, c in ((REPETITIVE, "a"), (NATURAL, "b"))
+                ]
+                toks = [r.tokens() for r in reqs]
+                load_after = eng.load_tokens()
+            finally:
+                eng.close()
+            return toks, load_after, led.snapshot()["counters"]
+
+        toks_off, load_off, led_off = run(False)
+        toks_on, load_on, led_on = run(True)
+        assert toks_on == toks_off
+        assert load_off == 0 and load_on == 0  # fully credited back
+        assert led_on == led_off  # identical weighted-served totals
+
+    def test_failover_continuation_load_acct(self, params):
+        """The submit()-side accounting fix: a continuation re-submitted
+        with emitted > 0 bills prompt + REMAINING decode, not prompt +
+        max_new (the spec multi-token spans make the old overcount
+        material)."""
+        eng = _engine(params)
+        try:
+            r = GenRequest(list(NATURAL), max_new_tokens=20)
+            r.emitted = 12  # as a failover continuation would carry
+            eng.submit(r)
+            assert r._load_acct == len(NATURAL) + 8
+            r.tokens()
+        finally:
+            eng.close()
+
+
+# ---------------------------------------------------------------------------
+# Temperature: distribution preserved (statistical sanity)
+# ---------------------------------------------------------------------------
+
+
+class TestTemperature:
+    def test_distribution_matches_spec_off(self):
+        """Fixed-seed statistical check on a tiny vocab: pooled token
+        frequencies of spec-on and spec-off sampling at temperature 1.0
+        agree within a loose total-variation bound. Not a bit-exact
+        check — speculation consumes randomness differently — but a
+        distribution-level one, which is the Leviathan guarantee."""
+        cfg = TransformerConfig.tiny(vocab_size=32)
+        params = init_params(jax.random.PRNGKey(1), cfg)
+        prompt = ([3, 4, 5] * 5)[:12]
+        n_req, n_tok = 64, 4
+
+        def harvest(spec: bool):
+            eng = _engine(params, cfg=cfg, slots=4,
+                          speculative=spec, spec_draft=3)
+            counts = np.zeros(cfg.vocab_size)
+            try:
+                reqs = [
+                    eng.submit(GenRequest(
+                        list(prompt), max_new_tokens=n_tok, temperature=1.0,
+                    ))
+                    for _ in range(n_req)
+                ]
+                for r in reqs:
+                    for t in r.tokens():
+                        counts[t] += 1
+            finally:
+                eng.close()
+            return counts / counts.sum()
+
+        p_off = harvest(False)
+        p_on = harvest(True)
+        tv = 0.5 * np.abs(p_off - p_on).sum()
+        assert tv < 0.25, f"total variation {tv:.3f} (spec-on vs spec-off)"
+
+
+# ---------------------------------------------------------------------------
+# No-op guarantee and observability
+# ---------------------------------------------------------------------------
+
+
+class TestNoOpAndObservability:
+    def test_spec_off_registers_no_program(self, params):
+        eng = _engine(params, warmup=True)
+        try:
+            assert eng._verify_op is None and eng.drafter is None
+            progs = {
+                p["program"]
+                for p in eng._registry.snapshot(model=eng.label)["programs"]
+            }
+            assert not any(p.startswith("llm.step_v") for p in progs), progs
+            assert eng.stats()["spec"]["enabled"] is False
+        finally:
+            eng.close()
+
+    def test_spec_metrics_and_close_zeroes_gauge(self, params):
+        from gofr_tpu.metrics import new_metrics_manager
+
+        metrics = new_metrics_manager()
+        eng = _engine(params, speculative=True, spec_draft=4,
+                      metrics=metrics, kv_label="specmetrics")
+        toks = eng.generate(list(REPETITIVE), max_new_tokens=12)
+        assert len(toks) == 12
+        st = eng.stats()["spec"]
+        assert st["proposed"] >= st["accepted"] >= 0
+        assert st["steps"] > 0
+        expo = metrics.render_prometheus()
+        assert "app_llm_spec_proposed_total" in expo
+        assert "app_llm_spec_tokens_per_step" in expo
+        rate = [
+            ln for ln in expo.splitlines()
+            if ln.startswith("app_llm_spec_accept_rate{")
+            and "specmetrics" in ln
+        ]
+        assert rate and 0.0 <= float(rate[0].rsplit(" ", 1)[1]) <= 1.0
+        eng.close()
+        expo = metrics.render_prometheus()
+        rate = [
+            ln for ln in expo.splitlines()
+            if ln.startswith("app_llm_spec_accept_rate{")
+            and "specmetrics" in ln
+        ]
+        # PR 3's dead-engine gauge regression class: zeroed at close()
+        assert rate and float(rate[0].rsplit(" ", 1)[1]) == 0.0
+
+    def test_debug_state_reports_spec(self, params):
+        eng = _engine(params, speculative=True, spec_draft=2)
+        try:
+            eng.generate(list(REPETITIVE), max_new_tokens=6)
+            dbg = eng.debug_state()
+            assert dbg["spec"]["enabled"] and dbg["spec"]["draft"] == 2
+        finally:
+            eng.close()
