@@ -744,6 +744,13 @@ def bench_serving(args) -> dict:
             ceiling_sust_qps,
         )
 
+    # rollout operating point (BENCH_r13+): live weight reload on a
+    # 2-replica fleet under steady load — p99 latency delta during the
+    # shift vs steady state, time-to-fully-shifted, and the zero-error
+    # contract (gofr_tpu.resilience.rollout)
+    if on_tpu and not args.no_rollout:
+        detail["rollout"] = _bench_rollout(args, cfg, params, quantize)
+
     # speculative-decoding operating point (BENCH_r12+): spec-on vs
     # spec-off decode tokens/s on a greedy repetitive-suffix mix (the
     # n-gram drafter's home turf) and a natural-text mix (the adaptive
@@ -975,6 +982,118 @@ def _bench_degraded(args, cfg, params, quantize: bool) -> dict:
             if t_recapacity is not None else None
         ),
         "rebuilt_on": landed if t_recapacity is not None else None,
+        "clients": n_clients,
+        "replicas": 2,
+    }
+
+
+def _bench_rollout(args, cfg, params, quantize: bool) -> dict:
+    """Rollout point: a 2-replica fleet serving steady closed-loop load
+    performs a live weight rollout (deploy -> drain one replica at a
+    time -> canary+shadow gate -> admit -> bake). The numbers that
+    matter are the COST OF THE SHIFT: p99 request latency during the
+    shift vs the pre-shift steady state (capacity runs one replica
+    short while each slot rebuilds), time until the fleet is fully on
+    the new version, and the zero-dropped-requests contract (error
+    count must be 0 — an unshifted run of the same shape would report
+    the same)."""
+    import jax
+
+    from gofr_tpu.llm import GenRequest, ReplicatedLLMEngine
+
+    if len(jax.devices()) < 2:
+        return {"skipped": "needs >=2 devices"}
+    S = args.prefill_len
+    rep = ReplicatedLLMEngine(
+        cfg, params, replicas=2,
+        slots=args.batch,
+        max_seq_len=S + args.new_tokens + 2 * args.decode_chunk,
+        prefill_buckets=(S,), decode_chunk=args.decode_chunk,
+        admit_cap=args.admit_cap, quantize=quantize, supervise=False,
+    )
+    lat_lock = threading.Lock()
+    lats: list[tuple[float, float]] = []  # (finish_t, seconds)
+    errors = 0
+    stop = threading.Event()
+
+    def client(cid: int):
+        nonlocal errors
+        rng = np.random.default_rng(cid)
+        while not stop.is_set():
+            prompt = rng.integers(1, cfg.vocab_size, size=S - 8).tolist()
+            t0 = time.perf_counter()
+            try:
+                req = rep.submit(
+                    GenRequest(prompt, max_new_tokens=args.new_tokens)
+                )
+                ok = len(req.tokens(timeout=600)) == args.new_tokens
+            except Exception:  # noqa: BLE001 — errors ARE the measurement
+                ok = False
+            t1 = time.perf_counter()
+            with lat_lock:
+                if ok:
+                    lats.append((t1, t1 - t0))
+                else:
+                    errors += 1
+
+    n_clients = min(64, args.clients)
+    ts = [threading.Thread(target=client, args=(c,)) for c in range(n_clients)]
+    for t in ts:
+        t.start()
+    t_deploy = t_shifted = None
+    try:
+        time.sleep(3.0)  # steady state on v1
+        # new weights: same shapes, slightly perturbed — the rollout
+        # machinery neither knows nor cares that the delta is tiny
+        v2 = jax.tree.map(lambda x: x * (1.0 + 1e-3), params)
+        t_deploy = time.perf_counter()
+        rep.deploy(cfg, v2, version="v2", bake_s=2.0)
+        deadline = t_deploy + 600.0
+        while time.perf_counter() < deadline:
+            if t_shifted is None and rep.version_counts() == {"v2": 2}:
+                t_shifted = time.perf_counter()
+            if not rep._rollout.active():
+                break
+            time.sleep(0.05)
+        final_state = rep.rollout_state()["state"]
+        time.sleep(2.0)  # post-shift steady state
+    finally:
+        stop.set()
+        for t in ts:
+            t.join(timeout=60)
+    rep.close()
+
+    def p(vals, q):
+        if not vals:
+            return None
+        vals = sorted(vals)
+        return round(
+            vals[min(len(vals) - 1, int(q * len(vals)))] * 1e3, 1
+        )
+
+    with lat_lock:
+        before = [s for t1, s in lats if t_deploy and t1 <= t_deploy]
+        during = [
+            s for t1, s in lats
+            if t_deploy and t1 > t_deploy
+            and (t_shifted is None or t1 <= t_shifted)
+        ]
+    p99_before = p(before, 0.99)
+    p99_during = p(during, 0.99)
+    return {
+        "state": final_state,
+        "requests": len(lats) + errors,
+        "errors": errors,  # the zero-dropped-requests contract
+        "time_to_fully_shifted_s": (
+            round(t_shifted - t_deploy, 2)
+            if t_shifted is not None and t_deploy is not None else None
+        ),
+        "p99_before_ms": p99_before,
+        "p99_during_shift_ms": p99_during,
+        "p99_shift_delta": (
+            round(p99_during / p99_before, 2)
+            if p99_before and p99_during else None
+        ),
         "clients": n_clients,
         "replicas": 2,
     }
@@ -1535,6 +1654,9 @@ def main() -> None:
     ap.add_argument("--no-degraded", action="store_true",
                     help="skip the degraded-operation point (replica kill "
                          "mid-run; needs >=2 devices)")
+    ap.add_argument("--no-rollout", action="store_true",
+                    help="skip the live weight-rollout point (2-replica "
+                         "shift under load; needs >=2 devices)")
     ap.add_argument("--no-overload", action="store_true",
                     help="skip the overload point (2x offered load, fair "
                          "queuing + shed telemetry)")
@@ -1669,6 +1791,14 @@ def _summary_line(result: dict) -> dict:
             "ttft_batch_p99_ms": ov.get("ttft_batch_p99_ms"),
             "jain_fairness": ov.get("jain_fairness"),
             "preemptions": ov.get("preemptions"),
+        }
+    if d.get("rollout") and not d["rollout"].get("skipped"):
+        ro = d["rollout"]  # BENCH_r13+: live weight reload under load
+        s["rollout"] = {
+            "state": ro.get("state"),
+            "errors": ro.get("errors"),
+            "time_to_fully_shifted_s": ro.get("time_to_fully_shifted_s"),
+            "p99_shift_delta": ro.get("p99_shift_delta"),
         }
     if d.get("subruns"):
         s["greet_qps"] = d["subruns"].get("greet_qps_cpu")

@@ -27,6 +27,8 @@ from .handler import (
     favicon_wire_handler,
     health_handler,
     live_handler,
+    rollout_handler,
+    rollout_status_handler,
     wrap_handler,
 )
 from .http.middleware import (
@@ -277,6 +279,19 @@ class App:
             timeout_s=max(60.0, self.request_timeout),
         )
         self._add("POST", "/.well-known/debug/drain", self._drain_handler)
+        # Model lifecycle (docs/advanced-guide/rollouts.md): GET = the
+        # per-model version/rollout view; POST stages a zero-downtime
+        # weight rollout from a checkpoint path. The POST gets its own
+        # timeout budget — loading a multi-GB checkpoint host-side can
+        # exceed the API-SLO REQUEST_TIMEOUT; the shift itself runs on
+        # the controller thread and the route returns immediately after
+        # staging. Loopback-only unless GOFR_ROLLOUT_REMOTE=1 (the
+        # drain route's trust model: this swaps the serving weights).
+        self.get("/.well-known/debug/rollout", rollout_status_handler)
+        self._add(
+            "POST", "/.well-known/debug/rollout", rollout_handler,
+            timeout_s=max(120.0, self.request_timeout),
+        )
         self.router.add("GET", "/favicon.ico", favicon_wire_handler)
         from .swagger import register_swagger_routes
 
@@ -380,16 +395,12 @@ class App:
         it), and auth middleware is opt-in — an exposed port must not be
         a one-request denial of service. The preStop hook runs inside
         the pod, so localhost covers it; GOFR_DRAIN_REMOTE=1 opts remote
-        callers in for deployments that gate the route themselves."""
-        host = (getattr(ctx.request, "remote_addr", "") or "").rsplit(":", 1)[0]
-        if host not in ("127.0.0.1", "::1", "[::1]", "localhost", "") and (
-            self.config.get_or_default("GOFR_DRAIN_REMOTE", "0") != "1"
-        ):
-            from .http.errors import HTTPError
+        callers in for deployments that gate the route themselves
+        (shared trust model with the rollout route: handler.py
+        _require_loopback)."""
+        from .handler import _require_loopback
 
-            err = HTTPError("drain is loopback-only (set GOFR_DRAIN_REMOTE=1)")
-            err.status_code = 403
-            raise err
+        _require_loopback(ctx, "GOFR_DRAIN_REMOTE")
         started = self.begin_drain()
         return {
             "draining": True,

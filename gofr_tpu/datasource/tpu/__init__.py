@@ -469,6 +469,7 @@ class TPURuntime:
         flight across TPU_LLM_POISON_DEATHS deaths is refused further
         failover (docs/advanced-guide/resilience.md)."""
         from ...llm import LLMEngine, ReplicatedLLMEngine
+        from ...resilience.rollout import ModelHandle
 
         engine_kw.setdefault("prefix_cache_mb", self.default_llm_prefix_cache_mb)
         if self.default_llm_step_budget != "":
@@ -497,6 +498,11 @@ class TPURuntime:
             )
         engine_kw.setdefault("kv_label", name)  # metric-series label
         engine_kw.setdefault("tracer", self.tracer)  # lifecycle spans
+        # model-version label (docs/advanced-guide/rollouts.md): tagged
+        # on metrics/wide events, pinned by mid-stream failover, and the
+        # baseline a later ModelHandle.deploy() / POST
+        # /.well-known/debug/rollout shifts away from
+        engine_kw.setdefault("version", "v1")
         if not hasattr(self, "_llms"):
             self._llms: dict[str, Any] = {}
         if name in self._llms:
@@ -507,12 +513,24 @@ class TPURuntime:
                 cfg, params, replicas=replicas,
                 logger=self.logger, metrics=self.metrics, **engine_kw,
             )
+            build_kw = {}  # the fleet retains its own rebuild inputs
         else:
             engine = LLMEngine(
                 cfg, params, logger=self.logger, metrics=self.metrics, **engine_kw
             )
-        self._llms[name] = engine
-        return engine
+            # retained so a deploy() can build the staged engine with the
+            # SAME serving shape (slots, buckets, scheduler, overload
+            # knobs) — only the weights change
+            build_kw = dict(
+                engine_kw, logger=self.logger, metrics=self.metrics
+            )
+            build_kw.pop("version", None)
+        handle = ModelHandle(
+            name, engine, cfg=cfg, params=params, build_kw=build_kw,
+            logger=self.logger, metrics=self.metrics,
+        )
+        self._llms[name] = handle
+        return handle
 
     def llm(self, name: str):
         llms = getattr(self, "_llms", {})

@@ -242,6 +242,13 @@ def _iter_stream_handler(handler: Callable, ctx: Context) -> Iterator[Any]:
                     return
         finally:
             loop.run_until_complete(agen.aclose())
+            # closing the handler's generator abandons any async
+            # generator it was iterating (e.g. GenRequest.astream) —
+            # those finalize through the loop's asyncgen hooks, so the
+            # hooks must RUN before the loop dies or the inner
+            # generator's cleanup (disconnect-cancel: slot freed,
+            # finish_reason "disconnect") never executes
+            loop.run_until_complete(loop.shutdown_asyncgens())
             loop.close()
     else:
         out = _run_handler(handler, ctx)
@@ -281,12 +288,28 @@ class GRPCServer:
 
     def add_server_stream(self, service: str, method: str, handler: Callable) -> None:
         """handler(ctx) -> iterator of JSON-serializable chunks (token
-        streaming: yield per token)."""
+        streaming: yield per token).
+
+        Client-disconnect cancellation: the serving loop checks
+        ``grpc_ctx.is_active()`` at every chunk and CLOSES the handler
+        iterator the moment the peer is gone (cancelled RPC, dead
+        connection) — the sync gRPC server abandons the response
+        iterator to the GC otherwise, which would let an LLM stream
+        decode to completion for a client that hung up. Closing it here,
+        on the serving thread, runs the handler's GeneratorExit path
+        (GenRequest disconnect-cancel: slot freed, load credited,
+        finish_reason "disconnect"; docs/advanced-guide/rollouts.md)."""
 
         def behavior(request: bytes, grpc_ctx) -> Iterator[bytes]:
             ctx = Context(GRPCRequest(request, grpc_ctx, f"/{service}/{method}"), self.container)
-            for chunk in _iter_stream_handler(handler, ctx):
-                yield _json_bytes(chunk)
+            it = _iter_stream_handler(handler, ctx)
+            try:
+                for chunk in it:
+                    if not grpc_ctx.is_active():
+                        break  # peer gone: finally closes the handler
+                    yield _json_bytes(chunk)
+            finally:
+                it.close()
 
         self._generic_methods.setdefault(service, {})[method] = (
             grpc.unary_stream_rpc_method_handler(

@@ -282,5 +282,95 @@ def debug_profile_handler(ctx: Context) -> Any:
     return FileResponse(res["archive"], "application/zip")
 
 
+def _require_loopback(ctx: Context, opt_in_key: str) -> None:
+    """Mutating admin routes are loopback-only by default (the drain
+    route's precedent): auth middleware is opt-in, and an exposed port
+    must not let a stranger swap the model weights or take the instance
+    out of rotation. ``opt_in_key``=1 opts remote callers in for
+    deployments that gate the route themselves."""
+    host = (getattr(ctx.request, "remote_addr", "") or "").rsplit(":", 1)[0]
+    if host in ("127.0.0.1", "::1", "[::1]", "localhost", ""):
+        return
+    cfg = ctx.container.config
+    if cfg is not None and cfg.get_or_default(opt_in_key, "0") == "1":
+        return
+    from .http.errors import HTTPError
+
+    err = HTTPError(f"this route is loopback-only (set {opt_in_key}=1)")
+    err.status_code = 403
+    raise err
+
+
+def rollout_status_handler(ctx: Context) -> Any:
+    """GET /.well-known/debug/rollout — the model-lifecycle view per
+    registered LLM: active version, live replicas per version, and the
+    state of the active (or last) rollout. Read-only; never constructs
+    the TPU runtime (docs/advanced-guide/rollouts.md)."""
+    rt = ctx.container.tpu_runtime
+    if rt is None:
+        return {"models": {}, "note": "tpu runtime not initialized"}
+    out = {}
+    for name, handle in getattr(rt, "_llms", {}).items():
+        eng = getattr(handle, "engine", handle)
+        out[name] = {
+            "version": getattr(eng, "version", None),
+            "versions": (
+                eng.version_counts() if hasattr(eng, "version_counts")
+                else {getattr(eng, "version", "v1"): 1}
+            ),
+            "rollout": (
+                handle.rollout_state()
+                if hasattr(handle, "rollout_state") else None
+            ),
+        }
+    return {"models": out}
+
+
+def rollout_handler(ctx: Context) -> Any:
+    """POST /.well-known/debug/rollout — stage a zero-downtime weight
+    rollout from a checkpoint on disk (docs/advanced-guide/rollouts.md).
+
+    Body: ``{"model": <registered llm name>, "checkpoint": <path>,
+    "version": "v2" (optional, derived), "family": "gemma"|"llama"
+    (optional, default gemma; ignored for orbax dirs),
+    "bake_s"/"shadow_probes" (optional overrides)}``.
+
+    The checkpoint is loaded host-side and validated against the
+    engine's config BEFORE any device transfer — a bad path or a
+    mismatched tree is a 4xx here, never a dead replica. A second
+    deploy while one is active is a 409. Loopback-only unless
+    GOFR_ROLLOUT_REMOTE=1 (this route swaps the serving weights —
+    the drain route's trust model applies)."""
+    from .http.errors import ErrorEntityNotFound, ErrorInvalidParam
+    from .models.checkpoint import load_checkpoint, validate_params
+
+    _require_loopback(ctx, "GOFR_ROLLOUT_REMOTE")
+    body = ctx.bind() or {}
+    name = body.get("model")
+    path = body.get("checkpoint")
+    if not name or not isinstance(name, str):
+        raise ErrorInvalidParam("model")
+    if not path or not isinstance(path, str):
+        raise ErrorInvalidParam("checkpoint")
+    rt = ctx.container.tpu_runtime  # never construct: roll what runs
+    llms = getattr(rt, "_llms", {}) if rt is not None else {}
+    handle = llms.get(name)
+    if handle is None or not hasattr(handle, "deploy"):
+        raise ErrorEntityNotFound("llm", name)
+    cfg = getattr(handle, "cfg", None)
+    params = load_checkpoint(path, cfg, str(body.get("family", "gemma")))
+    validate_params(params, cfg)  # 4xx here; deploy re-checks before devices
+    kw = {}
+    if body.get("bake_s") is not None:
+        kw["bake_s"] = float(body["bake_s"])
+    if body.get("shadow_probes") is not None:
+        kw["shadow_probes"] = int(body["shadow_probes"])
+    version = body.get("version")
+    snap = handle.deploy(
+        cfg, params, version=str(version) if version else None, **kw
+    )
+    return {"model": name, "rollout": snap}
+
+
 async def favicon_wire_handler(_req: Request) -> Response:
     return Response(200, [("Content-Type", "image/png")], FAVICON)

@@ -300,6 +300,12 @@ class _HTTPProtocol(asyncio.Protocol):
                 if not chunk:
                     continue
                 if self.closed:
+                    # peer hung up (connection_lost): close the producer
+                    # NOW — its GeneratorExit path is where a streaming
+                    # LLM handler cancels the GenRequest (slot freed,
+                    # finish_reason "disconnect") instead of decoding to
+                    # completion for a dead connection
+                    await self._aclose_stream(resp)
                     return False
                 self.transport.write(
                     f"{len(chunk):x}\r\n".encode() + chunk + b"\r\n"
@@ -307,6 +313,7 @@ class _HTTPProtocol(asyncio.Protocol):
                 if self.can_write is not None and not self.can_write.is_set():
                     await self.can_write.wait()
                     if self.closed:
+                        await self._aclose_stream(resp)
                         return False
         except Exception as e:  # noqa: BLE001
             # Mid-stream failure: abort WITHOUT the chunked terminator so the
@@ -316,9 +323,21 @@ class _HTTPProtocol(asyncio.Protocol):
                 self.server.logger.error(f"stream aborted: {e!r}")
             self.transport.abort()
             self.closed = True
+            await self._aclose_stream(resp)
             return False
         self.transport.write(b"0\r\n\r\n")
         return True
+
+    @staticmethod
+    async def _aclose_stream(resp: Response) -> None:
+        """Close an abandoned body generator so handler-side cleanup
+        (GenRequest disconnect-cancel) runs immediately, not at GC."""
+        aclose = getattr(resp.stream, "aclose", None)
+        if aclose is not None:
+            try:
+                await aclose()
+            except Exception:  # noqa: BLE001 — teardown must not mask the abort
+                pass
 
 
 class NativeHTTPServer:

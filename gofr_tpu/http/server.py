@@ -301,15 +301,32 @@ class AsyncHTTPServer:
                 async for chunk in resp.stream:
                     if not chunk:
                         continue
+                    # a peer that hung up surfaces as connection_lost on
+                    # the transport before the next write fails — check
+                    # it per chunk so a dead client is detected at the
+                    # next produced token, not at stream end
+                    if writer.transport.is_closing():
+                        raise ConnectionError("client disconnected")
                     writer.write(f"{len(chunk):x}\r\n".encode() + chunk + b"\r\n")
                     await writer.drain()
             except Exception as e:  # noqa: BLE001
                 # Mid-stream failure: do NOT write the chunked terminator —
                 # abort the connection so the client sees truncation instead
                 # of a syntactically-complete (but silently short) response.
+                # CLOSE the producing generator before raising: its finally/
+                # GeneratorExit path is where a streaming LLM handler
+                # cancels the GenRequest (slot freed, load credited,
+                # finish_reason "disconnect") — leaving it to the GC would
+                # let an abandoned request decode to completion first.
                 if self.logger:
                     self.logger.error(f"stream aborted: {e!r}")
                 writer.transport.abort()
+                aclose = getattr(resp.stream, "aclose", None)
+                if aclose is not None:
+                    try:
+                        await aclose()
+                    except Exception:  # noqa: BLE001 — teardown must not mask the abort
+                        pass
                 raise ConnectionError("stream aborted") from e
             writer.write(b"0\r\n\r\n")
             await writer.drain()

@@ -316,6 +316,16 @@ class GenRequest:
     def __post_init__(self):
         self.out: queue.Queue = queue.Queue()
         self.cancelled = False
+        # why the cancel happened — becomes the finish_reason when the
+        # engine retires the request ("cancelled" for an explicit caller
+        # cancel, "disconnect" when the serving edge detected a dead
+        # peer; docs/advanced-guide/rollouts.md#client-disconnects)
+        self.cancel_reason = "cancelled"
+        # model version of the engine that last accepted this request —
+        # stamped by LLMEngine.submit. Once the stream has emitted a
+        # token, failover PINS to this version: a stream must never be
+        # served tokens from two model versions (rollouts).
+        self.engine_version: str | None = None
         self.emitted = 0
         self.capped = False  # engine reduced max_new_tokens to fit the cache
         self.browned = False  # brownout clamped max_new_tokens (batch class)
@@ -379,34 +389,53 @@ class GenRequest:
                 "deaths; failover refused (do not retry this payload)"
             )
 
+    def _consumer_gone(self) -> None:
+        """The consuming generator was CLOSED before the stream finished —
+        the serving edge detected a dead peer (HTTP broken pipe, gRPC
+        context done) or the caller abandoned the iterator. Either way
+        nobody will read another token: cancel so the engine frees the
+        slot and credits load_tokens instead of decoding to completion
+        for a connection that no longer exists."""
+        if self.finish_reason is None and not self.cancelled:
+            self.cancel(reason="disconnect")
+
     def stream(self, timeout: float = 60.0) -> Iterator[int]:
         """Yield token ids until the engine signals completion."""
-        while True:
-            item = self.out.get(timeout=timeout)
-            if item is None:
-                self._raise_terminal()
-                return
-            if isinstance(item, list):
-                yield from item
-            else:
-                yield item
+        try:
+            while True:
+                item = self.out.get(timeout=timeout)
+                if item is None:
+                    self._raise_terminal()
+                    return
+                if isinstance(item, list):
+                    yield from item
+                else:
+                    yield item
+        except GeneratorExit:
+            self._consumer_gone()
+            raise
 
     async def astream(self, timeout: float = 60.0):
         import asyncio
 
         loop = asyncio.get_running_loop()
-        while True:
-            item = await loop.run_in_executor(None, lambda: self.out.get(timeout=timeout))
-            if item is None:
-                self._raise_terminal()
-                return
-            if isinstance(item, list):
-                for t in item:
-                    yield t
-            else:
-                yield item
+        try:
+            while True:
+                item = await loop.run_in_executor(None, lambda: self.out.get(timeout=timeout))
+                if item is None:
+                    self._raise_terminal()
+                    return
+                if isinstance(item, list):
+                    for t in item:
+                        yield t
+                else:
+                    yield item
+        except GeneratorExit:
+            self._consumer_gone()
+            raise
 
-    def cancel(self) -> None:
+    def cancel(self, reason: str = "cancelled") -> None:
+        self.cancel_reason = reason
         self.cancelled = True
 
     def tokens(self, timeout: float = 60.0) -> list[int]:
@@ -461,6 +490,7 @@ class LLMEngine:
         kv_window: int | None = None,
         prefix_cache_mb: float = 0.0,
         kv_label: str = "llm",
+        version: str = "v1",
     ):
         import jax
         import jax.numpy as jnp
@@ -653,6 +683,7 @@ class LLMEngine:
             numeric_check = _os.environ.get("TPU_LLM_NUMERIC_CHECK", "1") != "0"
         self.numeric_check = bool(numeric_check)
         self.numerical_trips = 0  # non-finite logits -> replica death
+        self.errored = 0  # requests finished "error"/"poison" (bake signal)
         self._draining = False  # drain(): admission closed, work finishes
         self._died = False  # _die ran (idempotence + stale-emission guard)
         self._die_guard = threading.Lock()
@@ -667,8 +698,18 @@ class LLMEngine:
         # kv_label doubles as the engine's metric/trace label (register_llm
         # passes the registered model name; replicas get a /rN suffix)
         self.label = kv_label
+        # model-version label (docs/advanced-guide/rollouts.md): which
+        # weight set this engine serves. Streams pin to it across
+        # failover; the wide-event line and the per-version request
+        # counter carry it.
+        self.version = str(version)
+        self.disconnect_cancels = 0  # dead-peer cancellations (edges)
         if metrics is not None:
             _register_phase_metrics(metrics)
+            metrics.set_gauge(
+                "app_llm_model_version_info", 1.0,
+                model=self.label, version=self.version,
+            )
         # recent-window phase samples (seconds) for stats()/debug — exact
         # p50/p99 over the last ~512 observations, deque-append cheap
         from .metrics import RollingWindow
@@ -1187,6 +1228,9 @@ class LLMEngine:
         now = time.perf_counter()
         req.submitted_at = now
         req.phase = "queued"
+        # version stamp: once this request has emitted a token, failover
+        # re-dispatch pins to this model version (no mixed-version stream)
+        req.engine_version = self.version
         # continuations (failover re-submits) carry engine-side spec
         # state from their previous replica; it is meaningless here
         req._spec_pending = []
@@ -1259,6 +1303,9 @@ class LLMEngine:
     def stats(self) -> dict:
         with self._lock:
             return {
+                "version": self.version,
+                "disconnect_cancels": self.disconnect_cancels,
+                "errored": self.errored,
                 "slots": self.slots,
                 "active": sum(r is not None for r in self._slot_req),
                 "waiting": self._admit_q.qsize() + len(self._waiting),
@@ -1386,9 +1433,11 @@ class LLMEngine:
             phases = {k: w.summary() for k, w in self._phases.items()}
         return {
             "label": self.label,
+            "version": self.version,
             "alive": self.alive(),
             "draining": self._draining,
             "died_reason": self.died_reason,
+            "disconnect_cancels": self.disconnect_cancels,
             "watchdog": (
                 {"threshold_s": self.step_watchdog_s,
                  "trips": self.watchdog.trips}
@@ -1526,6 +1575,18 @@ class LLMEngine:
             )
         self._kick.set()
 
+    def undrain(self) -> None:
+        """Reopen admission after a drain that was ROLLED BACK rather
+        than completed — the rollout controller's single-engine rollback
+        path (docs/advanced-guide/rollouts.md). A no-op on a dead engine
+        (alive() is still False; the router will not route here)."""
+        self._draining = False
+        if self.metrics is not None:
+            self.metrics.set_gauge(
+                "app_llm_drain_state", 0.0, model=self.label
+            )
+        self._kick.set()
+
     def drained(self) -> bool:
         """True once no request holds a slot, waits, or is in flight.
         A DEAD engine is vacuously drained — its requests were rescued
@@ -1650,6 +1711,13 @@ class LLMEngine:
             "app_llm_spec_accept_rate",
         ):
             self.metrics.set_gauge(name, 0.0, model=self.label)
+        # a closed engine must not keep exporting its version row (the
+        # dead-engine gauge bug class): the series would read as "this
+        # label still serves version X" forever
+        self.metrics.set_gauge(
+            "app_llm_model_version_info", 0.0,
+            model=self.label, version=self.version,
+        )
 
     def _teardown_profiling(self) -> None:
         """Compile-observatory teardown (close() and _die()): drop this
@@ -1968,7 +2036,7 @@ class LLMEngine:
                 self._stop = True
                 break
             if req.cancelled:
-                req.finish_reason = "cancelled"
+                req.finish_reason = req.cancel_reason
                 self._observe_finish(req, time.perf_counter())
                 req.out.put(None)
                 continue
@@ -2171,48 +2239,56 @@ class LLMEngine:
             )
 
     def _expire_deadlines(self, now: float) -> None:
-        """Cancel every request whose wall deadline passed — INCLUDING
-        slotted ones. ttft_deadline_ms only sheds at admission; before
-        this sweep a decode past its HTTP timeout kept burning chip time
-        for a client that already hung up. Cancelled occupants free their
-        slot through the virtual-free path (same machinery as a user
-        cancel), so the next admission reuses the slot immediately. Runs
+        """Retire every request whose wall deadline passed OR that was
+        cancelled by its consumer — INCLUDING slotted ones.
+        ttft_deadline_ms only sheds at admission; before this sweep a
+        decode past its HTTP timeout kept burning chip time for a client
+        that already hung up. The cancel half closes the same gap for
+        disconnect-cancels: a cancelled occupant with an IDLE pipeline
+        (nothing in flight to carry the finish through _emit_to) used to
+        hold its slot and its consumer's end-of-stream until the next
+        admission reassigned it. Retired occupants free their slot
+        through the virtual-free path (in-flight snapshots drop their
+        tokens), so the next admission reuses the slot immediately. Runs
         once per scheduler pass: O(slots + waiting), no device work."""
-        expired: list[GenRequest] = []
+        deadline_hit = 0
+        expired: list[tuple[GenRequest, str]] = []
         with self._lock:
             for slot, r in enumerate(self._slot_req):
-                if (
-                    r is not None
-                    and r.deadline is not None
-                    and now > r.deadline
-                    and r.finish_reason is None
-                ):
-                    expired.append(r)
+                if r is None or r.finish_reason is not None:
+                    continue
+                if r.cancelled:
+                    expired.append((r, r.cancel_reason))
+                    self._slot_req[slot] = None
+                elif r.deadline is not None and now > r.deadline:
+                    expired.append((r, "deadline"))
                     self._slot_req[slot] = None
             if self._waiting:
                 kept = []
                 for r in self._waiting:
-                    if (
-                        r.deadline is not None
-                        and now > r.deadline
-                        and r.finish_reason is None
-                    ):
-                        expired.append(r)
+                    if r.finish_reason is not None:
+                        continue  # closed elsewhere; drop from the queue
+                    if r.cancelled:
+                        expired.append((r, r.cancel_reason))
+                    elif r.deadline is not None and now > r.deadline:
+                        expired.append((r, "deadline"))
                     else:
                         kept.append(r)
                 self._waiting = kept
-            for r in expired:
+            for r, reason in expired:
                 r.cancelled = True  # in-flight snapshots drop its tokens
-                r.finish_reason = "deadline"
-                self.deadline_cancels += 1
+                r.finish_reason = reason
+                if reason == "deadline":
+                    self.deadline_cancels += 1
+                    deadline_hit += 1
                 self._observe_finish(r, now)
                 r.out.put(None)
         if expired:
             self._kick.set()
-            if self.metrics is not None:
+            if deadline_hit and self.metrics is not None:
                 self.metrics.increment_counter(
                     "app_llm_deadline_cancels_total",
-                    by=float(len(expired)), model=self.label,
+                    by=float(deadline_hit), model=self.label,
                 )
 
     def _admit(self) -> bool:
@@ -2426,7 +2502,7 @@ class LLMEngine:
         the wave path's _slot_in)."""
         old = self._slot_req[slot]
         if old is not None and old.cancelled and old.finish_reason is None:
-            old.finish_reason = "cancelled"
+            old.finish_reason = old.cancel_reason
             self._observe_finish(old, now)
             old.out.put(None)
         self._slot_req[slot] = r
@@ -2732,6 +2808,24 @@ class LLMEngine:
                     "app_llm_time_per_output_token_seconds", tpot,
                     model=self.label,
                 )
+        if r.finish_reason == "disconnect":
+            # dead-peer cancellation (edge detected a closed connection):
+            # the slot is free and the remaining decode was never done —
+            # count it so operators see abandoned-stream volume
+            self.disconnect_cancels += 1
+            if self.metrics is not None:
+                self.metrics.increment_counter(
+                    "app_llm_disconnect_cancels_total", model=self.label
+                )
+        if self.metrics is not None:
+            # per-version request accounting (rollouts): which weight set
+            # served this request — the canary dashboard's error-rate
+            # denominator during a traffic shift
+            self.metrics.increment_counter(
+                "app_llm_requests_by_version_total",
+                model=self.label, version=self.version,
+                finish=r.finish_reason or "unknown",
+            )
         if r.span is not None:
             if fetch_t is not None:
                 # host-side tail: final tokens fetched -> emitted to the
@@ -2741,14 +2835,17 @@ class LLMEngine:
             r.span.set_attribute("llm.finish_reason", r.finish_reason)
             if r.prefix_hit:
                 r.span.set_attribute("llm.prefix_hit", True)
-            if r.finish_reason in ("cancelled", "shed"):
+            if r.finish_reason in ("cancelled", "disconnect", "shed"):
                 r.span.set_status("ERROR")
             r.span.end()
+        if r.finish_reason in ("error", "poison"):
+            self.errored += 1  # bake-window regression signal (rollouts)
         if self.logger is not None:
             ms = lambda v: None if v is None else round(v * 1e3, 3)  # noqa: E731
             self._wide_events.append({
                 "event": "llm_request",
                 "model": self.label,
+                "model_version": self.version,
                 "id": r.id,
                 "trace_id": r.span.trace_id if r.span is not None else "",
                 "prompt_tokens": len(r.prompt_tokens),
@@ -2792,7 +2889,7 @@ class LLMEngine:
             now = time.perf_counter()
         finish = None
         if r.cancelled:
-            toks, finish = [], "cancelled"
+            toks, finish = [], r.cancel_reason
         take = min(len(toks), r.max_new_tokens - r.emitted)
         toks = toks[:take]
         if r.eos_token >= 0 and r.eos_token in toks:
@@ -2921,7 +3018,7 @@ class LLMEngine:
                     continue  # slot lost (recovery) or already finished
                 if r.cancelled:
                     if r.finish_reason is None:
-                        r.finish_reason = "cancelled"
+                        r.finish_reason = r.cancel_reason
                         self._observe_finish(r, time.perf_counter())
                         r.out.put(None)
                     self._slot_req[r.slot] = None
@@ -4036,6 +4133,7 @@ class ReplicatedLLMEngine:
         router: str = "least_loaded",
         logger=None,
         supervise: bool = True,
+        version: str = "v1",
         failover_retries: int | None = None,
         fleet_max_queue_tokens: int | None = None,
         retry_budget_per_s: float | None = None,
@@ -4080,7 +4178,27 @@ class ReplicatedLLMEngine:
         self.logger = logger
         self.metrics = engine_kw.get("metrics")
         self.label = engine_kw.pop("kv_label", "llm")
-        self._cfg, self._params = cfg, params
+        engine_kw.pop("version", None)  # fleet-owned; per-slot below
+        # -- versioned weight registry (docs/advanced-guide/rollouts.md) --
+        # The fleet retains (cfg, params) PER VERSION: the active version
+        # serves, a staged version is shifted in replica-by-replica by
+        # the rollout controller, and a rollback rebuilds from whichever
+        # retained version the slot should run. _slot_versions tracks
+        # what each replica slot serves RIGHT NOW (mixed mid-rollout).
+        self.version = str(version)
+        self._versions: dict[str, tuple] = {self.version: (cfg, params)}
+        self._slot_versions = [self.version] * len(specs)
+        # slots the rollout controller owns right now: the supervisor
+        # must not race it by rebuilding a replica the controller just
+        # drained/closed on purpose
+        self._rollout_hold: set[int] = set()
+        self._rollout = None  # active/last RolloutController
+        self._rollout_lock = threading.Lock()
+        self._versions_seen: set[str] = set()  # every gauge row ever written
+        # shadow-probe source: the last few REAL prompts, mirrored onto a
+        # rollout candidate before it is admitted to routing (sanity, not
+        # token equality — versions legitimately differ)
+        self._shadow_ring: deque = deque(maxlen=8)
         self._specs = specs
         self._engine_kw = engine_kw
         if failover_retries is None:
@@ -4173,7 +4291,10 @@ class ReplicatedLLMEngine:
         if canary is None:
             canary = _os.environ.get("TPU_LLM_CANARY", "1") != "0"
         self._canary_enabled = bool(canary)
-        self._canary_ref: list[int] | None = None  # healthy replica's probe tokens
+        # healthy replicas' probe tokens, PER MODEL VERSION — different
+        # weights legitimately produce different canary streams, so a v2
+        # candidate must never be token-compared against the v1 reference
+        self._canary_ref: dict[str, list[int]] = {}
         # build replicas concurrently: XLA releases the GIL while compiling,
         # so N warmups overlap instead of serializing construction N-fold.
         # On any failure, close the replicas that DID come up — each holds
@@ -4197,6 +4318,7 @@ class ReplicatedLLMEngine:
                 e.close()
             raise first_err
         self.engines = engines
+        self._observe_versions()
         self.supervisor = None
         if supervise:
             from .resilience import ReplicaSupervisor
@@ -4214,18 +4336,24 @@ class ReplicatedLLMEngine:
                 ),
             )
 
-    def _build_replica(self, i: int, spec: dict | None = None) -> "LLMEngine":
+    def _build_replica(
+        self, i: int, spec: dict | None = None, version: str | None = None,
+    ) -> "LLMEngine":
         """Construct (and warm) replica slot i from its retained spec —
-        the same path at first build and at supervised restart. ``spec``
-        overrides the home placement for elastic rebuilds (the
-        supervisor passes an alternate healthy device when the home
-        device is quarantined). Wires the failover hook so the new
-        replica's deaths rescue in-flight work too. Per-replica kv
-        label: N replicas sharing one label set would clobber each
-        other's resident-bytes gauges."""
+        the same path at first build, at supervised restart, and at a
+        rollout shift. ``spec`` overrides the home placement for elastic
+        rebuilds (the supervisor passes an alternate healthy device when
+        the home device is quarantined); ``version`` overrides the
+        slot's current version (the rollout controller passes the target
+        version on a shift and the retained old version on a rollback).
+        Wires the failover hook so the new replica's deaths rescue
+        in-flight work too. Per-replica kv label: N replicas sharing one
+        label set would clobber each other's resident-bytes gauges."""
         from .resilience import InjectedFault, default_injector, spec_device_key
 
         spec = self._specs[i] if spec is None else spec
+        version = self._slot_versions[i] if version is None else version
+        cfg, params = self._versions[version]
         inj = self._engine_kw.get("fault_injector") or default_injector()
         key = spec_device_key(spec)
         if inj.take("device_sick", key) is not None:
@@ -4241,8 +4369,8 @@ class ReplicatedLLMEngine:
                 )
             raise InjectedFault(f"device_sick: build refused on {key}")
         eng = LLMEngine(
-            self._cfg, self._params, logger=self.logger,
-            kv_label=f"{self.label}/r{i}", **spec,
+            cfg, params, logger=self.logger,
+            kv_label=f"{self.label}/r{i}", version=version, **spec,
             **self._engine_kw,
         )
         eng.failover_hook = self._failover
@@ -4284,20 +4412,24 @@ class ReplicatedLLMEngine:
 
     def _canary_check(self, replacement: "LLMEngine") -> tuple[bool, str]:
         """Gate a rebuilt replica before it enters routing: the fixed
-        greedy probe, token-compared against a healthy replica's cached
-        output when the fleet has (ever had) one, else against
-        completeness/vocabulary checks (resilience.health.canary_check).
-        The reference is computed once and cached — greedy decode is
-        deterministic per params+config, so it never goes stale."""
+        greedy probe, token-compared against a healthy SAME-VERSION
+        replica's cached output when the fleet has (ever had) one, else
+        against completeness/vocabulary checks
+        (resilience.health.canary_check). References are cached per
+        model version — greedy decode is deterministic per
+        params+config, so a version's reference never goes stale, and a
+        rollout candidate on new weights is never compared against the
+        old version's tokens."""
         if not self._canary_enabled:
             return True, "disabled"
         from .resilience.health import CANARY_MAX_NEW, CANARY_PROMPT, canary_check
 
-        ref = self._canary_ref
+        v = replacement.version
+        ref = self._canary_ref.get(v)
         has_peer = False
         if ref is None:
             for e in self.engines:
-                if e is replacement or not e.accepting():
+                if e is replacement or not e.accepting() or e.version != v:
                     continue
                 has_peer = True
                 try:
@@ -4306,37 +4438,162 @@ class ReplicatedLLMEngine:
                         temperature=0.0, eos_token=-1,
                     )
                     if len(ref) == CANARY_MAX_NEW:
-                        self._canary_ref = ref
+                        self._canary_ref[v] = ref
                         break
                     ref = None
                 except Exception:  # noqa: BLE001 — a sick reference is no reference
                     ref = None
         ok, detail, toks = canary_check(replacement, ref)
         if ok and ref is None and not has_peer:
-            # TRULY no healthy replica existed: the gated candidate's own
-            # passing output seeds the reference for future canaries.
-            # When a peer exists but its reference fetch failed
-            # transiently (saturated, draining race), do NOT self-seed —
-            # caching an unverified candidate's tokens would poison the
-            # permanent reference and canary-reject every honest rebuild
-            # after it; the next canary simply retries the peer.
-            self._canary_ref = toks
+            # TRULY no healthy same-version replica existed (the first
+            # replica of a staged version, or a fleet-wide outage): the
+            # gated candidate's own passing output seeds the reference
+            # for future canaries of this version. When a peer exists
+            # but its reference fetch failed transiently (saturated,
+            # draining race), do NOT self-seed — caching an unverified
+            # candidate's tokens would poison the permanent reference
+            # and canary-reject every honest rebuild after it; the next
+            # canary simply retries the peer.
+            self._canary_ref[v] = toks
         return ok, detail
 
+    # -- model lifecycle (resilience.rollout;
+    # docs/advanced-guide/rollouts.md) --------------------------------------
+    def deploy(
+        self,
+        cfg=None,
+        params=None,
+        *,
+        version: str | None = None,
+        bake_s: float | None = None,
+        shadow_probes: int | None = None,
+        drain_timeout_s: float | None = None,
+    ) -> dict:
+        """Stage a new model version and shift the running fleet onto it
+        with zero downtime: the rollout controller drains one replica at
+        a time, rebuilds it on the new weights through the supervisor's
+        ``_build_replica`` seam, gates it with the canary probe plus a
+        shadow-traffic replay, admits it to routing, and watches a bake
+        window afterwards — any regression (replica death, numerical
+        trip, canary/shadow failure, request-error delta) rolls every
+        upgraded replica back to the retained old params. The fleet
+        always ends fully on ONE version.
+
+        ``params`` are validated against ``cfg`` (structure, shapes,
+        dtypes — models.checkpoint.validate_params) BEFORE any device
+        transfer: a bad checkpoint is a 4xx at the admin route, never a
+        dead replica. Returns the rollout snapshot immediately; progress
+        is visible in stats()/debug_state()["rollout"] and the
+        app_llm_rollout_* metrics."""
+        from .models.checkpoint import validate_params
+        from .resilience.rollout import (
+            RolloutController,
+            RolloutError,
+            RolloutInProgress,
+        )
+
+        if params is None:
+            raise RolloutError("deploy() needs params (the new weights)")
+        active_cfg, _ = self._versions[self.version]
+        cfg = active_cfg if cfg is None else cfg
+        validate_params(params, cfg)  # typed 4xx before anything moves
+        with self._rollout_lock:
+            if self._rollout is not None and self._rollout.active():
+                raise RolloutInProgress(
+                    f"rollout to {self._rollout.to_version!r} already in "
+                    f"progress (state {self._rollout.state})"
+                )
+            if self._draining:
+                raise EngineDraining("fleet draining; refusing rollout")
+            if version is None:
+                version = self._derive_version()
+            if version in self._versions:
+                raise RolloutError(
+                    f"model version {version!r} already exists "
+                    f"(known: {sorted(self._versions)})"
+                )
+            self._versions[version] = (cfg, params)
+            ctl = RolloutController(
+                self, version, bake_s=bake_s, shadow_probes=shadow_probes,
+                drain_timeout_s=drain_timeout_s,
+            )
+            self._rollout = ctl
+            ctl.start()
+        return ctl.snapshot()
+
+    def _derive_version(self) -> str:
+        """Next free label in the conventional v<N> sequence (used when
+        deploy() is not given an explicit version)."""
+        import re
+
+        nums = [
+            int(m.group(1))
+            for v in self._versions
+            for m in [re.match(r"^v(\d+)$", v)] if m
+        ]
+        n = (max(nums) + 1) if nums else (len(self._versions) + 1)
+        while f"v{n}" in self._versions:
+            n += 1
+        return f"v{n}"
+
+    def version_counts(self) -> dict[str, int]:
+        """Live replicas per model version (mixed only mid-rollout)."""
+        counts: dict[str, int] = {}
+        for e in self.engines:
+            if e.alive():
+                counts[e.version] = counts.get(e.version, 0) + 1
+        return counts
+
+    def _observe_versions(self) -> None:
+        """Keep ``app_llm_model_version_info`` truthful at fleet level:
+        value = live replicas serving that version, and every version
+        label the fleet has ever exported is re-written (stale rows from
+        a completed or rolled-back version must read 0, not their last
+        live value — the dead-engine gauge bug class)."""
+        if self.metrics is None:
+            return
+        counts = self.version_counts()
+        for v in set(self._versions) | set(counts) | self._versions_seen:
+            self._versions_seen.add(v)
+            self.metrics.set_gauge(
+                "app_llm_model_version_info", float(counts.get(v, 0)),
+                model=self.label, version=v,
+            )
+
+    def rollout_state(self) -> dict | None:
+        """Snapshot of the active (or most recent) rollout, None if a
+        deploy was never staged."""
+        ctl = self._rollout
+        return None if ctl is None else ctl.snapshot()
+
     # -- routing -----------------------------------------------------------
-    def _pick(self, exclude: set | frozenset = frozenset()) -> "LLMEngine":
+    def _pick(
+        self,
+        exclude: set | frozenset = frozenset(),
+        version: str | None = None,
+    ) -> "LLMEngine":
         """Route among replicas that ACCEPT work — alive and not
         draining. A replica whose scheduler or collector thread died
         (LLMEngine._die) hands its queued requests to the failover hook;
-        the router's job is to stop feeding it new ones."""
+        the router's job is to stop feeding it new ones. ``version``
+        restricts the candidate set to replicas serving that model
+        version — the failover path's mid-stream pin (a stream must
+        never carry tokens from two versions)."""
         live = [
             e for e in self.engines
             if e.accepting() and id(e) not in exclude
+            and (version is None or e.version == version)
         ]
         if not live:
-            if any(e.alive() for e in self.engines):
+            if any(
+                e.alive() for e in self.engines
+                if version is None or e.version == version
+            ):
                 raise EngineDraining("all replicas draining")
-            raise EngineStoppedError("all replicas dead")
+            raise EngineStoppedError(
+                "all replicas dead" if version is None
+                else f"no live replica serves model version {version!r}"
+            )
         if self.router == "round_robin" or len(live) == 1:
             return live[next(self._rr) % len(live)]
         # token-weighted least-loaded: queued device work, not request
@@ -4397,10 +4654,16 @@ class ReplicatedLLMEngine:
                 self._observe_retry_budget()
             eng = self._pick(exclude=tried)
             try:
-                return eng.submit(req)
+                out = eng.submit(req)
             except (EngineStoppedError, EngineDraining) as e:
                 first_err = first_err or e
                 tried.add(id(eng))
+                continue
+            # shadow-probe source (rollouts): remember a bounded prefix
+            # of real accepted prompts; a rollout candidate replays a few
+            # before admission (deque append is thread-safe, O(1))
+            self._shadow_ring.append(tuple(req.prompt_tokens[:32]))
+            return out
         raise first_err or EngineStoppedError("all replicas dead")
 
     def _fleet_retry_after(self, queued_tokens: int) -> float:
@@ -4484,6 +4747,15 @@ class ReplicatedLLMEngine:
                 r._prefill_t0 = None
                 r._load_acct = 0
                 tried: set[int] = set()
+                # Mid-stream version pin (docs/advanced-guide/rollouts.md):
+                # a request that already emitted tokens continues ONLY on
+                # a replica serving the same model version — resuming the
+                # continuation prompt on different weights would splice
+                # two models' tokens into one stream (silent corruption:
+                # the bytes look plausible and the status is 200). A
+                # request with nothing emitted may restart anywhere; its
+                # stream is still single-version by construction.
+                pin = r.engine_version if r.emitted > 0 else None
                 # A momentarily FULL live replica is not a dead one:
                 # excluding it would error rescued work while capacity
                 # exists seconds later (the overload+death case failover
@@ -4493,8 +4765,19 @@ class ReplicatedLLMEngine:
                 while first_try or time.perf_counter() < batch_deadline:
                     first_try = False
                     try:
-                        eng = self._pick(exclude=tried)
+                        eng = self._pick(exclude=tried, version=pin)
                     except (EngineStoppedError, EngineDraining):
+                        if (
+                            pin is not None
+                            and self.logger is not None
+                            and any(e.accepting() for e in self.engines)
+                        ):
+                            self.logger.error(
+                                f"failover: request {r.id} pinned to model "
+                                f"version {pin} mid-stream and no live "
+                                f"replica serves it; erroring instead of "
+                                f"mixing versions"
+                            )
                         break
                     try:
                         eng.submit(r)
@@ -4546,6 +4829,13 @@ class ReplicatedLLMEngine:
             "replicas_alive": sum(e.alive() for e in self.engines),
             "router": self.router,
             "draining": self._draining,
+            # model lifecycle (docs/advanced-guide/rollouts.md)
+            "version": self.version,
+            "versions": self.version_counts(),
+            "rollout": self.rollout_state(),
+            "disconnect_cancels": sum(
+                s.get("disconnect_cancels", 0) for s in per
+            ),
             "failovers": self.failovers,
             "failover_errors": self.failover_errors,
             "restarts": self.supervisor.restarts if self.supervisor else 0,
@@ -4650,6 +4940,11 @@ class ReplicatedLLMEngine:
             "replicas": len(self.engines),
             "replicas_alive": sum(e.alive() for e in self.engines),
             "draining": self._draining,
+            # model lifecycle (docs/advanced-guide/rollouts.md)
+            "version": self.version,
+            "versions_retained": sorted(self._versions),
+            "slot_versions": list(self._slot_versions),
+            "rollout": self.rollout_state(),
             "failovers": self.failovers,
             "failover_errors": self.failover_errors,
             "failover_retries": self.failover_retries,
@@ -4697,18 +4992,29 @@ class ReplicatedLLMEngine:
 
     def close(self) -> None:
         self._draining = True  # a rebuild racing close must not be routed
+        if self._rollout is not None:
+            # a mid-shift controller must stop BEFORE the engines close:
+            # it would otherwise race the teardown rebuilding replicas
+            # into a fleet that no longer exists
+            self._rollout.close()
         if self.supervisor is not None:
             self.supervisor.close()
         for e in self.engines:
             e.close()
         if self.metrics is not None:
             # a closed fleet must not keep exporting its last budget
-            # level or capacity-degradation state (the dead-engine gauge
-            # bug class)
+            # level, capacity-degradation state, or model-version rows
+            # (the dead-engine gauge bug class)
             for name in (
                 "app_llm_retry_budget_remaining",
                 "app_llm_devices_quarantined",
                 "app_llm_replicas_parked",
                 "app_llm_replicas_failed",
+                "app_llm_rollout_state",
             ):
                 self.metrics.set_gauge(name, 0.0, model=self.label)
+            for v in set(self._versions) | self._versions_seen:
+                self.metrics.set_gauge(
+                    "app_llm_model_version_info", 0.0,
+                    model=self.label, version=v,
+                )

@@ -28,14 +28,103 @@ from typing import Any
 import numpy as np
 
 __all__ = [
+    "CheckpointValidationError",
     "load_safetensors_dir",
     "gemma_params_from_hf",
     "llama_params_from_hf",
     "load_gemma_checkpoint",
     "load_llama_checkpoint",
+    "load_checkpoint",
     "save_orbax",
     "load_orbax",
+    "validate_params",
 ]
+
+
+class CheckpointValidationError(ValueError):
+    """A loaded param tree does not match the engine config — wrong
+    structure, a mismatched shape, or a mismatched dtype. Raised by
+    :func:`validate_params` BEFORE any device transfer, naming the
+    first offending path: a bad checkpoint must be a 4xx at the rollout
+    admin route, never a dead replica billed to the device ledger
+    (docs/advanced-guide/rollouts.md)."""
+
+    status_code = 400
+
+
+def _tree_specs(tree: Any, prefix: str = "") -> dict[str, tuple]:
+    """Flatten a params pytree (nested dicts of array-likes) into
+    ``{"layers/wq": (shape, dtype_str)}``."""
+    out: dict[str, tuple] = {}
+    if isinstance(tree, dict):
+        for k in sorted(tree):
+            out.update(_tree_specs(tree[k], f"{prefix}{k}/"))
+        return out
+    path = prefix[:-1] if prefix else "<root>"
+    shape = tuple(getattr(tree, "shape", ()))
+    dtype = str(getattr(tree, "dtype", "?"))
+    out[path] = (shape, dtype)
+    return out
+
+
+def validate_params(params: Any, cfg) -> None:
+    """Verify a param tree's structure, shapes, and dtypes against what
+    ``cfg`` requires — with ZERO FLOPs and zero device memory:
+    ``jax.eval_shape`` over ``init_params`` produces the expected
+    ShapeDtypeStruct tree for any architecture variant the config
+    expresses, so the contract can never drift from the model code.
+
+    Raises :class:`CheckpointValidationError` naming the first
+    mismatching path. An extra ``unembed`` leaf (untied head) is
+    accepted when it matches the embedding's layout — untied-ness lives
+    in the pytree, not the config (see gofr_tpu.llm's param_specs
+    patching for the same reason)."""
+    import jax
+
+    from . import init_params
+
+    if not isinstance(params, dict):
+        raise CheckpointValidationError(
+            f"params must be a dict pytree, got {type(params).__name__}"
+        )
+    expected = _tree_specs(
+        jax.eval_shape(lambda k: init_params(k, cfg), jax.random.PRNGKey(0))
+    )
+    got = _tree_specs(params)
+    embed_spec = expected.get("embed")
+    if (
+        "unembed" in got
+        and "unembed" not in expected
+        and embed_spec is not None
+        and got["unembed"] == embed_spec
+    ):
+        expected = dict(expected, unembed=embed_spec)
+    missing = sorted(set(expected) - set(got))
+    if missing:
+        raise CheckpointValidationError(
+            f"checkpoint is missing param {missing[0]!r} "
+            f"(and {len(missing) - 1} more)" if len(missing) > 1 else
+            f"checkpoint is missing param {missing[0]!r}"
+        )
+    extra = sorted(set(got) - set(expected))
+    if extra:
+        raise CheckpointValidationError(
+            f"checkpoint has unexpected param {extra[0]!r} "
+            f"(config {type(cfg).__name__} does not use it)"
+        )
+    for path in sorted(expected):
+        eshape, edtype = expected[path]
+        gshape, gdtype = got[path]
+        if gshape != eshape:
+            raise CheckpointValidationError(
+                f"param {path!r} has shape {tuple(gshape)}, config "
+                f"requires {tuple(eshape)}"
+            )
+        if gdtype != edtype:
+            raise CheckpointValidationError(
+                f"param {path!r} has dtype {gdtype}, config requires "
+                f"{edtype}"
+            )
 
 
 def load_safetensors_dir(path: str) -> dict[str, np.ndarray]:
@@ -215,6 +304,28 @@ def load_llama_checkpoint(path: str, cfg) -> dict:
     if _is_orbax_dir(path):
         return load_orbax(path)
     return llama_params_from_hf(load_safetensors_dir(path), cfg)
+
+
+def load_checkpoint(path: str, cfg, family: str = "gemma") -> dict:
+    """Family-dispatching loader for the rollout admin route: an orbax
+    directory of the native pytree loads directly (family irrelevant);
+    an HF safetensors checkpoint goes through the family's layout
+    mapping. Loader failures (missing files, unknown tensors, layout
+    mismatches) surface as :class:`CheckpointValidationError` so the
+    admin route answers 4xx instead of a masked 500."""
+    if family not in ("gemma", "llama"):
+        raise CheckpointValidationError(
+            f"unknown checkpoint family {family!r} (gemma | llama)"
+        )
+    loader = load_llama_checkpoint if family == "llama" else load_gemma_checkpoint
+    try:
+        return loader(path, cfg)
+    except CheckpointValidationError:
+        raise
+    except (FileNotFoundError, KeyError, ValueError, OSError) as e:
+        raise CheckpointValidationError(
+            f"failed to load checkpoint at {path!r}: {e}"
+        ) from e
 
 
 def save_orbax(params: Any, path: str, *, overwrite: bool = False) -> None:

@@ -40,6 +40,7 @@ import threading
 from .faults import FAULT_POINTS, FaultInjector, InjectedFault, default_injector
 from .health import DeviceHealthLedger, canary_check, device_key, spec_device_key
 from .overload import FairLedger, OverloadController, RetryBudget
+from .rollout import ModelHandle, RolloutController, RolloutError, RolloutInProgress
 from .supervisor import ReplicaSupervisor
 from .watchdog import Heartbeat, StepWatchdog
 
@@ -50,9 +51,13 @@ __all__ = [
     "FaultInjector",
     "Heartbeat",
     "InjectedFault",
+    "ModelHandle",
     "OverloadController",
     "ReplicaSupervisor",
     "RetryBudget",
+    "RolloutController",
+    "RolloutError",
+    "RolloutInProgress",
     "StepWatchdog",
     "canary_check",
     "default_injector",
@@ -107,6 +112,23 @@ def register_resilience_metrics(metrics) -> None:
              "llm requests refused further failover after being in "
              "flight across the poison death threshold (500/INTERNAL "
              "to the caller)"),
+            # model lifecycle (resilience.rollout;
+            # docs/advanced-guide/rollouts.md)
+            ("app_llm_rollouts_started_total",
+             "llm weight rollouts staged (deploy()/the admin route)"),
+            ("app_llm_rollouts_completed_total",
+             "llm weight rollouts fully shifted and baked clean"),
+            ("app_llm_rollouts_rolled_back_total",
+             "llm weight rollouts rolled back to the old version "
+             "(canary/shadow rejection or bake-window regression)"),
+            ("app_llm_requests_by_version_total",
+             "llm requests finished, by model version and finish "
+             "reason — the per-version error-rate view during a "
+             "traffic shift"),
+            ("app_llm_disconnect_cancels_total",
+             "llm requests cancelled because the serving edge detected "
+             "a dead peer (broken pipe / closed gRPC context) — slot "
+             "freed instead of decoding to completion"),
         ):
             if not metrics.has(name):
                 metrics.new_counter(name, desc)
@@ -133,6 +155,12 @@ def register_resilience_metrics(metrics) -> None:
              "llm replica slots permanently failed after "
              "TPU_LLM_RESTART_MAX_ATTEMPTS consecutive rebuild "
              "failures (operator attention required)"),
+            ("app_llm_model_version_info",
+             "live replicas serving each model version (single engine: "
+             "1 for its version); mixed only mid-rollout, 0 after close"),
+            ("app_llm_rollout_state",
+             "llm rollout state machine (0 idle/terminal, 1 shifting, "
+             "2 baking, 3 rolling back)"),
         ):
             if not metrics.has(name):
                 metrics.new_gauge(name, desc)

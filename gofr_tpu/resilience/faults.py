@@ -43,6 +43,15 @@ Named failure points (armed per point, optionally per engine label):
                        chip that fails every rebuild, driving device
                        quarantine, elastic rebuild on an alternate
                        device, and slot parking deterministically.
+- ``rollout_canary_fail`` — the rollout controller's admission gate
+                       rejects the next candidate replica as if its
+                       canary/shadow probe diverged (deterministic
+                       automatic-rollback path for a bad weight push;
+                       gofr_tpu.resilience.rollout).
+- ``rollout_bake_regression`` — the next rollout bake-window poll sees
+                       a regression regardless of real fleet health,
+                       driving the post-shift rollback path
+                       deterministically in tier-1 and CI.
 
 A spec may carry a ``tag``: it then fires only for a request whose
 ``GenRequest.tag`` equals it (the poison-payload marker — a tagged
@@ -77,6 +86,8 @@ FAULT_POINTS = (
     "overload_pressure",
     "nan_logits",
     "device_sick",
+    "rollout_canary_fail",
+    "rollout_bake_regression",
 )
 
 

@@ -1,0 +1,812 @@
+"""Zero-downtime model rollouts: live weight reload, canary-gated
+traffic shift, automatic rollback.
+
+The one operation a production LLM fleet performs constantly — shipping
+a new checkpoint — used to require killing the process: ``register_llm``
+bound one immutable ``(cfg, params)`` for the process lifetime. This
+module is GoFr's data-migration + config-reload + circuit-breaker
+probe/reintegrate story applied to *weights*: load v(N+1), prove it
+healthy, shift traffic onto it one replica at a time, and roll back
+automatically on regression — with zero dropped requests and no stream
+ever served tokens from two model versions.
+
+Pieces:
+
+- :class:`RolloutController` — the fleet state machine
+  (``shifting -> baking -> completed`` | ``rolling_back ->
+  rolled_back``). One replica at a time it: drains the replica (PR 5
+  drain semantics, per-replica instead of per-process — in-flight
+  requests FINISH on the old weights), closes it, rebuilds it on the
+  staged version through the supervisor's ``_build_replica`` seam,
+  gates the candidate with the PR 7 canary probe (version-keyed
+  references) **plus** a shadow-traffic replay (a few real prompts
+  re-run for completion/vocabulary sanity — not token equality, new
+  weights legitimately differ), and only then admits it to routing.
+  After the last replica shifts, a bake window
+  (``TPU_LLM_ROLLOUT_BAKE_S``) watches for regressions — a replica
+  death, a numerical-watchdog trip, a device quarantine, a
+  request-error delta, or the ``rollout_bake_regression`` fault point —
+  and a trip halts everything and rolls every upgraded replica back to
+  the retained old params. The fleet always ends fully on ONE version.
+- :class:`ModelHandle` — what ``register_llm`` returns and
+  ``ctx.tpu().llm(name)`` resolves: the versioned registry entry. It
+  proxies the full engine surface (existing callers are unchanged) and
+  adds ``deploy(cfg, params, version=...)``. For a replicated fleet,
+  deploy delegates to the fleet's rollout controller; for a bare
+  single engine it runs a blue-green SWAP instead (build the new
+  engine next to the old one, gate it, atomically repoint the handle,
+  drain the old engine in the background, watch the same bake window,
+  and swap back on regression) — zero downtime either way, at the cost
+  of two resident weight copies during the swap.
+- Typed errors carrying the HTTP-status seam: a malformed deploy is a
+  4xx at the admin route (``POST /.well-known/debug/rollout``), a
+  concurrent deploy a 409 — never a dead replica or a masked 500.
+
+Mid-stream version pinning lives in ``gofr_tpu.llm`` (failover pins a
+request that has emitted tokens to a same-version replica, else errors
+cleanly); the checkpoint structure/shape/dtype validation lives in
+``gofr_tpu.models.checkpoint.validate_params``. Knobs and the failure
+model: docs/advanced-guide/rollouts.md.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+__all__ = [
+    "ModelHandle",
+    "RolloutController",
+    "RolloutError",
+    "RolloutInProgress",
+]
+
+
+class RolloutError(RuntimeError):
+    """A deploy request that cannot be staged (bad arguments, duplicate
+    version label, no params). 400 via the statusCodeResponder seam —
+    operator error, not an engine failure."""
+
+    status_code = 400
+
+
+class RolloutInProgress(RolloutError):
+    """A deploy was staged while another rollout is still shifting,
+    baking, or rolling back. 409: retry after the active rollout
+    reaches a terminal state."""
+
+    status_code = 409
+
+
+# state -> app_llm_rollout_state gauge value. Terminal states read 0
+# (nothing in progress); the counters say how each rollout ended.
+ROLLOUT_STATE_GAUGE = {
+    "idle": 0.0,
+    "shifting": 1.0,
+    "baking": 2.0,
+    "rolling_back": 3.0,
+    "completed": 0.0,
+    "rolled_back": 0.0,
+    "aborted": 0.0,
+}
+
+_ACTIVE_STATES = ("idle", "shifting", "baking", "rolling_back")
+
+SHADOW_MAX_NEW = 8  # tokens per shadow-probe replay (sanity, not equality)
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+def shadow_probe(candidate, prompts, *, max_new: int = SHADOW_MAX_NEW,
+                 timeout: float = 60.0) -> tuple[bool, str]:
+    """Replay a few REAL prompts on a not-yet-routed candidate engine
+    and judge sanity only: the stream must complete (``max_new`` tokens
+    — no eos is set, a short stream means a dying engine) and stay
+    inside the vocabulary (the numerical-watchdog sentinel ``-1`` is
+    out-of-vocabulary by construction). Token equality is deliberately
+    NOT checked — a new model version legitimately answers differently;
+    what must not change is that it answers at all."""
+    from ..llm import GenRequest
+
+    vocab = getattr(getattr(candidate, "cfg", None), "vocab_size", None)
+    for n, prompt in enumerate(prompts):
+        try:
+            req = candidate.submit(GenRequest(
+                list(prompt), max_new_tokens=max_new, temperature=0.0,
+                eos_token=-1,
+            ))
+            toks = req.tokens(timeout=timeout)
+        except Exception as e:  # noqa: BLE001 — a crashing replay IS the verdict
+            return False, f"shadow probe {n} crashed: {e!r}"
+        if len(toks) != max_new:
+            return (
+                False,
+                f"shadow probe {n} incomplete ({len(toks)}/{max_new} "
+                f"tokens, finish={req.finish_reason!r})",
+            )
+        if vocab is not None and any(t < 0 or t >= vocab for t in toks):
+            return False, f"shadow probe {n} emitted out-of-vocabulary token"
+    return True, "ok"
+
+
+class _RolloutBase:
+    """Shared bookkeeping for the fleet controller and the single-engine
+    swap: state machine, history ring, metrics, the bake-window watch."""
+
+    def __init__(self, *, label: str, metrics=None, logger=None,
+                 bake_s: float | None = None,
+                 shadow_probes: int | None = None,
+                 drain_timeout_s: float | None = None,
+                 interval_s: float = 0.05):
+        self.label = label
+        self.metrics = metrics
+        self.logger = logger
+        self.bake_s = (
+            _env_float("TPU_LLM_ROLLOUT_BAKE_S", 5.0)
+            if bake_s is None else max(0.0, float(bake_s))
+        )
+        self.shadow_probes = (
+            _env_int("TPU_LLM_ROLLOUT_SHADOW", 2)
+            if shadow_probes is None else max(0, int(shadow_probes))
+        )
+        self.drain_timeout_s = (
+            _env_float("TPU_LLM_ROLLOUT_DRAIN_S", 120.0)
+            if drain_timeout_s is None else max(0.1, float(drain_timeout_s))
+        )
+        self.interval = interval_s
+        self.state = "idle"
+        self.error: str | None = None
+        self.started_at: float | None = None
+        self.finished_at: float | None = None
+        self.canary_fails = 0
+        self.shadow_fails = 0
+        self._history: list[str] = []
+        self._stop = False
+        self._thread: threading.Thread | None = None
+
+    # -- lifecycle --------------------------------------------------------
+    def start(self) -> None:
+        self.started_at = time.perf_counter()
+        if self.metrics is not None:
+            self.metrics.increment_counter(
+                "app_llm_rollouts_started_total", model=self.label
+            )
+        self._thread = threading.Thread(
+            target=self._run_safe, name="llm-rollout", daemon=True
+        )
+        self._thread.start()
+
+    def _run_safe(self) -> None:
+        try:
+            self._run()
+        except Exception as e:  # noqa: BLE001 — a crashed controller must land terminal
+            self.error = self.error or f"rollout controller crashed: {e!r}"
+            if self.logger is not None:
+                self.logger.error(f"rollout controller crashed: {e!r}")
+            try:
+                self._converge_after_crash()
+            finally:
+                if self.state in _ACTIVE_STATES:
+                    self._finish("aborted")
+
+    def _run(self) -> None:  # pragma: no cover — subclass responsibility
+        raise NotImplementedError
+
+    def _converge_after_crash(self) -> None:
+        """Best-effort single-version convergence after an unexpected
+        controller exception. Subclasses override."""
+
+    def active(self) -> bool:
+        return self.state in _ACTIVE_STATES
+
+    def close(self, timeout: float = 10.0) -> None:
+        self._stop = True
+        t = self._thread
+        if t is not None and t.is_alive():
+            t.join(timeout=timeout)
+
+    def wait(self, timeout: float = 120.0) -> str:
+        """Block until the rollout reaches a terminal state (tests and
+        scripts). Returns the final state."""
+        deadline = time.monotonic() + timeout
+        while self.active() and time.monotonic() < deadline:
+            time.sleep(0.01)
+        return self.state
+
+    # -- state + visibility -----------------------------------------------
+    def _note(self, event: str) -> None:
+        self._history.append(f"{time.strftime('%H:%M:%S')} {event}")
+        del self._history[:-32]  # bounded debug ring
+        if self.logger is not None:
+            self.logger.info(f"rollout[{self.label}]: {event}")
+
+    def _set_state(self, state: str) -> None:
+        self.state = state
+        self._note(f"state -> {state}")
+        if self.metrics is not None:
+            self.metrics.set_gauge(
+                "app_llm_rollout_state", ROLLOUT_STATE_GAUGE[state],
+                model=self.label,
+            )
+
+    def _finish(self, state: str) -> None:
+        self.finished_at = time.perf_counter()
+        self._set_state(state)
+        if self.metrics is not None and state in ("completed", "rolled_back"):
+            self.metrics.increment_counter(
+                f"app_llm_rollouts_{state}_total", model=self.label
+            )
+
+    def snapshot(self) -> dict:
+        out = {
+            "state": self.state,
+            "from_version": self.from_version,
+            "to_version": self.to_version,
+            "bake_s": self.bake_s,
+            "shadow_probes": self.shadow_probes,
+            "canary_fails": self.canary_fails,
+            "shadow_fails": self.shadow_fails,
+            "error": self.error,
+            "history": list(self._history),
+        }
+        if self.started_at is not None:
+            end = self.finished_at or time.perf_counter()
+            out["elapsed_s"] = round(end - self.started_at, 2)
+        return out
+
+    # -- shared mechanics -------------------------------------------------
+    def _injector(self):
+        from .faults import default_injector
+
+        inj = getattr(self, "_fault_injector", None)
+        return inj if inj is not None else default_injector()
+
+    def _count_fault(self, point: str) -> None:
+        if self.logger is not None:
+            self.logger.warn(f"fault injection: {point} fired on {self.label}")
+        if self.metrics is not None:
+            self.metrics.increment_counter(
+                "app_llm_faults_injected_total", point=point, model=self.label,
+            )
+
+    def _wait_drained(self, engine, deadline: float) -> bool:
+        while not self._stop and time.perf_counter() < deadline:
+            if not engine.alive() or engine.drained():
+                return True
+            time.sleep(self.interval)
+        return not engine.alive() or engine.drained()
+
+    def _bake_watch(self, engines_fn, errored_baseline: int,
+                    quarantine_baseline: int) -> str | None:
+        """Watch the post-shift fleet for ``bake_s`` seconds. Returns a
+        regression reason, or None when the bake window passed clean.
+        The signals are exactly the ones the resilience stack already
+        classifies: a replica death (step fault, watchdog hang,
+        numerical trip — all land as ``alive() == False`` within a poll
+        interval and are billed by the PR 7 ledger), a device
+        quarantine, a request finishing ``error``/``poison``, and the
+        deterministic ``rollout_bake_regression`` fault point."""
+        t_end = time.perf_counter() + self.bake_s
+        while not self._stop and time.perf_counter() < t_end:
+            if self._injector().take("rollout_bake_regression", self.label):
+                self._count_fault("rollout_bake_regression")
+                return "injected rollout_bake_regression"
+            engines = engines_fn()
+            dead = [e for e in engines if not e.alive()]
+            if dead:
+                why = getattr(dead[0], "died_reason", None) or "unknown"
+                return f"replica death during bake ({why})"
+            errored = sum(e.errored for e in engines)
+            if errored > errored_baseline:
+                return (
+                    f"request errors during bake "
+                    f"(+{errored - errored_baseline})"
+                )
+            q = getattr(self, "_quarantines_fn", None)
+            if q is not None and q() > quarantine_baseline:
+                return "device quarantine during bake"
+            time.sleep(self.interval)
+        return None
+
+
+class RolloutController(_RolloutBase):
+    """Blue-green replica shift over a ``ReplicatedLLMEngine``.
+
+    The fleet owns the versioned weight registry
+    (``fleet._versions[version] = (cfg, params)``, staged by
+    ``fleet.deploy``) and the build/canary seams; the controller owns
+    the WHEN and the guarantee: one replica out of routing at a time,
+    in-flight work finished on the old weights, every candidate gated
+    before admission, and a fleet that ends fully on one version no
+    matter which step failed."""
+
+    def __init__(self, fleet, to_version: str, **kw):
+        super().__init__(
+            label=fleet.label, metrics=fleet.metrics,
+            logger=fleet.logger, **kw,
+        )
+        self.fleet = fleet
+        self.from_version = fleet.version
+        self.to_version = to_version
+        self.shifted = 0
+        self.total = len(fleet.engines)
+        self._fault_injector = fleet._engine_kw.get("fault_injector")
+        self._quarantines_fn = lambda: fleet.health.quarantines
+
+    def snapshot(self) -> dict:
+        out = super().snapshot()
+        out["shifted"] = self.shifted
+        out["total"] = self.total
+        return out
+
+    # -- main sequence ----------------------------------------------------
+    def _run(self) -> None:
+        fleet = self.fleet
+        self._set_state("shifting")
+        for i in range(len(fleet.engines)):
+            if self._stop or fleet._draining:
+                self._finish("aborted")
+                return
+            if not self._shift_slot(i):
+                self._rollback()
+                return
+            self.shifted += 1
+        quarantine_base = fleet.health.quarantines
+        errored_base = sum(e.errored for e in fleet.engines)
+        self._set_state("baking")
+        regression = self._bake_watch(
+            lambda: list(fleet.engines), errored_base, quarantine_base
+        )
+        if self._stop or fleet._draining:
+            self._finish("aborted")
+            return
+        if regression is not None:
+            self.error = regression
+            self._note(f"bake regression: {regression}")
+            self._rollback()
+            return
+        # committed: the staged version is THE version; other retained
+        # params are dropped (host memory) and their canary refs pruned
+        fleet.version = self.to_version
+        for v in list(fleet._versions):
+            if v != self.to_version:
+                fleet._versions.pop(v, None)
+                fleet._canary_ref.pop(v, None)
+        fleet._observe_versions()
+        self._finish("completed")
+
+    def _shift_slot(self, i: int) -> bool:
+        """Move replica slot i to the staged version. True on success;
+        False leaves the fleet mid-shift for _rollback to converge.
+
+        The hold is released only on SUCCESS: a failed shift leaves the
+        slot deliberately dead until _rollback rebuilds it, and
+        releasing the hold in between would let the supervisor both
+        bill the deliberate close to the device health ledger (a
+        quarantine for a failure that never happened) and race
+        _rollback's rebuild of the same slot. _rollback clears every
+        hold when it finishes."""
+        fleet = self.fleet
+        fleet._rollout_hold.add(i)
+        old = fleet.engines[i]
+        if old.alive():
+            # per-replica drain: the router stops feeding this
+            # replica (accepting() is False) while its in-flight
+            # requests FINISH ON THE OLD WEIGHTS — nothing is
+            # dropped and no stream changes version mid-flight
+            old.drain()
+            if not self._wait_drained(
+                old, time.perf_counter() + self.drain_timeout_s
+            ):
+                if self._stop:
+                    return False
+                # wedged in-flight work: put the replica back in
+                # service rather than killing live streams
+                old.undrain()
+                self.error = (
+                    f"slot {i} failed to drain within "
+                    f"{self.drain_timeout_s:.0f}s"
+                )
+                return False
+            old.close()
+        picked = fleet._spec_for_rebuild(i)
+        if picked is None:
+            self.error = f"slot {i}: no usable device for rebuild"
+            return False
+        spec, key = picked
+        try:
+            cand = fleet._build_replica(
+                i, spec=spec, version=self.to_version
+            )
+        except Exception as e:  # noqa: BLE001 — a failed build rolls back
+            self.error = f"slot {i} build on {key} failed: {e!r}"
+            return False
+        ok, detail = self._gate(cand)
+        if not ok:
+            try:
+                cand.close()
+            except Exception:  # noqa: BLE001 — teardown must not mask the verdict
+                pass
+            self.error = f"slot {i} rejected: {detail}"
+            return False
+        if self._stop or fleet._draining:
+            cand.close()
+            return False
+        fleet.engines[i] = cand  # atomic item swap: routers see old or new
+        fleet._current_keys[i] = key
+        fleet._slot_versions[i] = self.to_version
+        fleet.health.probe_ok(key)
+        fleet._observe_versions()
+        self._note(f"slot {i} shifted to {self.to_version} on {key}")
+        fleet._rollout_hold.discard(i)
+        return True
+
+    def _gate(self, candidate) -> tuple[bool, str]:
+        """Canary probe + shadow-traffic replay + the deterministic
+        ``rollout_canary_fail`` fault point. A candidate that fails any
+        of them never receives live traffic."""
+        fleet = self.fleet
+        if self._injector().take("rollout_canary_fail", fleet.label):
+            self._count_fault("rollout_canary_fail")
+            self.canary_fails += 1
+            return False, "injected rollout_canary_fail"
+        ok, detail = fleet._canary_check(candidate)
+        if not ok:
+            self.canary_fails += 1
+            return False, f"canary: {detail}"
+        if self.shadow_probes > 0:
+            # most recent distinct real prompts, bounded
+            seen: list[tuple] = []
+            for p in reversed(list(fleet._shadow_ring)):
+                if p not in seen:
+                    seen.append(p)
+                if len(seen) >= self.shadow_probes:
+                    break
+            if seen:
+                ok, detail = shadow_probe(candidate, seen)
+                if not ok:
+                    self.shadow_fails += 1
+                    return False, detail
+        return True, "ok"
+
+    # -- rollback ---------------------------------------------------------
+    def _rollback(self) -> None:
+        """Converge every slot back onto the retained old version. Slots
+        whose rebuild fails are left pointed at the old version for the
+        supervisor to converge (its _build_replica default is the
+        slot's recorded version) — the fleet NEVER ends wedged with two
+        versions in routing."""
+        fleet = self.fleet
+        self._set_state("rolling_back")
+        try:
+            for i in range(len(fleet.engines)):
+                if self._stop or fleet._draining:
+                    self._finish("aborted")
+                    return
+                eng = fleet.engines[i]
+                if eng.alive() and eng.version == self.from_version:
+                    continue
+                fleet._rollout_hold.add(i)
+                # record intent FIRST: even if this rebuild fails, the
+                # supervisor's next rebuild of the slot uses from_version
+                fleet._slot_versions[i] = self.from_version
+                if eng.alive():
+                    eng.drain()
+                    if not self._wait_drained(
+                        eng, time.perf_counter() + self.drain_timeout_s
+                    ):
+                        # rollback must CONVERGE (a wedged new-version
+                        # replica cannot block it forever), but its
+                        # in-flight requests deserve the failover rescue
+                        # a crash would get — _die hands them to the
+                        # router (same-version pin applies), where
+                        # close() would silently cancel them
+                        eng._die(
+                            "rollout rollback: replica failed to drain "
+                            f"within {self.drain_timeout_s:.0f}s"
+                        )
+                    eng.close()
+                picked = fleet._spec_for_rebuild(i)
+                if picked is None:
+                    self._note(f"rollback: slot {i} parked (no device)")
+                    continue
+                spec, key = picked
+                try:
+                    repl = fleet._build_replica(
+                        i, spec=spec, version=self.from_version
+                    )
+                except Exception as e:  # noqa: BLE001 — supervisor converges later
+                    self._note(f"rollback: slot {i} rebuild failed: {e!r}")
+                    continue
+                ok, detail = fleet._canary_check(repl)
+                if not ok:
+                    self._note(f"rollback: slot {i} canary: {detail}")
+                    try:
+                        repl.close()
+                    except Exception:  # noqa: BLE001
+                        pass
+                    continue
+                fleet.engines[i] = repl
+                fleet._current_keys[i] = key
+                fleet._observe_versions()
+                self._note(f"slot {i} rolled back to {self.from_version}")
+                fleet._rollout_hold.discard(i)
+            # drop the rejected version entirely: params freed, canary
+            # refs pruned, and a later deploy may reuse the label after
+            # fixing it
+            fleet._versions.pop(self.to_version, None)
+            fleet._canary_ref.pop(self.to_version, None)
+            fleet._observe_versions()
+            self._finish("rolled_back")
+        finally:
+            # every hold this controller still owns (kept across a
+            # failed shift, a failed rollback rebuild, or an abort) is
+            # released in one place: slots whose rebuild failed stay
+            # recorded on from_version, so the supervisor converges
+            # them on the OLD weights
+            fleet._rollout_hold.clear()
+
+    def _converge_after_crash(self) -> None:
+        if any(v != self.from_version for v in self.fleet._slot_versions):
+            self._rollback()
+
+
+class _EngineSwapRollout(_RolloutBase):
+    """Blue-green swap for a bare single engine: build the staged
+    version NEXT TO the serving engine (two weight copies resident for
+    the duration — the price of zero downtime without a second
+    replica), gate it, repoint the handle, drain the old engine, and
+    keep it alive through the bake window so a regression swaps back
+    instead of rebuilding."""
+
+    def __init__(self, handle, to_version: str, cfg, params, **kw):
+        super().__init__(
+            label=handle._engine.label, metrics=handle._metrics,
+            logger=handle._logger, **kw,
+        )
+        self.handle = handle
+        self.from_version = handle._engine.version
+        self.to_version = to_version
+        self._cfg, self._params = cfg, params
+        self._fault_injector = handle._build_kw.get("fault_injector")
+
+    def _run(self) -> None:
+        from ..llm import LLMEngine
+
+        handle = self.handle
+        old = handle._engine
+        self._set_state("shifting")
+        try:
+            cand = LLMEngine(
+                self._cfg, self._params,
+                version=self.to_version, **handle._build_kw,
+            )
+        except Exception as e:  # noqa: BLE001 — staged build failed; old keeps serving
+            self.error = f"build failed: {e!r}"
+            self._finish("rolled_back")
+            return
+        ok, detail = self._gate(cand)
+        if not ok:
+            self.error = detail
+            try:
+                cand.close()
+            except Exception:  # noqa: BLE001
+                pass
+            self._finish("rolled_back")
+            return
+        if self._stop:
+            cand.close()
+            self._finish("aborted")
+            return
+        # atomic repoint: new submissions land on the staged engine;
+        # in-flight requests finish on the old weights behind the drain
+        handle._engine = cand
+        old.drain()
+        self._note(f"swapped to {self.to_version}; old engine draining")
+        errored_base = cand.errored
+        self._set_state("baking")
+        regression = self._bake_watch(lambda: [cand], errored_base, 0)
+        if self._stop:
+            # teardown raced the bake: the staged engine is the serving
+            # one — retire the drained old engine instead of leaking its
+            # threads and device-resident weights
+            old.close()
+            self._finish("aborted")
+            return
+        if regression is not None:
+            self.error = regression
+            # swap BACK: the old engine is still alive and warm — reopen
+            # its admission and retire the regressed candidate
+            handle._engine = old
+            old.undrain()
+            cand.drain()
+            if not self._wait_drained(
+                cand, time.perf_counter() + self.drain_timeout_s
+            ):
+                # a bare engine has no failover to rescue into: bounded
+                # convergence wins over waiting forever, and the close
+                # is visible here and in the snapshot history
+                self._note(
+                    "regressed engine failed to drain; closing with "
+                    "in-flight work"
+                )
+            cand.close()
+            self._note(f"bake regression ({regression}); swapped back")
+            self._finish("rolled_back")
+            return
+        # committed: retire the old engine once its in-flight work ends
+        if not self._wait_drained(
+            old, time.perf_counter() + self.drain_timeout_s
+        ):
+            self._note(
+                "old engine failed to drain; closing with in-flight work"
+            )
+        old.close()
+        handle._cfg, handle._params = self._cfg, self._params
+        self._finish("completed")
+
+    def _gate(self, candidate) -> tuple[bool, str]:
+        from .health import canary_check
+
+        if self._injector().take("rollout_canary_fail", self.label):
+            self._count_fault("rollout_canary_fail")
+            self.canary_fails += 1
+            return False, "injected rollout_canary_fail"
+        # no same-version peer exists by construction: completeness +
+        # vocabulary judgment (the no-reference canary path)
+        ok, detail, _toks = canary_check(candidate)
+        if not ok:
+            self.canary_fails += 1
+            return False, f"canary: {detail}"
+        if self.shadow_probes > 0:
+            seen: list[tuple] = []
+            for p in reversed(list(self.handle._shadow_ring)):
+                if p not in seen:
+                    seen.append(p)
+                if len(seen) >= self.shadow_probes:
+                    break
+            if seen:
+                ok, detail = shadow_probe(candidate, seen)
+                if not ok:
+                    self.shadow_fails += 1
+                    return False, detail
+        return True, "ok"
+
+
+class ModelHandle:
+    """Versioned registry entry for one registered LLM — what
+    ``register_llm`` returns and ``ctx.tpu().llm(name)`` resolves.
+
+    Everything callers did with the raw engine keeps working: the
+    handle proxies attribute access to the live engine (submit,
+    generate, stats, debug_state, drain, stream consumption, replica
+    internals). On top it adds the model lifecycle:
+
+    - ``deploy(cfg, params, version=...)`` stages a new weight version
+      and shifts traffic with zero downtime (fleet: per-replica
+      blue-green via :class:`RolloutController`; bare engine:
+      build-gate-swap via the engine-swap rollout).
+    - ``rollout_state()`` / ``version`` for the admin route and
+      debug views.
+    """
+
+    def __init__(self, name: str, engine, *, cfg, params,
+                 build_kw: dict | None = None, logger=None, metrics=None):
+        self.name = name
+        self._engine = engine
+        self._cfg = cfg
+        self._params = params
+        self._build_kw = dict(build_kw or {})
+        self._logger = logger
+        self._metrics = metrics
+        self._lock = threading.Lock()
+        self._swap: _EngineSwapRollout | None = None
+        # single-engine shadow source (the fleet keeps its own ring)
+        self._shadow_ring: list = []
+
+    # -- engine surface ----------------------------------------------------
+    @property
+    def engine(self):
+        return self._engine
+
+    @property
+    def cfg(self):
+        """The ACTIVE version's config (a fleet retains one per version;
+        a bare engine carries its own)."""
+        eng = self._engine
+        if hasattr(eng, "_versions"):
+            return eng._versions[eng.version][0]
+        return eng.cfg
+
+    def __getattr__(self, item):
+        # only consulted when the handle itself lacks the attribute:
+        # the full engine surface flows through unchanged
+        return getattr(self._engine, item)
+
+    def submit(self, req):
+        eng = self._engine
+        out = eng.submit(req)
+        if not hasattr(eng, "_shadow_ring"):  # bare engine: handle-kept ring
+            self._shadow_ring.append(tuple(req.prompt_tokens[:32]))
+            del self._shadow_ring[:-8]
+        return out
+
+    def generate(self, prompt_tokens, **kw):
+        from ..llm import GenRequest
+
+        return self.submit(GenRequest(list(prompt_tokens), **kw)).tokens()
+
+    # -- model lifecycle ---------------------------------------------------
+    def deploy(self, cfg=None, params=None, *, version: str | None = None,
+               bake_s: float | None = None,
+               shadow_probes: int | None = None,
+               drain_timeout_s: float | None = None) -> dict:
+        """Stage new weights and shift traffic onto them with zero
+        downtime; see RolloutController / _EngineSwapRollout for the
+        two execution shapes. Validates the param tree against the
+        config BEFORE any device transfer (a bad checkpoint is a 4xx,
+        never a dead replica) and returns the rollout snapshot
+        immediately — progress is visible in ``rollout_state()``."""
+        eng = self._engine
+        if hasattr(eng, "deploy"):  # replicated fleet: its own controller
+            return eng.deploy(
+                cfg, params, version=version, bake_s=bake_s,
+                shadow_probes=shadow_probes, drain_timeout_s=drain_timeout_s,
+            )
+        from ..models.checkpoint import validate_params
+
+        if params is None:
+            raise RolloutError("deploy() needs params (the new weights)")
+        cfg = self._cfg if cfg is None else cfg
+        validate_params(params, cfg)
+        with self._lock:
+            if self._swap is not None and self._swap.active():
+                raise RolloutInProgress(
+                    f"rollout to {self._swap.to_version!r} already in "
+                    f"progress (state {self._swap.state})"
+                )
+            if version is None:
+                version = _next_version(eng.version)
+            if version == eng.version:
+                raise RolloutError(
+                    f"model version {version!r} is already active"
+                )
+            self._swap = _EngineSwapRollout(
+                self, version, cfg, params, bake_s=bake_s,
+                shadow_probes=shadow_probes, drain_timeout_s=drain_timeout_s,
+            )
+            self._swap.start()
+        return self._swap.snapshot()
+
+    def rollout_state(self) -> dict | None:
+        eng = self._engine
+        if hasattr(eng, "rollout_state"):
+            return eng.rollout_state()
+        return None if self._swap is None else self._swap.snapshot()
+
+    def close(self) -> None:
+        if self._swap is not None:
+            self._swap.close()
+        self._engine.close()
+
+
+def _next_version(current: str) -> str:
+    """v3 -> v4; anything unconventional gets a ``.next`` suffix rather
+    than a guessed number."""
+    import re
+
+    m = re.match(r"^v(\d+)$", current)
+    return f"v{int(m.group(1)) + 1}" if m else f"{current}.next"

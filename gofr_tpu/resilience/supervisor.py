@@ -105,7 +105,13 @@ class ReplicaSupervisor:
             return
         now = time.perf_counter()
         health = getattr(fleet, "health", None)
+        # slots the rollout controller holds are being drained/rebuilt ON
+        # PURPOSE (gofr_tpu.resilience.rollout): rebuilding one here
+        # would race the controller's close->build->gate->swap sequence
+        hold = getattr(fleet, "_rollout_hold", ())
         for i, eng in enumerate(list(fleet.engines)):
+            if i in hold:
+                continue
             if eng.alive():
                 if self._state.pop(i, None) is not None:
                     self._observe_slots()
@@ -188,6 +194,21 @@ class ReplicaSupervisor:
             # raced a close/drain: the fleet is going down — do not route
             # to (or leak) the replacement
             replacement.close()
+            return
+        if (
+            i in getattr(fleet, "_rollout_hold", ())
+            or fleet.engines[i].alive()
+        ):
+            # raced the rollout controller: the slot was (re)claimed —
+            # held for a shift/rollback, or already carrying a live
+            # engine the controller swapped in — while our multi-second
+            # build ran. Clobbering it would orphan a live engine
+            # (leaked threads + a full device-resident weight copy);
+            # discard ours instead. The controller holds the slot for
+            # its whole swap sequence, so this last check cannot pass
+            # mid-swap.
+            replacement.close()
+            self._state.pop(i, None)
             return
         fleet.engines[i] = replacement  # atomic item swap: routers see old or new
         fleet._current_keys[i] = key

@@ -177,6 +177,30 @@ def test_summary_line_carries_speculative():
     assert "speculative" not in bench._summary_line(_serving_result())
 
 
+def test_summary_line_carries_rollout():
+    """BENCH_r13+: the live weight-rollout point rides the summary as a
+    compact block (terminal state, error count, time-to-fully-shifted,
+    p99 delta during the shift)."""
+    r = _serving_result()
+    r["detail"]["rollout"] = {
+        "state": "completed", "requests": 4096, "errors": 0,
+        "time_to_fully_shifted_s": 41.2, "p99_before_ms": 180.0,
+        "p99_during_shift_ms": 252.0, "p99_shift_delta": 1.4,
+        "clients": 64, "replicas": 2,
+    }
+    s = bench._summary_line(r)
+    assert s["rollout"] == {
+        "state": "completed", "errors": 0,
+        "time_to_fully_shifted_s": 41.2, "p99_shift_delta": 1.4,
+    }
+    assert len(json.dumps(s)) < 1500
+    # absent block (--no-rollout / CPU runs) must not leak a key
+    assert "rollout" not in bench._summary_line(_serving_result())
+    # a skipped point (single-device host) must not leak either
+    r["detail"]["rollout"] = {"skipped": "needs >=2 devices"}
+    assert "rollout" not in bench._summary_line(r)
+
+
 def test_phase_breakdown_from_histogram_deltas():
     """p50/p99 come from the count DELTAS between two snapshots, so the
     SLO window is attributed without the warmup/probe traffic that also

@@ -312,8 +312,11 @@ class TestDPServing:
                 "tiny", cfg, params, replicas=2, slots=2, max_seq_len=32,
                 prefill_buckets=(8,), warmup=False,
             )
-            assert isinstance(eng, ReplicatedLLMEngine)
+            # register_llm returns the versioned ModelHandle (rollouts);
+            # the replicated engine sits behind it, full surface proxied
+            assert isinstance(eng.engine, ReplicatedLLMEngine)
             assert rt.llm("tiny") is eng
+            assert eng.version == "v1" and len(eng.engines) == 2
         finally:
             rt.close()
 

@@ -1,0 +1,783 @@
+"""Zero-downtime model rollouts (gofr_tpu.resilience.rollout): versioned
+registry, canary-gated blue-green shift, automatic rollback, mid-stream
+version pinning, checkpoint validation, and client-disconnect
+cancellation.
+
+The load-bearing invariants:
+
+- a live shift drops ZERO requests, and an in-flight stream finishes on
+  the weights it started on (the drained replica serves it to the end);
+- a stream is NEVER served tokens from two model versions — mid-stream
+  failover pins to a same-version replica while any exists, else errors
+  cleanly (mixed-version continuations are the silent-corruption case);
+- a canary/shadow rejection or a bake-window regression ends with the
+  fleet FULLY on the old version (never wedged mixed), with zero failed
+  requests along the way;
+- a bad checkpoint is a typed validation error BEFORE any device
+  transfer — never a dead replica;
+- version metrics are zeroed at close (the PR 3 dead-engine gauge
+  regression class).
+
+Every fault here is deterministic (gofr_tpu.resilience.faults);
+scripts/smoke_rollout.py drives a live POST /rollout over real sockets
+in CI."""
+
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gofr_tpu.llm import GenRequest, LLMEngine, ReplicatedLLMEngine
+from gofr_tpu.metrics import new_metrics_manager
+from gofr_tpu.models import TransformerConfig, generate, init_params
+from gofr_tpu.models.checkpoint import CheckpointValidationError, validate_params
+from gofr_tpu.resilience import FaultInjector
+from gofr_tpu.resilience.rollout import (
+    ModelHandle,
+    RolloutError,
+    RolloutInProgress,
+)
+
+CFG = TransformerConfig.tiny()
+
+ENGINE_KW = dict(
+    slots=2, max_seq_len=128, prefill_buckets=(8,), prefill_chunk=4,
+    step_token_budget=4, decode_chunk=2, lookahead=1, warmup=False,
+)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(jax.random.PRNGKey(0), CFG)
+
+
+@pytest.fixture(scope="module")
+def params_v2():
+    return init_params(jax.random.PRNGKey(1), CFG)
+
+
+def _reference(params, prompt, n):
+    toks = jnp.asarray([prompt], jnp.int32)
+    lens = jnp.asarray([len(prompt)], jnp.int32)
+    return [int(t) for t in np.asarray(generate(params, CFG, toks, lens, n))[0]]
+
+
+def _wait(pred, timeout: float, what: str = "condition") -> None:
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return
+        time.sleep(0.01)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def _fleet(params, inj=None, *, replicas=2, supervise=False, **kw):
+    merged = dict(ENGINE_KW)
+    merged.update(kw)
+    return ReplicatedLLMEngine(
+        CFG, params, replicas=replicas,
+        fault_injector=inj if inj is not None else FaultInjector(),
+        supervise=supervise, **merged,
+    )
+
+
+# a prompt whose greedy continuation DIFFERS between the v1 and v2
+# weight sets (the tiny random-init model mostly echoes the last
+# prompt token, so short prompts make versions indistinguishable;
+# asserted in test_shift_completes_and_old_stream_is_token_identical)
+PROMPT = list(range(1, 13))
+
+
+# ---------------------------------------------------------------------------
+# checkpoint validation (satellite): typed 4xx before any device transfer
+# ---------------------------------------------------------------------------
+class TestCheckpointValidation:
+    def test_matching_tree_passes(self, params):
+        validate_params(params, CFG)  # no raise
+
+    def test_shape_mismatch_names_path(self, params):
+        bad = jax.tree.map(lambda x: x, params)  # shallow-ish copy
+        bad = dict(bad, embed=np.zeros((3, 3), np.float32))
+        with pytest.raises(CheckpointValidationError) as ei:
+            validate_params(bad, CFG)
+        assert "embed" in str(ei.value)
+        assert ei.value.status_code == 400
+
+    def test_missing_leaf_rejected(self, params):
+        bad = {k: v for k, v in params.items() if k != "final_norm"}
+        with pytest.raises(CheckpointValidationError) as ei:
+            validate_params(bad, CFG)
+        assert "final_norm" in str(ei.value)
+
+    def test_extra_leaf_rejected(self, params):
+        bad = dict(params, bogus=np.zeros((2,), np.float32))
+        with pytest.raises(CheckpointValidationError) as ei:
+            validate_params(bad, CFG)
+        assert "bogus" in str(ei.value)
+
+    def test_dtype_mismatch_rejected(self, params):
+        bad = dict(params, embed=np.asarray(params["embed"], np.float16))
+        with pytest.raises(CheckpointValidationError) as ei:
+            validate_params(bad, CFG)
+        assert "dtype" in str(ei.value)
+
+    def test_untied_unembed_accepted(self, params):
+        untied = dict(params, unembed=np.asarray(params["embed"]))
+        validate_params(untied, CFG)  # no raise
+
+    def test_non_dict_rejected(self):
+        with pytest.raises(CheckpointValidationError):
+            validate_params([1, 2, 3], CFG)
+
+    def test_deploy_validates_before_any_engine_change(self, params):
+        rep = _fleet(params)
+        try:
+            before = [id(e) for e in rep.engines]
+            with pytest.raises(CheckpointValidationError):
+                rep.deploy(None, {"embed": np.zeros((2, 2))}, version="vX")
+            assert rep._rollout is None  # nothing staged
+            assert "vX" not in rep._versions
+            assert [id(e) for e in rep.engines] == before
+        finally:
+            rep.close()
+
+
+# ---------------------------------------------------------------------------
+# versioned registry basics
+# ---------------------------------------------------------------------------
+class TestVersionedRegistry:
+    def test_engine_carries_version_label(self, params):
+        eng = LLMEngine(CFG, params, version="v7", **ENGINE_KW)
+        try:
+            assert eng.version == "v7"
+            assert eng.stats()["version"] == "v7"
+            assert eng.debug_state()["version"] == "v7"
+        finally:
+            eng.close()
+
+    def test_fleet_views_and_duplicate_version_rejected(self, params, params_v2):
+        rep = _fleet(params)
+        try:
+            assert rep.version == "v1"
+            assert rep.version_counts() == {"v1": 2}
+            assert rep.stats()["versions"] == {"v1": 2}
+            assert rep.debug_state()["slot_versions"] == ["v1", "v1"]
+            with pytest.raises(RolloutError):
+                rep.deploy(None, params_v2, version="v1")
+        finally:
+            rep.close()
+
+    def test_concurrent_deploy_is_409(self, params, params_v2):
+        rep = _fleet(params)
+        try:
+            rep.deploy(None, params_v2, version="v2", bake_s=30.0,
+                       drain_timeout_s=30)
+            with pytest.raises(RolloutInProgress) as ei:
+                rep.deploy(None, params_v2, version="v3")
+            assert ei.value.status_code == 409
+            rep._rollout.close()
+        finally:
+            rep.close()
+
+    def test_derived_version_increments(self, params, params_v2):
+        rep = _fleet(params)
+        try:
+            assert rep._derive_version() == "v2"
+            rep._versions["v2"] = (CFG, params_v2)
+            assert rep._derive_version() == "v3"
+        finally:
+            rep.close()
+
+
+# ---------------------------------------------------------------------------
+# the live shift: zero-downtime, in-flight streams finish on old weights
+# ---------------------------------------------------------------------------
+class TestFleetRollout:
+    def test_shift_completes_and_old_stream_is_token_identical(
+        self, params, params_v2
+    ):
+        rep = _fleet(params)
+        try:
+            # the version checks below are only meaningful if the two
+            # weight sets actually answer differently on this prompt
+            assert _reference(params, PROMPT, 8) != _reference(
+                params_v2, PROMPT, 8
+            )
+            v1_ref = _reference(params, PROMPT, 32)
+            # long-running v1 stream, mid-decode when the shift begins
+            req = rep.submit(GenRequest(
+                PROMPT, max_new_tokens=32, temperature=0.0, eos_token=-1,
+            ))
+            it = req.stream(timeout=60)
+            got = [next(it) for _ in range(4)]
+            rep.deploy(None, params_v2, version="v2", bake_s=0.3,
+                       drain_timeout_s=60)
+            got.extend(it)  # finishes while the rollout drains/shifts
+            # in-flight work finished ON THE OLD WEIGHTS, token-identical
+            assert got == v1_ref
+            assert req.finish_reason == "length"
+            assert rep._rollout.wait(timeout=120) == "completed", (
+                rep.rollout_state()
+            )
+            assert rep.version == "v2"
+            assert all(e.version == "v2" for e in rep.engines)
+            assert rep.version_counts() == {"v2": 2}
+            assert sorted(rep._versions) == ["v2"]  # old params dropped
+            v2_out = rep.generate(
+                PROMPT, max_new_tokens=8, temperature=0.0, eos_token=-1
+            )
+            assert v2_out == _reference(params_v2, PROMPT, 8)
+        finally:
+            rep.close()
+
+    def test_continuous_traffic_sees_zero_failures_through_shift(
+        self, params, params_v2
+    ):
+        rep = _fleet(params)
+        failures, done = [], threading.Event()
+        v1_ref8 = _reference(params, PROMPT, 8)
+        v2_ref8 = _reference(params_v2, PROMPT, 8)
+
+        def client():
+            while not done.is_set():
+                try:
+                    out = rep.generate(
+                        PROMPT, max_new_tokens=8, temperature=0.0,
+                        eos_token=-1,
+                    )
+                    # every response is EXACTLY one version's greedy
+                    # output — a spliced stream would match neither
+                    if out not in (v1_ref8, v2_ref8):
+                        failures.append(("mixed", out))
+                except Exception as e:  # noqa: BLE001 — failures ARE the assertion
+                    failures.append(("error", repr(e)))
+
+        threads = [threading.Thread(target=client) for _ in range(3)]
+        try:
+            for t in threads:
+                t.start()
+            time.sleep(0.3)
+            rep.deploy(None, params_v2, version="v2", bake_s=0.5,
+                       drain_timeout_s=60)
+            assert rep._rollout.wait(timeout=120) == "completed", (
+                rep.rollout_state()
+            )
+            time.sleep(0.3)
+        finally:
+            done.set()
+            for t in threads:
+                t.join(timeout=60)
+            rep.close()
+        assert not failures, failures[:5]
+
+    def test_canary_fail_rolls_back_fully_v_old(self, params, params_v2):
+        inj = FaultInjector()
+        rep = _fleet(params, inj)
+        failures, done = [], threading.Event()
+        v1_ref8 = _reference(params, PROMPT, 8)
+
+        def client():
+            while not done.is_set():
+                try:
+                    out = rep.generate(
+                        PROMPT, max_new_tokens=8, temperature=0.0,
+                        eos_token=-1,
+                    )
+                    if out != v1_ref8:
+                        failures.append(("wrong", out))
+                except Exception as e:  # noqa: BLE001
+                    failures.append(("error", repr(e)))
+
+        t = threading.Thread(target=client)
+        try:
+            t.start()
+            inj.arm("rollout_canary_fail", count=1)
+            rep.deploy(None, params_v2, version="v2", bake_s=0.3,
+                       drain_timeout_s=60)
+            assert rep._rollout.wait(timeout=120) == "rolled_back", (
+                rep.rollout_state()
+            )
+        finally:
+            done.set()
+            t.join(timeout=60)
+        try:
+            # fully v_old: live replicas, active version, retained params
+            assert rep.version == "v1"
+            assert all(e.version == "v1" for e in rep.engines)
+            assert sorted(rep._versions) == ["v1"]
+            assert rep._rollout.canary_fails == 1
+            assert not failures, failures[:5]
+            assert rep.rollout_state()["error"] is not None
+        finally:
+            rep.close()
+
+    def test_bake_regression_rolls_back_fully_v_old(self, params, params_v2):
+        inj = FaultInjector()
+        rep = _fleet(params, inj)
+        failures, done = [], threading.Event()
+        v1_ref8 = _reference(params, PROMPT, 8)
+        v2_ref8 = _reference(params_v2, PROMPT, 8)
+
+        def client():
+            while not done.is_set():
+                try:
+                    out = rep.generate(
+                        PROMPT, max_new_tokens=8, temperature=0.0,
+                        eos_token=-1,
+                    )
+                    # during bake both versions legitimately serve; a
+                    # response must still be exactly ONE version's output
+                    if out not in (v1_ref8, v2_ref8):
+                        failures.append(("mixed", out))
+                except Exception as e:  # noqa: BLE001
+                    failures.append(("error", repr(e)))
+
+        t = threading.Thread(target=client)
+        try:
+            t.start()
+            inj.arm("rollout_bake_regression", count=1)
+            rep.deploy(None, params_v2, version="v2", bake_s=5.0,
+                       drain_timeout_s=60)
+            assert rep._rollout.wait(timeout=120) == "rolled_back", (
+                rep.rollout_state()
+            )
+        finally:
+            done.set()
+            t.join(timeout=60)
+        try:
+            assert rep.version == "v1"
+            assert all(e.version == "v1" for e in rep.engines)
+            assert sorted(rep._versions) == ["v1"]
+            assert not failures, failures[:5]
+            out = rep.generate(
+                PROMPT, max_new_tokens=8, temperature=0.0, eos_token=-1
+            )
+            assert out == v1_ref8
+        finally:
+            rep.close()
+
+
+    def test_canary_fail_rollback_with_live_supervisor(
+        self, params, params_v2, monkeypatch
+    ):
+        """The supervisor must not race the rollout controller: a failed
+        shift leaves the slot deliberately dead and HELD until rollback
+        rebuilds it — the supervisor neither rebuilds it on the wrong
+        version, bills the deliberate close to the device ledger, nor
+        clobbers the controller's rollback engine with its own."""
+        monkeypatch.setenv("TPU_LLM_SUPERVISOR_INTERVAL_S", "0.02")
+        monkeypatch.setenv("TPU_LLM_RESTART_BACKOFF_S", "0.02")
+        inj = FaultInjector()
+        rep = _fleet(params, inj, supervise=True)
+        try:
+            inj.arm("rollout_canary_fail", count=1)
+            rep.deploy(None, params_v2, version="v2", bake_s=0.3,
+                       drain_timeout_s=60)
+            assert rep._rollout.wait(timeout=120) == "rolled_back", (
+                rep.rollout_state()
+            )
+            _wait(
+                lambda: all(e.alive() for e in rep.engines), 30,
+                "all replicas alive",
+            )
+            assert all(e.version == "v1" for e in rep.engines)
+            assert rep._rollout_hold == set()
+            # the deliberate shift-close was never billed as a device
+            # failure (a quarantine for a failure that never happened)
+            assert rep.health.quarantines == 0
+            out = rep.generate(
+                PROMPT, max_new_tokens=8, temperature=0.0, eos_token=-1
+            )
+            assert out == _reference(params, PROMPT, 8)
+        finally:
+            rep.close()
+
+
+# ---------------------------------------------------------------------------
+# mid-stream version pinning: no stream ever mixes versions
+# ---------------------------------------------------------------------------
+class TestVersionPinning:
+    def _mixed_fleet(self, params, params_v2, inj, replicas):
+        """Fleet with slot 0 manually shifted to v2 (controller-free so
+        the mixed state is stable for the kill timing)."""
+        rep = _fleet(params, inj, replicas=replicas)
+        rep._versions["v2"] = (CFG, params_v2)
+        old0 = rep.engines[0]
+        old0.drain()
+        _wait(old0.drained, 30, "replica 0 drained")
+        old0.close()
+        rep.engines[0] = rep._build_replica(0, version="v2")
+        rep._slot_versions[0] = "v2"
+        return rep
+
+    def test_mid_decode_kill_with_no_same_version_survivor_errors_cleanly(
+        self, params, params_v2
+    ):
+        inj = FaultInjector()
+        rep = self._mixed_fleet(params, params_v2, inj, replicas=2)
+        try:
+            v1_ref = _reference(params, PROMPT, 24)
+            req = rep.engines[1].submit(GenRequest(
+                PROMPT, max_new_tokens=24, temperature=0.0, eos_token=-1,
+            ))
+            toks = []
+            for tok in req.stream(timeout=60):
+                toks.append(tok)
+                if len(toks) == 4:
+                    inj.arm("replica_kill", label="/r1")
+            # the ONLY v1 replica died mid-decode; a v2 replica is live
+            # and accepting — failover must refuse it (mixed-version
+            # continuation) and error the stream cleanly instead
+            assert req.finish_reason == "error"
+            assert toks == v1_ref[: len(toks)], "stream mixed versions"
+            assert len(toks) < 24
+            assert rep.failover_errors == 1
+        finally:
+            rep.close()
+
+    def test_mid_decode_kill_pins_to_same_version_survivor(
+        self, params, params_v2
+    ):
+        inj = FaultInjector()
+        rep = self._mixed_fleet(params, params_v2, inj, replicas=3)
+        try:
+            v1_ref = _reference(params, PROMPT, 24)
+            req = rep.engines[1].submit(GenRequest(
+                PROMPT, max_new_tokens=24, temperature=0.0, eos_token=-1,
+            ))
+            toks = []
+            for tok in req.stream(timeout=60):
+                toks.append(tok)
+                if len(toks) == 4:
+                    inj.arm("replica_kill", label="/r1")
+            # a v1 survivor exists (replica 2): the continuation pins to
+            # it and the greedy stream is token-identical end to end
+            assert toks == v1_ref
+            assert req.finish_reason == "length"
+            assert rep.failovers == 1
+        finally:
+            rep.close()
+
+
+# ---------------------------------------------------------------------------
+# client-disconnect cancellation (satellite)
+# ---------------------------------------------------------------------------
+class TestDisconnectCancel:
+    def test_abandoned_stream_frees_slot_and_credits_load(self, params):
+        m = new_metrics_manager()
+        eng = LLMEngine(CFG, params, metrics=m, **ENGINE_KW)
+        try:
+            req = eng.submit(GenRequest(
+                [1, 2, 3], max_new_tokens=64, eos_token=-1,
+            ))
+            it = req.stream(timeout=30)
+            next(it)
+            it.close()  # consumer vanishes (the edges do exactly this)
+            _wait(
+                lambda: req.finish_reason is not None, 15, "finish_reason"
+            )
+            assert req.finish_reason == "disconnect"
+            _wait(lambda: eng.stats()["active"] == 0, 15, "slot freed")
+            assert eng.load_tokens() == 0
+            assert eng.stats()["disconnect_cancels"] == 1
+            assert (
+                'app_llm_disconnect_cancels_total{model="llm"} 1'
+                in m.render_prometheus()
+            )
+            # engine still serves: the slot really was freed
+            out = eng.generate(
+                PROMPT, max_new_tokens=4, temperature=0.0, eos_token=-1
+            )
+            assert len(out) == 4
+        finally:
+            eng.close()
+
+    def test_completed_stream_is_not_a_disconnect(self, params):
+        eng = LLMEngine(CFG, params, **ENGINE_KW)
+        try:
+            req = eng.submit(GenRequest(
+                PROMPT, max_new_tokens=4, temperature=0.0, eos_token=-1,
+            ))
+            assert len(req.tokens(timeout=30)) == 4
+            assert req.finish_reason == "length"
+            assert eng.stats()["disconnect_cancels"] == 0
+        finally:
+            eng.close()
+
+    def test_http_peer_close_cancels_generation(self, params):
+        import json
+        import socket
+
+        from gofr_tpu import App, StreamingResponse
+        from gofr_tpu.config import new_mock_config
+
+        app = App(config=new_mock_config({
+            "APP_NAME": "disc", "HTTP_PORT": "0", "METRICS_PORT": "0",
+            "LOG_LEVEL": "ERROR", "TPU_TELEMETRY_INTERVAL_S": "0",
+            "REQUEST_TIMEOUT": "30",
+        }))
+        app.container.tpu().register_llm("tiny", CFG, params, **ENGINE_KW)
+
+        async def stream(ctx):
+            body = ctx.bind()
+            req = ctx.tpu().llm("tiny").submit(GenRequest(
+                list(body["tokens"]), max_new_tokens=500, eos_token=-1,
+            ))
+
+            async def chunks():
+                async for tok in req.astream():
+                    yield (json.dumps({"token": tok}) + "\n").encode()
+
+            return StreamingResponse(chunks())
+
+        app.post("/stream", stream)
+        app.run_in_background()
+        eng = app.container.tpu().llm("tiny")
+        try:
+            body = json.dumps({"tokens": [1, 2, 3]}).encode()
+            s = socket.create_connection(("127.0.0.1", app.http_server.port))
+            s.sendall(
+                b"POST /stream HTTP/1.1\r\nHost: x\r\n"
+                b"Content-Length: %d\r\n\r\n%s" % (len(body), body)
+            )
+            assert s.recv(4096)  # headers + first chunks flowing
+            time.sleep(0.2)
+            s.close()  # peer vanishes mid-stream
+            _wait(
+                lambda: eng.stats()["disconnect_cancels"] == 1, 20,
+                "disconnect cancel",
+            )
+            _wait(lambda: eng.stats()["active"] == 0, 15, "slot freed")
+        finally:
+            app.shutdown()
+
+    def test_grpc_client_cancel_cancels_generation(self, params):
+        import json
+
+        import grpc
+
+        from gofr_tpu import App
+        from gofr_tpu.config import new_mock_config
+
+        app = App(config=new_mock_config({
+            "APP_NAME": "discg", "HTTP_PORT": "0", "METRICS_PORT": "0",
+            "GRPC_PORT": "0", "LOG_LEVEL": "ERROR",
+            "TPU_TELEMETRY_INTERVAL_S": "0",
+        }))
+        app.container.tpu().register_llm("tiny", CFG, params, **ENGINE_KW)
+
+        async def stream(ctx):
+            body = ctx.bind()
+            req = ctx.tpu().llm("tiny").submit(GenRequest(
+                list(body["tokens"]), max_new_tokens=500, eos_token=-1,
+            ))
+            async for tok in req.astream():
+                yield {"token": tok}
+
+        app.grpc_server_stream("Tiny", "Stream", stream)
+        app.run_in_background()
+        eng = app.container.tpu().llm("tiny")
+        channel = grpc.insecure_channel(
+            f"127.0.0.1:{app.grpc_server.port}"
+        )
+        try:
+            fn = channel.unary_stream(
+                "/Tiny/Stream",
+                request_serializer=lambda b: b,
+                response_deserializer=lambda b: b,
+            )
+            call = fn(json.dumps({"tokens": [1, 2, 3]}).encode())
+            json.loads(next(call))  # stream is live
+            call.cancel()  # context done
+            _wait(
+                lambda: eng.stats()["disconnect_cancels"] == 1, 20,
+                "disconnect cancel",
+            )
+            _wait(lambda: eng.stats()["active"] == 0, 15, "slot freed")
+        finally:
+            channel.close()
+            app.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# single-engine blue-green swap (ModelHandle without a fleet)
+# ---------------------------------------------------------------------------
+class TestSingleEngineSwap:
+    def _handle(self, params, **kw):
+        merged = dict(ENGINE_KW)
+        merged.update(kw)
+        eng = LLMEngine(CFG, params, **merged)
+        return ModelHandle("tiny", eng, cfg=CFG, params=params,
+                           build_kw=merged)
+
+    def test_swap_deploy_serves_new_weights_zero_downtime(
+        self, params, params_v2
+    ):
+        h = self._handle(params)
+        try:
+            v1_out = h.generate(
+                PROMPT, max_new_tokens=8, temperature=0.0, eos_token=-1
+            )
+            assert v1_out == _reference(params, PROMPT, 8)
+            h.deploy(None, params_v2, bake_s=0.3)
+            # submissions keep succeeding throughout the swap
+            while h._swap.active():
+                out = h.generate(
+                    PROMPT, max_new_tokens=4, temperature=0.0, eos_token=-1
+                )
+                assert len(out) == 4
+            assert h._swap.state == "completed", h.rollout_state()
+            assert h.version == "v2"
+            assert h.generate(
+                PROMPT, max_new_tokens=8, temperature=0.0, eos_token=-1
+            ) == _reference(params_v2, PROMPT, 8)
+        finally:
+            h.close()
+
+    def test_swap_canary_fail_keeps_old_engine(self, params, params_v2):
+        inj = FaultInjector()
+        h = self._handle(params, fault_injector=inj)
+        try:
+            inj.arm("rollout_canary_fail", count=1)
+            h.deploy(None, params_v2, bake_s=0.2)
+            assert h._swap.wait(timeout=120) == "rolled_back", (
+                h.rollout_state()
+            )
+            assert h.version == "v1"
+            assert h.generate(
+                PROMPT, max_new_tokens=8, temperature=0.0, eos_token=-1
+            ) == _reference(params, PROMPT, 8)
+        finally:
+            h.close()
+
+    def test_swap_bake_regression_swaps_back(self, params, params_v2):
+        inj = FaultInjector()
+        h = self._handle(params, fault_injector=inj)
+        try:
+            inj.arm("rollout_bake_regression", count=1)
+            h.deploy(None, params_v2, bake_s=5.0)
+            assert h._swap.wait(timeout=120) == "rolled_back", (
+                h.rollout_state()
+            )
+            # the ORIGINAL engine serves again (swap back, not rebuild)
+            assert h.version == "v1"
+            assert h.generate(
+                PROMPT, max_new_tokens=8, temperature=0.0, eos_token=-1
+            ) == _reference(params, PROMPT, 8)
+        finally:
+            h.close()
+
+
+# ---------------------------------------------------------------------------
+# observability: version metrics zeroed at close (PR 3 regression class)
+# ---------------------------------------------------------------------------
+class TestVersionMetrics:
+    def test_version_rows_and_rollout_state_zero_after_close(
+        self, params, params_v2
+    ):
+        m = new_metrics_manager()
+        rep = _fleet(params, metrics=m)
+        rep.deploy(None, params_v2, version="v2", bake_s=0.2,
+                   drain_timeout_s=60)
+        assert rep._rollout.wait(timeout=120) == "completed"
+        expo = m.render_prometheus()
+        assert 'app_llm_model_version_info{model="llm",version="v2"} 2' in expo
+        assert 'app_llm_rollouts_completed_total{model="llm"} 1' in expo
+        rep.close()
+        expo = m.render_prometheus()
+        for line in expo.splitlines():
+            if line.startswith("#"):
+                continue
+            if line.startswith(
+                ("app_llm_model_version_info", "app_llm_rollout_state")
+            ):
+                assert line.rsplit(" ", 1)[1] == "0", line
+
+    def test_wide_event_carries_model_version(self, params):
+        class Capture:
+            def __init__(self):
+                self.events = []
+
+            def info(self, msg):
+                if isinstance(msg, dict):
+                    self.events.append(msg)
+
+            def warn(self, msg):
+                pass
+
+            def error(self, msg):
+                pass
+
+            def debug(self, msg):
+                pass
+
+        log = Capture()
+        eng = LLMEngine(CFG, params, logger=log, version="v9", **ENGINE_KW)
+        try:
+            eng.generate(PROMPT, max_new_tokens=4, eos_token=-1)
+            _wait(
+                lambda: any(
+                    e.get("event") == "llm_request" for e in log.events
+                ),
+                15, "wide event",
+            )
+            ev = next(
+                e for e in log.events if e.get("event") == "llm_request"
+            )
+            assert ev["model_version"] == "v9"
+        finally:
+            eng.close()
+
+
+# ---------------------------------------------------------------------------
+# admin route plumbing (the full live-socket shift runs in
+# scripts/smoke_rollout.py; here: the 4xx contracts and the GET view)
+# ---------------------------------------------------------------------------
+class TestAdminRoute:
+    def test_post_contracts_and_get_view(self, params):
+        import json
+        import urllib.error
+        import urllib.request
+
+        from gofr_tpu import App
+        from gofr_tpu.config import new_mock_config
+
+        app = App(config=new_mock_config({
+            "APP_NAME": "radm", "HTTP_PORT": "0", "METRICS_PORT": "0",
+            "LOG_LEVEL": "ERROR", "TPU_TELEMETRY_INTERVAL_S": "0",
+            "REQUEST_TIMEOUT": "30",
+        }))
+        app.container.tpu().register_llm("tiny", CFG, params, **ENGINE_KW)
+        app.run_in_background()
+        base = f"http://127.0.0.1:{app.http_server.port}"
+
+        def post(body):
+            req = urllib.request.Request(
+                base + "/.well-known/debug/rollout",
+                data=json.dumps(body).encode(),
+                headers={"Content-Type": "application/json"}, method="POST",
+            )
+            try:
+                with urllib.request.urlopen(req, timeout=60) as r:
+                    return r.status, json.loads(r.read())
+            except urllib.error.HTTPError as e:
+                return e.code, json.loads(e.read())
+
+        try:
+            code, body = post({"model": "tiny", "checkpoint": "/nope"})
+            assert code == 400, (code, body)
+            code, body = post({"model": "ghost", "checkpoint": "/nope"})
+            assert code == 404, (code, body)
+            code, body = post({"checkpoint": "/nope"})
+            assert code == 400, (code, body)
+            with urllib.request.urlopen(
+                base + "/.well-known/debug/rollout", timeout=10
+            ) as r:
+                view = json.loads(r.read())["data"]
+            assert view["models"]["tiny"]["version"] == "v1"
+            assert view["models"]["tiny"]["versions"] == {"v1": 1}
+        finally:
+            app.shutdown()
