@@ -761,6 +761,16 @@ def bench_serving(args) -> dict:
             args, cfg, eng.params if quantize else params, quantize
         )
 
+    # sessions operating point (BENCH_r14+): paged-vs-contiguous decode
+    # tok/s (incl. the int8-KV variant), HBM bytes per idle multi-turn
+    # session vs slot residency, and cold-resume-from-host latency vs
+    # full re-prefill (gofr_tpu.kvcache.paged / sessions;
+    # docs/advanced-guide/kv-cache.md)
+    if on_tpu and not args.no_sessions:
+        detail["sessions"] = _bench_sessions(
+            args, cfg, eng.params if quantize else params, quantize
+        )
+
     # prefix-cache operating point: 50% shared-prefix traffic — hits skip
     # the prefill wave entirely, so the engine can exceed the NO-CACHE
     # device ceiling (per-request prefill is the larger serial share at
@@ -1141,6 +1151,159 @@ def _bench_prefix_cache(args, cfg, params, quantize: bool, ceiling_qps: float) -
     finally:
         eng.close()
     return point
+
+
+def _bench_sessions(args, cfg, params, quantize: bool) -> dict:
+    """Sessions point (BENCH_r14+): the paged KV pool's "millions of
+    users" memory model (gofr_tpu.kvcache.paged/sessions).
+
+    Three sub-measurements:
+
+    - **paged vs contiguous decode tok/s** on a decode-heavy closed run
+      (same shapes, kv_paged A/B), plus the int8-KV variant — the paged
+      read path must hold the contiguous path's throughput while buying
+      the sharing below.
+    - **multi-turn residency**: N conversations (50% sharing one
+      system-prefix, so sibling turns block-share it) each run a turn
+      and go idle; the adjudicated number is HBM bytes per IDLE session
+      (pool blocks, radix-deduplicated) vs what slot residency would
+      cost — parking each conversation in a slot slab.
+    - **cold resume**: sessions spilled to host, then one resumed —
+      second-turn latency from the host tier vs the full re-prefill the
+      same turn pays without a session. Restore is one DMA per block;
+      re-prefill is a forward pass per token.
+    """
+    from gofr_tpu.llm import GenRequest, LLMEngine
+
+    S = args.prefill_len
+    K = args.decode_chunk
+    new_tokens = args.new_tokens
+    max_seq = 2 * S + 2 * new_tokens + 4 * K
+
+    # -- paged vs contiguous decode tokens/s (+ int8 variant) -------------
+    dec_tokens = max(4 * args.new_tokens, 64)
+
+    def tok_s(paged: bool, int8: bool = False) -> float:
+        eng = LLMEngine(
+            cfg, params, slots=min(args.batch, 64),
+            max_seq_len=S + dec_tokens + 2 * K,
+            prefill_buckets=(S,), decode_chunk=K,
+            admit_cap=args.admit_cap, quantize=quantize,
+            kv_paged=paged, kv_int8=int8,
+        )
+        try:
+            _closed_loop(eng, cfg, S - 8, 8, 16, 16)  # warm
+            p = _closed_loop(
+                eng, cfg, S - 8, dec_tokens, min(args.batch, 64) * 2, 64,
+            )
+            return p["qps"] * dec_tokens
+        finally:
+            eng.close()
+
+    paged_tok_s = tok_s(True)
+    contig_tok_s = tok_s(False)
+    int8_tok_s = tok_s(True, int8=True)
+
+    # -- multi-turn residency + cold resume -------------------------------
+    n_sessions = 32
+    eng = LLMEngine(
+        cfg, params, slots=16, max_seq_len=max_seq,
+        prefill_buckets=(S,), decode_chunk=K, admit_cap=args.admit_cap,
+        quantize=quantize, session_mb=4096.0, prefix_cache_mb=64.0,
+    )
+    try:
+        rng = np.random.default_rng(5)
+        sys_prefix = rng.integers(1, cfg.vocab_size, S // 2).tolist()
+        convs = []
+        for i in range(n_sessions):
+            own = rng.integers(
+                1, cfg.vocab_size, S - 8 - (len(sys_prefix) if i % 2 else 0)
+            ).tolist()
+            convs.append((sys_prefix + own) if i % 2 else own)
+
+        def turn(sid: str, prompt: list[int]) -> tuple[list[int], float, float]:
+            t0 = time.perf_counter()
+            req = eng.submit(GenRequest(
+                prompt, max_new_tokens=new_tokens, session_id=sid,
+            ))
+            toks, first = [], None
+            for t in req.stream(timeout=600):
+                if first is None:
+                    first = time.perf_counter() - t0
+                toks.append(t)
+            return toks, first, time.perf_counter() - t0
+
+        outs = [turn(f"s{i}", convs[i]) for i in range(n_sessions)]
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            st = eng.kv.sessions.stats()
+            if st["publishes"] >= n_sessions:
+                break
+            time.sleep(0.05)
+        st = eng.kv.sessions.stats()
+        kvs = eng.kv.stats()
+        # idle-session residency: pool bytes pinned by sessions (radix
+        # dedups the 50% shared prefix) vs parking each conversation in
+        # a full slot slab (what pre-paging "keep it warm" would cost)
+        per_session = st["resident_bytes"] / max(1, st["resident"])
+        row_bytes = kvs["block_bytes"] / eng.kv.block
+        slot_equiv = row_bytes * eng.max_seq_len
+        # first-turn TTFT baseline, then the warm second turn (resident
+        # blocks -> block-granular prefix hit on the whole history)
+        first_ttfts = [o[1] for o in outs]
+        warm2 = []
+        for i in range(0, n_sessions, 8):
+            t2 = convs[i] + outs[i][0] + [7, 8, 9]
+            warm2.append(turn(f"s{i}", t2)[1])
+        # cold resume: spill EVERYTHING, then resume one session — the
+        # restore is h2d DMA + prefill of only the unshared tail, vs the
+        # sessionless full re-prefill of the same prompt
+        eng.kv.sessions.device_budget = 1
+        eng._kick.set()
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            if eng.kv.sessions.stats()["resident"] == 0:
+                break
+            time.sleep(0.05)
+        eng.kv.sessions.device_budget = 4096 * 2**20
+        spilled = eng.kv.sessions.stats()
+        # warm the restore executable (first call compiles the h2d
+        # scatter for this session width) on a DIFFERENT session, then
+        # time the adjudicated resume
+        warm_t2 = convs[4] + outs[4][0] + [11, 12, 13]
+        turn("s4", warm_t2)
+        j = 2
+        t2 = convs[j] + outs[j][0] + [11, 12, 13]
+        _, resume_ttft, resume_total = turn(f"s{j}", t2)
+        _, cold_ttft, cold_total = turn("", t2 + [14])  # sessionless: full prefill
+        return {
+            "paged_tok_s": round(paged_tok_s, 0),
+            "contig_tok_s": round(contig_tok_s, 0),
+            "paged_vs_contig": round(paged_tok_s / max(1e-9, contig_tok_s), 3),
+            "int8_tok_s": round(int8_tok_s, 0),
+            "int8_vs_contig": round(int8_tok_s / max(1e-9, contig_tok_s), 3),
+            "sessions": n_sessions,
+            "shared_frac": 0.5,
+            "hbm_bytes_per_idle_session": int(per_session),
+            "slot_equiv_bytes": int(slot_equiv),
+            "idle_session_vs_slot": round(per_session / max(1, slot_equiv), 3),
+            "blocks_shared": kvs["blocks_shared"],
+            "first_turn_ttft_ms": round(
+                1e3 * float(np.median(first_ttfts)), 1
+            ),
+            "second_turn_ttft_ms": round(1e3 * float(np.median(warm2)), 1),
+            "spilled_sessions": spilled["spilled"],
+            "spilled_mb": round(
+                spilled["offload"]["spilled_bytes"] / 2**20, 1
+            ),
+            "cold_resume_ttft_ms": round(1e3 * resume_ttft, 1),
+            "reprefill_ttft_ms": round(1e3 * cold_ttft, 1),
+            "resume_vs_reprefill": round(
+                resume_ttft / max(1e-9, cold_ttft), 3
+            ),
+        }
+    finally:
+        eng.close()
 
 
 def _bench_speculative(args, cfg, params, quantize: bool) -> dict:
@@ -1646,6 +1809,10 @@ def main() -> None:
                     help="skip the 4k-prompt sliding-window operating point")
     ap.add_argument("--no-prefix-cache", action="store_true",
                     help="skip the 50%%-shared-prefix prefix-cache point")
+    ap.add_argument("--no-sessions", action="store_true",
+                    help="skip the sessions point (paged KV pool: "
+                         "bytes/idle-session, cold resume, paged vs "
+                         "contiguous tok/s)")
     ap.add_argument("--no-spec", action="store_true",
                     help="skip the speculative-decoding point (spec-on vs "
                          "spec-off tokens/s + acceptance rate)")
@@ -1750,6 +1917,17 @@ def _summary_line(result: dict) -> dict:
         pc = d["prefix_cache"]
         s["prefix_cache_qps"] = pc.get("qps")
         s["prefix_vs_ceiling"] = pc.get("qps_vs_no_cache_ceiling")
+    if d.get("sessions"):  # BENCH_r14+: paged KV pool + session tier
+        se = d["sessions"]
+        s["sessions"] = {
+            "paged_vs_contig": se.get("paged_vs_contig"),
+            "int8_vs_contig": se.get("int8_vs_contig"),
+            "idle_session_vs_slot": se.get("idle_session_vs_slot"),
+            "hbm_bytes_per_idle_session": se.get("hbm_bytes_per_idle_session"),
+            "second_turn_ttft_ms": se.get("second_turn_ttft_ms"),
+            "cold_resume_ttft_ms": se.get("cold_resume_ttft_ms"),
+            "resume_vs_reprefill": se.get("resume_vs_reprefill"),
+        }
     if d.get("speculative"):  # BENCH_r12+: spec-on vs spec-off decode
         sp = d["speculative"]
         s["speculative"] = {

@@ -44,6 +44,25 @@ def _spec_kw() -> dict:
     return kw
 
 
+def _session_kw() -> dict:
+    """Session-tier kwargs from LLM_SESSION_MB / LLM_KV_PAGED — only
+    the keys the operator actually set, so register_llm's app-config
+    defaulting (TPU_LLM_SESSION_MB / TPU_LLM_KV_PAGED) still applies
+    when unset. With a session budget, X-GoFr-Session conversations
+    keep their KV blocks warm between turns
+    (docs/advanced-guide/kv-cache.md#sessions)."""
+    kw: dict = {}
+    mb = float(os.environ.get("LLM_SESSION_MB", "0") or 0.0)
+    if mb > 0:
+        kw["session_mb"] = mb
+    v = os.environ.get("LLM_KV_PAGED", "").lower()
+    if v in ("1", "true"):
+        kw["kv_paged"] = True
+    elif v in ("0", "false"):
+        kw["kv_paged"] = False
+    return kw
+
+
 def build_engine(app):
     global TOKENIZER
     import jax
@@ -118,6 +137,11 @@ def build_engine(app):
         # prefix_cache_mb precedent below); an explicit LLM_SPEC=0 still
         # forces OFF even when the fleet-wide config knob is on.
         **_spec_kw(),
+        # LLM_SESSION_MB>0: the paged session tier — X-GoFr-Session
+        # conversations keep their KV blocks resident between turns
+        # (spilled to host RAM when cold), so every follow-up turn
+        # block-shares the whole history instead of re-prefilling it
+        **_session_kw(),
         # prefix_cache_mb is NOT passed here: register_llm defaults it
         # from the documented TPU_LLM_PREFIX_CACHE_MB config knob
         # (docs/references/configs.md). Set it >0 to retain prefill KV

@@ -264,6 +264,14 @@ class TPURuntime:
         # docs/advanced-guide/speculative-decoding.md
         self.default_llm_spec = get("TPU_LLM_SPEC", "")
         self.default_llm_spec_draft = get("TPU_LLM_SPEC_DRAFT", "")
+        # paged KV pool knobs (gofr_tpu.kvcache.paged; "" = engine
+        # defaults, which read the same names as process env vars) —
+        # docs/advanced-guide/kv-cache.md
+        self.default_llm_kv_paged = get("TPU_LLM_KV_PAGED", "")
+        self.default_llm_kv_block = get("TPU_LLM_KV_BLOCK", "")
+        self.default_llm_kv_int8 = get("TPU_LLM_KV_INT8", "")
+        self.default_llm_session_mb = get("TPU_LLM_SESSION_MB", "")
+        self.default_llm_host_cache_mb = get("TPU_LLM_HOST_CACHE_MB", "")
         # resilience knobs (gofr_tpu.resilience): step-watchdog threshold
         # seconds ("" = engine default, which reads the same env var; 0
         # disables) and the numerical watchdog gate ("" = engine default,
@@ -444,9 +452,13 @@ class TPURuntime:
         `replicas=N` (or `devices=[...]` / `meshes=[(mesh, specs), ...]`)
         for data-parallel replicated serving — N independent engines with
         a per-request router behind the same handle (SURVEY §2.8 row 1).
-        KV layout/residency policy (rolling window caches, prefix reuse)
-        comes from gofr_tpu.kvcache; `prefix_cache_mb` defaults to the
-        TPU_LLM_PREFIX_CACHE_MB config knob, and the token-budget step
+        KV layout/residency policy comes from gofr_tpu.kvcache: the
+        block-paged pool with radix prefix sharing by default
+        (TPU_LLM_KV_PAGED/TPU_LLM_KV_BLOCK/TPU_LLM_KV_INT8), the
+        X-GoFr-Session conversation tier with host offload
+        (TPU_LLM_SESSION_MB/TPU_LLM_HOST_CACHE_MB), and `prefix_cache_mb`
+        defaulting to the TPU_LLM_PREFIX_CACHE_MB config knob
+        (docs/advanced-guide/kv-cache.md); the token-budget step
         scheduler honors TPU_LLM_STEP_TOKEN_BUDGET / TPU_LLM_PREFILL_CHUNK
         (docs/advanced-guide/scheduling.md). Speculative decoding — a
         host-side n-gram drafter with fused on-device verification,
@@ -495,6 +507,28 @@ class TPURuntime:
         if self.default_llm_numeric_check != "":
             engine_kw.setdefault(
                 "numeric_check", self.default_llm_numeric_check != "0"
+            )
+        # paged KV pool / session-tier knobs (docs/advanced-guide/kv-cache.md)
+        if self.default_llm_kv_paged != "":
+            # "1" means AUTO exactly like the process-env knob (windowed
+            # models keep the rolling ring unless sessions/kv_paged=True
+            # opt in) — the two configuration surfaces must not resolve
+            # the same value to different layouts
+            engine_kw.setdefault(
+                "kv_paged",
+                False if self.default_llm_kv_paged == "0" else "auto",
+            )
+        if self.default_llm_kv_block != "":
+            engine_kw.setdefault("kv_block", int(self.default_llm_kv_block))
+        if self.default_llm_kv_int8 != "":
+            engine_kw.setdefault("kv_int8", self.default_llm_kv_int8 != "0")
+        if self.default_llm_session_mb != "":
+            engine_kw.setdefault(
+                "session_mb", float(self.default_llm_session_mb)
+            )
+        if self.default_llm_host_cache_mb != "":
+            engine_kw.setdefault(
+                "host_cache_mb", float(self.default_llm_host_cache_mb)
             )
         engine_kw.setdefault("kv_label", name)  # metric-series label
         engine_kw.setdefault("tracer", self.tracer)  # lifecycle spans

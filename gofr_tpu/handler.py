@@ -96,6 +96,14 @@ def llm_request_kwargs(ctx: Context) -> dict:
       a credential disclosure), then the peer address (portless, so one
       busy host's ephemeral ports don't fan into thousands of ledger
       rows).
+    - ``session_id``: the ``X-GoFr-Session`` conversation id
+      (docs/advanced-guide/kv-cache.md#sessions) — the paged KV pool
+      keeps the finished turn's blocks resident (or host-spilled) under
+      this id, so the next turn's prompt block-shares the whole history
+      instead of re-prefilling it; the replicated router pins the id to
+      the replica holding the blocks. Empty = sessionless. The session's
+      tokens still bill the fairness ledger through ``client`` as usual
+      (a shared-prefix hit discounts device work, never accounting).
 
     Works over both edges: HTTP headers and gRPC metadata both surface
     through ``ctx.header`` (grpc-gemma's handlers pass these straight
@@ -131,6 +139,7 @@ def llm_request_kwargs(ctx: Context) -> dict:
     return {
         "priority": (hdr("X-GoFr-Priority") or "interactive").lower(),
         "client": client,
+        "session_id": hdr("X-GoFr-Session"),
     }
 
 

@@ -37,13 +37,15 @@ end-to-end, prefix caching — VERDICT r5 levers #1 and #9).
 
 from __future__ import annotations
 
+import os
 import threading
+import time
 from collections import Counter, OrderedDict
-from typing import Any
+from typing import Any, NamedTuple
 
 import numpy as np
 
-__all__ = ["CacheManager", "PrefixCache", "ring_pack"]
+__all__ = ["CacheManager", "PrefixCache", "ring_pack", "SeedPlan"]
 
 # Serializes metric registration across CacheManagers: ReplicatedLLMEngine
 # builds N engines on parallel threads, and a bare has()/new_* pair racing
@@ -289,24 +291,67 @@ class PrefixCache:
             }
 
 
+class SeedPlan(NamedTuple):
+    """Admission-time radix consult result (paged layout)."""
+
+    blocks: list  # shared full prefix blocks, in table order
+    shared: int  # tokens covered by `blocks` (block-aligned)
+    exact: bool  # an end record matched the WHOLE prompt
+    tail_src: int  # end record's copied tail block (-1 = none)
+    tail_len: int  # valid rows in the tail block
+    logits: Any  # stored last-token logits (exact hits skip prefill)
+
+
+def paged_default():
+    """Engine-level default for the paged layout: "auto" unless
+    TPU_LLM_KV_PAGED=0 (the contiguous escape hatch / A-B lever).
+    "auto" resolves per model in CacheManager: paged for
+    global-attention models (same worst-case bytes as the dense slab,
+    plus sharing); the ROLLING ring for sliding-window models where it
+    engages — the paged pool does not yet reclaim blocks behind the
+    attention window, so auto-pagination there would trade the ring's
+    O(window) slot bound for O(max_seq_len). Explicit kv_paged=True
+    opts a windowed model in anyway (sessions/radix over window
+    masks)."""
+    return "auto" if os.environ.get("TPU_LLM_KV_PAGED", "1") != "0" else False
+
+
 class CacheManager:
     """Owns the serving engine's KV layout, residency, and reuse policy.
 
-    Layout decision (static, at engine build): a model with a sliding
-    window smaller than the sequence budget gets a ROLLING slot cache of
-    capacity `window + max(decode_chunk, prefill_chunk)` — the window
-    itself plus one chunk of merge/append slack, so an end-of-chunk merge
-    (models.transformer.decode_chunk) or a chunked-prefill append
-    (models.transformer.prefill_append) only ever overwrites rows already
-    behind every window. Global-attention models (or window >=
-    max_seq_len) keep the dense slab; the engine code is identical either
-    way, only shapes and masks differ.
+    Layout decision (static, at engine build):
 
-    `window=None` auto-adopts cfg.sliding_window; `window=0` forces the
-    dense layout (the A/B lever the equality tests use). `prefill_chunk`
-    is the largest prefill-chunk shape the token-budget step scheduler
-    will append (0 under the monolithic wave path, where prefill rows
-    arrive ring-packed and never append in place).
+    - **Paged** (``paged=True`` — the serving engine's default via
+      ``TPU_LLM_KV_PAGED``): one pool of ``TPU_LLM_KV_BLOCK``-token
+      blocks backs every slot through per-slot block tables
+      (gofr_tpu.kvcache.paged). Blocks materialize as each cursor
+      advances — the uniform contract that replaces the old per-feature
+      ring-slack arithmetic (chunk shapes and speculative verify widths
+      fold into ONE ``append_slack`` term of the admission reservation,
+      computed here and nowhere else). A radix tree shares every common
+      prefix block between sibling prompts (copy-on-write, refcounted),
+      and an optional session tier (``TPU_LLM_SESSION_MB``) keeps idle
+      conversations resident / spills them to host RAM
+      (gofr_tpu.kvcache.sessions). ``TPU_LLM_KV_INT8`` stores blocks
+      int8 (+ per-row scales), halving the decode HBM stream.
+
+    - **Contiguous** (``paged=False``): the pre-paging layouts — a
+      ROLLING ring of capacity ``window + append_slack`` for
+      sliding-window models, the dense slab otherwise, and the
+      whole-row PrefixCache. Kept as the A/B lever the
+      paged==contiguous equality tests pin and as the fallback for
+      stacks where the paged path is unavailable.
+
+    `window=None` auto-adopts cfg.sliding_window; `window=0` forces
+    dense masks (the rolling-vs-dense A/B lever). ``append_widths`` is
+    every append width the engine can dispatch in one program (decode
+    chunk, prefill chunk shapes, speculative verify width); its max is
+    the single slack term both layouts budget for.
+
+    Threading: construction and all paged mutation happen on the
+    engine's SCHEDULER thread (the only thread allowed to touch the
+    donated pool arrays); ``_plock`` protects the host bookkeeping
+    against concurrent stats()/metrics readers.
     """
 
     def __init__(
@@ -318,7 +363,14 @@ class CacheManager:
         *,
         window: int | None = None,
         prefill_chunk: int = 0,
+        append_widths: tuple = (),
         prefix_cache_mb: float = 0.0,
+        paged: bool = False,
+        block: int | None = None,
+        pool_blocks: int | None = None,
+        kv_int8: bool | None = None,
+        session_mb: float | None = None,
+        host_cache_mb: float | None = None,
         metrics=None,
         model: str = "llm",
     ):
@@ -334,18 +386,109 @@ class CacheManager:
                 f"{cfg.sliding_window} (attention masks use the config)"
             )
         self.window = int(w or 0)
-        slack = max(decode_chunk, int(prefill_chunk or 0))
-        self.rolling = 0 < self.window and self.window + slack < max_seq_len
-        self.capacity = self.window + slack if self.rolling else max_seq_len
-        # static arg for decode_chunk/attention: ring capacity, 0 = dense
-        self.ring = self.capacity if self.rolling else 0
-        itemsize = jnp.dtype(cfg.dtype).itemsize
-        self.slot_bytes = (
-            2 * cfg.n_layers * slots * self.capacity * cfg.n_kv_heads
-            * cfg.head_dim * itemsize
+        # UNIFIED append-slack accounting: every width the engine can
+        # append in one device program, maxed into one slack term. The
+        # legacy prefill_chunk kwarg folds in for direct constructions.
+        widths = tuple(int(x) for x in append_widths) + (
+            int(decode_chunk), int(prefill_chunk or 0),
         )
+        self.append_slack = max(widths)
+        slack = self.append_slack
+        would_roll = 0 < self.window and self.window + slack < max_seq_len
+        if session_mb is None:
+            session_mb = float(os.environ.get("TPU_LLM_SESSION_MB", "0") or 0.0)
+        if paged == "auto":
+            # see paged_default(): windowed models where the rolling
+            # ring engages keep its O(window) slot bound — UNLESS the
+            # operator asked for the session tier, which only the paged
+            # pool provides. Explicit kv_paged=True also overrides.
+            paged = not would_roll or session_mb > 0
+        self.paged = bool(paged)
         self.metrics = metrics
         self.model = model
+        itemsize = jnp.dtype(cfg.dtype).itemsize
+
+        if self.paged:
+            self.rolling = False
+            self.block = int(
+                block if block is not None
+                else os.environ.get("TPU_LLM_KV_BLOCK", "16")
+            )
+            if kv_int8 is None:
+                kv_int8 = os.environ.get("TPU_LLM_KV_INT8", "0") not in ("", "0")
+            self.int8 = bool(kv_int8)
+            self.table_width = -(-max_seq_len // self.block)
+            self.capacity = self.table_width * self.block
+            self.ring = 0
+            kv_itemsize = 1 if self.int8 else itemsize
+            self.block_bytes = (
+                2 * cfg.n_layers * self.block * cfg.n_kv_heads
+                * cfg.head_dim * kv_itemsize
+                + (2 * cfg.n_layers * self.block * cfg.n_kv_heads * 4
+                   if self.int8 else 0)
+            )
+            retain_bytes = int(prefix_cache_mb * 1024 * 1024)
+            if host_cache_mb is None:
+                host_cache_mb = float(
+                    os.environ.get("TPU_LLM_HOST_CACHE_MB", "256") or 0.0
+                )
+            session_bytes = int(session_mb * 1024 * 1024)
+            if pool_blocks is None:
+                pool_blocks = int(os.environ.get("TPU_LLM_KV_POOL_BLOCKS", "0"))
+            if not pool_blocks:
+                # worst case with zero sharing: every slot fully grown,
+                # plus the retained-prefix and session budgets
+                pool_blocks = (
+                    slots * self.table_width
+                    + -(-retain_bytes // self.block_bytes)
+                    + -(-session_bytes // self.block_bytes)
+                )
+            from .paged import BlockPool, RadixTree, SlotTable
+
+            self.pool = BlockPool(pool_blocks, self.block, self.block_bytes)
+            self._slot_tables = [SlotTable(self.table_width) for _ in range(slots)]
+            self._tables_np = np.zeros((slots, self.table_width), np.int32)
+            self.tables_dirty = True
+            # sharing is on whenever there is a retention budget OR the
+            # session tier wants the radix as its index
+            self.share = retain_bytes > 0 or session_bytes > 0
+            self.radix = (
+                RadixTree(self.pool, self.block, retain_bytes)
+                if self.share else None
+            )
+            self.sessions = None
+            if session_bytes > 0:
+                from .sessions import HostOffload, SessionStore
+
+                self.sessions = SessionStore(
+                    session_bytes,
+                    HostOffload(int(host_cache_mb * 1024 * 1024)),
+                )
+            # the old PrefixCache surface: None in paged mode — the radix
+            # IS the prefix index (stats()["prefix"] maps its counters)
+            self.prefix = None
+            self.slot_bytes = 0  # dynamic: pool bytes in use (gauges)
+        else:
+            self.block = 0
+            self.int8 = False
+            self.pool = None
+            self.radix = None
+            self.sessions = None
+            self.share = False
+            self.rolling = would_roll
+            self.capacity = self.window + slack if self.rolling else max_seq_len
+            # static arg for decode_chunk/attention: ring capacity, 0 = dense
+            self.ring = self.capacity if self.rolling else 0
+            self.slot_bytes = (
+                2 * cfg.n_layers * slots * self.capacity * cfg.n_kv_heads
+                * cfg.head_dim * itemsize
+            )
+            self.prefix = (
+                PrefixCache(int(prefix_cache_mb * 1024 * 1024), metrics, model)
+                if prefix_cache_mb > 0
+                else None
+            )
+        self._plock = threading.Lock()
         if metrics is not None:
             with _METRICS_REG_LOCK:
                 if not metrics.has("app_kvcache_events"):
@@ -358,17 +501,41 @@ class CacheManager:
                         "app_kvcache_resident_bytes",
                         "resident kv bytes (kind=slots|prefix)",
                     )
-            metrics.set_gauge(
-                "app_kvcache_resident_bytes", float(self.slot_bytes),
-                model=model, kind="slots",
-            )
-        self.prefix = (
-            PrefixCache(int(prefix_cache_mb * 1024 * 1024), metrics, model)
-            if prefix_cache_mb > 0
-            else None
-        )
+                if self.paged:
+                    if not metrics.has("app_kvcache_blocks_in_use"):
+                        metrics.new_gauge(
+                            "app_kvcache_blocks_in_use",
+                            "KV pool blocks with refcount > 0",
+                        )
+                    if not metrics.has("app_kvcache_blocks_shared"):
+                        metrics.new_gauge(
+                            "app_kvcache_blocks_shared",
+                            "KV pool blocks with refcount > 1 (prefix sharing)",
+                        )
+                    if not metrics.has("app_kvcache_spilled_bytes"):
+                        metrics.new_gauge(
+                            "app_kvcache_spilled_bytes",
+                            "session KV bytes spilled to the host tier",
+                        )
+                    if not metrics.has("app_kvcache_session_count"):
+                        metrics.new_gauge(
+                            "app_kvcache_session_count",
+                            "sessions tracked (state=resident|spilled)",
+                        )
+                    if not metrics.has("app_kvcache_session_events"):
+                        metrics.new_counter(
+                            "app_kvcache_session_events",
+                            "session lifecycle events "
+                            "(event=publish|resume|spill|restore|expire)",
+                        )
+            self._update_gauges()
+            if not self.paged:
+                metrics.set_gauge(
+                    "app_kvcache_resident_bytes", float(self.slot_bytes),
+                    model=model, kind="slots",
+                )
 
-    # -- slot cache -------------------------------------------------------
+    # -- slot cache (contiguous layout + prefill scratch) -----------------
     def init_cache(self, rows: int):
         """A zeroed slot (or prefill-scratch) cache at the planned width."""
         from ..models.transformer import init_cache
@@ -377,31 +544,548 @@ class CacheManager:
 
     def prefill_cache_len(self, bucket: int) -> int:
         """Row width the prefill op should build its cache at: the dense
-        layout pads straight to capacity; the rolling layout keeps the
-        position-indexed rows (bucket wide) and ring-packs after."""
+        contiguous AND paged layouts pad straight to capacity (paged's
+        insert scatter drops rows beyond each prompt's length, and one
+        capacity-wide shape keeps the insert program family at one
+        executable); the rolling layout keeps position-indexed rows
+        (bucket wide) and ring-packs after."""
         return bucket if self.rolling else self.capacity
 
     def pack_prefill(self, cache):
         """Convert a freshly prefilled cache to the slot layout."""
         return ring_pack(cache, self.capacity) if self.rolling else cache
 
+    # -- paged layout: pool geometry --------------------------------------
+    def pool_arrays(self, jnp):
+        """Zeroed device pool (KVCache pool-layout) + int8 scales (or
+        None). The ENGINE owns these arrays — they are donated through
+        every jitted program; this manager only does the bookkeeping."""
+        from ..models.transformer import KVCache
+
+        cfg = self.cfg
+        shape = (cfg.n_layers, self.pool.n_blocks, self.block,
+                 cfg.n_kv_heads, cfg.head_dim)
+        dtype = jnp.int8 if self.int8 else cfg.dtype
+        cache = KVCache(
+            k=jnp.zeros(shape, dtype),
+            v=jnp.zeros(shape, dtype),
+            length=jnp.zeros((self.slots,), jnp.int32),
+        )
+        scales = (
+            jnp.zeros((2,) + shape[:-1], jnp.float32) if self.int8 else None
+        )
+        return cache, scales
+
+    def blocks_for(self, tokens: int) -> int:
+        return -(-max(0, int(tokens)) // self.block)
+
+    def reserve_tokens(self, prompt_len: int, max_new: int) -> int:
+        """Worst-case rows a request can ever occupy: prompt + decode
+        budget + ONE append-slack term (chunk-granular decode overshoot
+        and transient speculative verify rows past the cursor), clamped
+        to the logical capacity. The single place this arithmetic lives."""
+        return min(prompt_len + max_new - 1 + self.append_slack, self.capacity)
+
+    # -- paged layout: admission ------------------------------------------
+    def lookup_seed(self, prompt_tokens, *, allow_partial: bool = True) -> SeedPlan | None:
+        """Radix consult for one prompt. Exact end records reproduce the
+        old PrefixCache exact-hit contract (stored tail rows + logits —
+        prefill skipped entirely); otherwise the longest block-aligned
+        shared prefix is returned, CLAMPED to prompt_len - 1 so at least
+        one token still runs through prefill (last-token logits).
+        allow_partial=False restricts to exact probes (the wave
+        scheduler has no mid-prompt append path)."""
+        if self.radix is None:
+            return None
+        with self._plock:
+            m = self.radix.lookup(prompt_tokens)
+            n = len(prompt_tokens)
+            if m.end is not None and m.end.logits is not None:
+                # prefill can only be skipped when the stored last-token
+                # logits exist (session end records keep rows, not
+                # logits — those degrade to the partial path below)
+                self._count("hit")
+                plan = SeedPlan(
+                    blocks=m.blocks, shared=m.shared, exact=True,
+                    tail_src=(
+                        m.end.tail_block if m.end.tail_block is not None else -1
+                    ),
+                    tail_len=m.end.tail_len, logits=m.end.logits,
+                )
+            else:
+                shared = min(m.shared, ((n - 1) // self.block) * self.block)
+                if shared <= 0 or not allow_partial:
+                    self._count("miss")
+                    return None
+                self._count("partial_hit")
+                plan = SeedPlan(
+                    blocks=m.blocks[: shared // self.block], shared=shared,
+                    exact=False, tail_src=-1, tail_len=0, logits=None,
+                )
+            # PIN the plan's blocks (the PrefixCache lookup-pins-entry
+            # contract): between this lookup and attach_seed, a LATER
+            # request's reservation/restore in the same admission pass
+            # may evict these very radix leaves — without the pin the
+            # plan would reference freed (possibly re-allocated) blocks.
+            # attach_seed adopts the refs; every discard path calls
+            # release_plan.
+            self.pool.incref(plan.blocks)
+            if plan.tail_src >= 0:
+                self.pool.incref([plan.tail_src])
+            return plan
+
+    def release_plan(self, plan: SeedPlan | None) -> None:
+        """Drop an unconsumed seed plan's pins (blocked/stranded/failed
+        admissions)."""
+        if plan is None:
+            return
+        with self._plock:
+            self.pool.decref(plan.blocks)
+            if plan.tail_src >= 0:
+                self.pool.decref([plan.tail_src])
+
+    def _reserve_need(self, prompt_len: int, max_new: int, plan: SeedPlan | None) -> int:
+        """Blocks a request still needs beyond its seed plan's shared
+        prefix. The exact hit's tail COPY is already inside
+        blocks_for(reserve_tokens) — the tail block is simply the first
+        non-shared block."""
+        need = self.blocks_for(self.reserve_tokens(prompt_len, max_new))
+        need -= len(plan.blocks) if plan is not None else 0
+        return max(0, need)
+
+    def reserve_need(self, prompt_len: int, max_new: int, plan: SeedPlan | None) -> int:
+        """Public view of the admission promise (the engine records it on
+        the request so a stranded admission can hand the promise back)."""
+        return self._reserve_need(prompt_len, max_new, plan)
+
+    def unreserve(self, n: int) -> None:
+        """Return an unconsumed admission promise to the pool (stranded
+        requests re-queued by admission recovery)."""
+        if n > 0:
+            with self._plock:
+                self.pool.unreserve(n)
+
+    def admit_reserve(self, prompt_len: int, max_new: int, plan: SeedPlan | None) -> bool:
+        """Promise pool blocks for a request's worst case (minus what a
+        seed plan already shares). False = the pool cannot host it yet —
+        the engine keeps it queued (and may spill sessions to make
+        room). Radix retention is reclaimed automatically: retained-only
+        blocks are exactly the evictable slack."""
+        need = self._reserve_need(prompt_len, max_new, plan)
+        with self._plock:
+            if self.pool.available() < need and self.radix is not None:
+                self.radix.evict_for(need - self.pool.available())
+            return self.pool.reserve(need)
+
+    def attach_seed(
+        self, slot: int, plan: SeedPlan | None, owner,
+        prompt_len: int, max_new: int,
+    ) -> dict:
+        """Point a slot's table at its seed plan's shared blocks
+        (refcount++, read-only for this slot) and move the admission
+        promise onto the slot's books. Returns the device work the
+        ENGINE must dispatch: ``copies`` (src, dst) block pairs — the
+        exact hit's partial tail is shared by COPY, never in place,
+        which is what keeps the copy-on-write invariant trivial — and
+        ``seed_len`` for the device length scatter (exact hits only;
+        append paths carry their cursor in the pack)."""
+        with self._plock:
+            st = self._slot_tables[slot]
+            self._release_slot_locked(slot)
+            st.owner = owner
+            st.reserved = self._reserve_need(prompt_len, max_new, plan)
+            copies: list[tuple[int, int]] = []
+            seed_len = 0
+            if plan is not None:
+                # ADOPT the plan's pins as the slot's references (no
+                # extra incref — lookup_seed already took them)
+                shared = plan.blocks
+                n = len(shared)
+                st.rows[:n] = np.asarray(shared, np.int32)
+                st.shared = n
+                st.hi = n
+                seed_len = plan.shared
+                if plan.exact and plan.tail_src >= 0:
+                    dst = self.pool.alloc(1, reserved=True)[0]
+                    st.reserved -= 1
+                    st.rows[n] = dst
+                    st.hi = n + 1
+                    copies.append((plan.tail_src, dst))
+                    seed_len = plan.shared + plan.tail_len
+                    # the tail-source pin served its purpose: the copy
+                    # the engine dispatches next is device-ordered
+                    # before any future re-user's write to this block
+                    self.pool.decref([plan.tail_src])
+            self.tables_dirty = True
+            self._update_gauges()
+            return {"copies": copies, "seed_len": seed_len}
+
+    def ensure(self, slot: int, upto_tokens: int) -> bool:
+        """Materialize table entries so rows [0, upto_tokens) are
+        writable-or-shared — the "allocate blocks as the cursor advances"
+        contract. Draws the slot's admission reservation first; anything
+        beyond it (shouldn't happen — reserve_tokens is the worst case)
+        competes for free headroom, evicting retained prefixes if it
+        must. Returns True when the table changed (engine re-ships the
+        device mirror)."""
+        upto = min(int(upto_tokens), self.capacity)
+        need = self.blocks_for(upto)
+        with self._plock:
+            st = self._slot_tables[slot]
+            if need <= st.hi:
+                return False
+            n = need - st.hi
+            take_r = min(n, st.reserved)
+            fresh: list[int] = []
+            if take_r:
+                fresh += self.pool.alloc(take_r, reserved=True)
+                st.reserved -= take_r
+            extra = n - take_r
+            if extra:
+                if self.pool.available() < extra and self.radix is not None:
+                    self.radix.evict_for(extra - self.pool.available())
+                fresh += self.pool.alloc(extra)
+            st.rows[st.hi : need] = np.asarray(fresh, np.int32)
+            st.hi = need
+            self.tables_dirty = True
+            self._update_gauges()
+            return True
+
+    def _release_slot_locked(self, slot: int) -> None:
+        st = self._slot_tables[slot]
+        if st.hi:
+            self.pool.decref(st.blocks())
+        if st.reserved:
+            self.pool.unreserve(st.reserved)
+        st.hi = 0
+        st.shared = 0
+        st.reserved = 0
+        st.owner = None
+
+    def release_slot(self, slot: int, owner=None) -> None:
+        """Drop a slot's block references (retire/preempt/reassign).
+        owner-checked when provided so a late release can never free a
+        successor's blocks."""
+        with self._plock:
+            st = self._slot_tables[slot]
+            if owner is not None and st.owner is not owner:
+                return
+            self._release_slot_locked(slot)
+            self.tables_dirty = True
+            self._update_gauges()
+
+    def slot_owner(self, slot: int):
+        return self._slot_tables[slot].owner
+
+    def take_tables(self) -> np.ndarray | None:
+        """The [slots, table_width] np mirror when dirty, else None."""
+        with self._plock:
+            if not self.tables_dirty:
+                return None
+            for s, st in enumerate(self._slot_tables):
+                self._tables_np[s] = st.rows
+            self.tables_dirty = False
+            return self._tables_np.copy()
+
+    # -- paged layout: publishing (radix + sessions) ----------------------
+    def publish_plan(self, slot: int, tokens, *, want_tail: bool) -> dict | None:
+        """Plan publishing a slot's first `len(tokens)` rows into the
+        radix: the full blocks are shared in place; the sub-block tail
+        (when wanted — exact-hit entries and session ends) is COPIED
+        into a fresh radix-owned block. Returns None when sharing is off
+        or the tail block cannot be allocated even after eviction."""
+        if self.radix is None:
+            return None
+        n = len(tokens)
+        full = n - n % self.block
+        with self._plock:
+            st = self._slot_tables[slot]
+            if self.blocks_for(n) > st.hi:
+                return None  # rows not resident (shouldn't happen)
+            blocks = [int(b) for b in st.rows[: full // self.block]]
+            tail_src = tail_dst = -1
+            tail_len = n - full
+            if want_tail and tail_len > 0:
+                if self.pool.available() < 1:
+                    self.radix.evict_for(1)
+                if self.pool.available() < 1:
+                    return None
+                tail_src = int(st.rows[full // self.block])
+                tail_dst = self.pool.alloc(1)[0]
+            return {
+                "slot": slot, "blocks": blocks, "tail_src": tail_src,
+                "tail_dst": tail_dst, "tail_len": tail_len if want_tail else 0,
+            }
+
+    def publish_commit(self, plan: dict, tokens, logits=None, logits_nbytes: int = 0,
+                       session_id: str | None = None) -> None:
+        """Insert the published sequence into the radix (dedup against
+        existing paths) and, for sessions, pin the leaf to the
+        conversation."""
+        with self._plock:
+            node, key = self.radix.insert(
+                list(tokens), plan["blocks"],
+                tail_block=(plan["tail_dst"] if plan["tail_dst"] >= 0 else None),
+                tail_len=plan["tail_len"],
+                logits=logits, logits_nbytes=logits_nbytes,
+            )
+            self._count("store")
+            if session_id and self.sessions is not None:
+                self.radix.pin(node)
+                nblocks = len(plan["blocks"]) + (1 if plan["tail_dst"] >= 0 else 0)
+                self.sessions.publish(
+                    session_id, tokens, node, key,
+                    nblocks * self.block_bytes, self.radix,
+                )
+                self._count_session("publish")
+            self._update_gauges()
+
+    # -- paged layout: session spill/restore ------------------------------
+    def session_path(self, sid: str) -> dict | None:
+        """The device blocks a resident session's pinned leaf covers
+        (root -> leaf order) + its end-record tail — what the engine
+        fetches to host on spill."""
+        if self.sessions is None:
+            return None
+        with self._plock:
+            s = self.sessions.get(sid)
+            if s is None or s.state != "resident" or s.node is None:
+                return None
+            blocks: list[int] = []
+            node = s.node
+            chain = []
+            while node is not None and node.parent is not None:
+                chain.append(node)
+                node = node.parent
+            for n in reversed(chain):
+                blocks.extend(n.blocks)
+            end = s.node.ends.get(s.end_key)
+            tail = end.tail_block if end is not None and end.tail_block is not None else -1
+            tail_len = end.tail_len if end is not None else 0
+            return {
+                "tokens": list(s.tokens), "blocks": blocks,
+                "tail": tail, "tail_len": tail_len,
+            }
+
+    def spill_commit(self, sid: str, payload: dict, nbytes: int) -> None:
+        """Bookkeeping after the engine fetched a session's blocks to
+        host: unpin, store in the offload tier (LRU under its budget),
+        and evict the session's now-exclusive leaf chain so the device
+        blocks actually free (budget pressure is WHY it spilled). Nodes
+        still pinned or shared by other sessions/prompts stay — their
+        blocks were never this session's exclusive cost."""
+        with self._plock:
+            s = self.sessions.get(sid)
+            node = s.node if s is not None else None
+            self.sessions.mark_spilled(sid, self.radix)
+            for dropped in self.sessions.offload.store(sid, payload, nbytes):
+                # includes sid itself when the payload exceeds the whole
+                # host budget — a "spilled" session with no stored
+                # payload would otherwise leak in the registry forever
+                self.sessions.forget(dropped, self.radix)
+                self._count_session("expire")
+            while (
+                node is not None and node.parent is not None
+                and not node.children and node.refs == 0
+            ):
+                parent = node.parent
+                self.radix._evict_node(node)
+                node = parent
+            self._count_session("spill")
+            self._update_gauges()
+
+    def restore_fetch(self, sid: str) -> dict | None:
+        """Pop a spilled session's host payload (engine rebuilds blocks).
+        A spilled session whose payload is gone (host-budget expiry
+        races, refused oversized stores) is forgotten — the next turn is
+        a clean miss, not a permanently dead registry entry."""
+        if self.sessions is None:
+            return None
+        with self._plock:
+            s = self.sessions.get(sid)
+            if s is None or s.state != "spilled":
+                return None
+            payload = self.sessions.offload.fetch(sid)
+            if payload is None:
+                self.sessions.forget(sid, self.radix)
+                self._count_session("expire")
+            return payload
+
+    def session_forget(self, sid: str) -> None:
+        """Drop a session entirely (restore failed mid-flight: its
+        payload is consumed and its blocks cannot be allocated)."""
+        if self.sessions is None:
+            return
+        with self._plock:
+            self.sessions.forget(sid, self.radix)
+            self._count_session("expire")
+            self._update_gauges()
+
+    def alloc_restore(self, n: int) -> list[int] | None:
+        with self._plock:
+            if self.pool.available() < n and self.radix is not None:
+                self.radix.evict_for(n - self.pool.available())
+            if self.pool.available() < n:
+                return None
+            return self.pool.alloc(n)
+
+    def restore_commit(self, sid: str, tokens, blocks: list[int],
+                       tail_block: int, tail_len: int) -> None:
+        """Re-insert a restored session into the radix and re-pin it.
+        insert() dedups against any prefix that re-grew while the
+        session was spilled; the duplicate blocks stay slot-free and the
+        decref below releases our extra references."""
+        with self._plock:
+            node, key = self.radix.insert(
+                list(tokens), blocks,
+                tail_block=(tail_block if tail_block >= 0 else None),
+                tail_len=tail_len,
+            )
+            # drop the allocation references — the radix now holds its
+            # own (insert increfed exactly the blocks it adopted; blocks
+            # it deduplicated away free right here). The tail block is
+            # adopted by the end record without an extra ref.
+            self.pool.decref(blocks)
+            self.radix.pin(node)
+            self.sessions.publish(
+                sid, tokens, node, key,
+                (len(blocks) + (1 if tail_block >= 0 else 0)) * self.block_bytes,
+                self.radix,
+            )
+            self._count_session("restore")
+            self._update_gauges()
+
+    def spill_candidates(self, exclude=None):
+        if self.sessions is None:
+            return []
+        with self._plock:
+            return self.sessions.spill_candidates(exclude)
+
+    def session_touch(self, sid: str) -> str:
+        """Record a turn arriving for `sid`; returns the session state
+        ("new" | "resident" | "spilled") so the engine knows whether a
+        restore is needed."""
+        if self.sessions is None:
+            return "off"
+        with self._plock:
+            s = self.sessions.get(sid)
+            if s is None:
+                return "new"
+            s.last_use = time.monotonic()
+            if s.state == "resident":
+                self.sessions.resumes += 1
+                self._count_session("resume")
+            return s.state
+
     # -- observability ----------------------------------------------------
+    def _count(self, event: str) -> None:
+        if self.metrics is not None:
+            self.metrics.increment_counter(
+                "app_kvcache_events", 1.0, model=self.model, event=event
+            )
+
+    def _count_session(self, event: str) -> None:
+        if self.metrics is not None:
+            self.metrics.increment_counter(
+                "app_kvcache_session_events", 1.0, model=self.model, event=event
+            )
+
+    def _update_gauges(self) -> None:
+        if self.metrics is None or not self.paged:
+            return
+        self.metrics.set_gauge(
+            "app_kvcache_resident_bytes", float(self.pool.bytes_in_use()),
+            model=self.model, kind="slots",
+        )
+        if self.radix is not None:
+            self.metrics.set_gauge(
+                "app_kvcache_resident_bytes", float(self.radix.owned_bytes),
+                model=self.model, kind="prefix",
+            )
+        self.metrics.set_gauge(
+            "app_kvcache_blocks_in_use", float(self.pool.blocks_in_use()),
+            model=self.model,
+        )
+        self.metrics.set_gauge(
+            "app_kvcache_blocks_shared", float(self.pool.blocks_shared()),
+            model=self.model,
+        )
+        if self.sessions is not None:
+            st = self.sessions.stats()
+            self.metrics.set_gauge(
+                "app_kvcache_spilled_bytes",
+                float(st["offload"]["spilled_bytes"]), model=self.model,
+            )
+            self.metrics.set_gauge(
+                "app_kvcache_session_count", float(st["resident"]),
+                model=self.model, state="resident",
+            )
+            self.metrics.set_gauge(
+                "app_kvcache_session_count", float(st["spilled"]),
+                model=self.model, state="spilled",
+            )
+
     def stats(self) -> dict[str, Any]:
-        return {
-            "layout": "rolling" if self.rolling else "dense",
-            "capacity": self.capacity,
-            "window": self.window,
-            "slot_bytes": self.slot_bytes,
-            "prefix": self.prefix.stats() if self.prefix is not None else None,
-        }
+        if not self.paged:
+            return {
+                "layout": "rolling" if self.rolling else "dense",
+                "capacity": self.capacity,
+                "window": self.window,
+                "slot_bytes": self.slot_bytes,
+                "prefix": self.prefix.stats() if self.prefix is not None else None,
+            }
+        with self._plock:
+            return {
+                "layout": "paged",
+                "capacity": self.capacity,
+                "window": self.window,
+                "block": self.block,
+                "int8": self.int8,
+                "pool_blocks": self.pool.n_blocks,
+                "blocks_in_use": self.pool.blocks_in_use(),
+                "blocks_shared": self.pool.blocks_shared(),
+                "blocks_reserved": self.pool.reserved,
+                "cow_copies": self.pool.cow_copies,
+                "block_bytes": self.block_bytes,
+                # single source of truth for resident KV bytes: the pool
+                "slot_bytes": self.pool.bytes_in_use(),
+                "prefix": self.radix.stats() if self.radix is not None else None,
+                "sessions": (
+                    self.sessions.stats() if self.sessions is not None else None
+                ),
+            }
 
     def close(self) -> None:
         if self.prefix is not None:
             self.prefix.clear()
+        if self.paged:
+            with self._plock:
+                if self.sessions is not None:
+                    self.sessions.clear(self.radix)
+                if self.radix is not None:
+                    self.radix.clear()
+                for s in range(self.slots):
+                    self._release_slot_locked(s)
         if self.metrics is not None:
-            # the slab is freed with the engine: a stale gauge would keep
-            # reporting a closed engine's KV bytes as resident forever
-            self.metrics.set_gauge(
-                "app_kvcache_resident_bytes", 0.0,
-                model=self.model, kind="slots",
-            )
+            # freed with the engine: a stale gauge would keep reporting a
+            # closed engine's KV bytes as resident forever
+            for kind in ("slots", "prefix"):
+                self.metrics.set_gauge(
+                    "app_kvcache_resident_bytes", 0.0,
+                    model=self.model, kind=kind,
+                )
+            if self.paged:
+                self.metrics.set_gauge(
+                    "app_kvcache_blocks_in_use", 0.0, model=self.model
+                )
+                self.metrics.set_gauge(
+                    "app_kvcache_blocks_shared", 0.0, model=self.model
+                )
+                self.metrics.set_gauge(
+                    "app_kvcache_spilled_bytes", 0.0, model=self.model
+                )
+                for state in ("resident", "spilled"):
+                    self.metrics.set_gauge(
+                        "app_kvcache_session_count", 0.0,
+                        model=self.model, state=state,
+                    )

@@ -8,17 +8,28 @@ request model at the model-serving layer (SURVEY.md §7 hard part 5:
 
 Design (all shapes static; a bounded set of compiled executables):
 
-- **Slots.** A fixed decode batch of S slots with one persistent KV cache
-  on device whose layout the kvcache subsystem owns: a dense
+- **Slots over a paged block pool (default).** A fixed decode batch of
+  S slots whose KV lives in ONE device-resident pool of fixed-size
+  blocks [n_layers, NB, block, hkv, hd], read and written through
+  per-slot block tables (gofr_tpu.kvcache.paged): blocks materialize as
+  each cursor advances, sibling prompts share every common prefix block
+  in place (refcounted, copy-on-write), and decode attention goes
+  through ops.paged_chunk_decode_attention (Pallas paged kernel on TPU,
+  dense-gather fallback elsewhere). TPU_LLM_KV_INT8 stores blocks int8.
+  kv_paged=False restores the contiguous layouts — a dense
   [n_layers, S, max_seq_len, hkv, hd] slab for global attention, or a
-  window-bounded ROLLING ring [n_layers, S, window+chunk, hkv, hd] for
-  sliding-window models (O(window) memory/bandwidth per slot). Inactive
-  slots are masked (their tokens are discarded on host; their cursors
-  never advance).
-- **Prefix reuse.** With prefix_cache_mb > 0, admission consults a
-  refcounted LRU cache of retained prefill KV rows keyed by the prompt —
-  a hit skips the prefill wave entirely and inserts the cached rows
-  (gofr_tpu.kvcache; hit/miss/eviction counters in stats()["kvcache"]).
+  window-bounded ROLLING ring for sliding-window models — as the
+  token-identical A/B lever. Inactive slots are masked (their tokens
+  are discarded on host; their cursors never advance).
+- **Prefix reuse.** With prefix_cache_mb > 0, admission consults the
+  prefix index — the paged layout's RADIX TREE over token ids (every
+  block-aligned shared prefix hits, exact published prompts skip
+  prefill entirely via copied tails + stored logits), or the contiguous
+  layout's refcounted LRU cache of whole retained rows
+  (gofr_tpu.kvcache; hit/miss/partial_hit counters in
+  stats()["kvcache"]). With session_mb > 0, X-GoFr-Session
+  conversations keep their blocks resident between turns and spill to
+  host RAM when cold (docs/advanced-guide/kv-cache.md#sessions).
 - **Fused decode chunks.** Decode advances ALL slots K steps per dispatch
   (models.transformer.decode_chunk: a lax.scan over a chunk-ring-buffer
   layer body with on-device sampling — the main cache is read-only inside
@@ -99,7 +110,7 @@ import itertools
 import queue
 import threading
 import time
-from collections import deque
+from collections import OrderedDict, deque
 from dataclasses import dataclass, field
 from typing import Any, Iterator
 
@@ -311,6 +322,13 @@ class GenRequest:
     # deterministic stand-in for a payload that crashes the step
     # program; gofr_tpu.resilience.faults). Empty for real traffic.
     tag: str = ""
+    # Conversation id (X-GoFr-Session header; docs/advanced-guide/
+    # kv-cache.md#sessions). On finish the full sequence's KV blocks
+    # stay resident in the paged pool keyed by this id (spilled to host
+    # RAM when cold), so the NEXT turn's prompt — which extends this
+    # conversation — block-shares the whole history instead of
+    # re-prefilling it. Empty = sessionless (blocks free at retire).
+    session_id: str = ""
     id: int = field(default_factory=itertools.count().__next__)
 
     def __post_init__(self):
@@ -353,6 +371,11 @@ class GenRequest:
         self.prefill_done = False  # all prompt tokens resident; decoding
         self.slot: int | None = None  # slot index while resident
         self._rows_hi = 0  # highest slot row ever written (prefix trim)
+        # -- paged KV state (engine-maintained; kvcache.paged) --
+        self._kv_limit = 0  # worst-case rows (CacheManager.reserve_tokens)
+        self._kv_resv = 0  # admission block promise not yet bound to a slot
+        self._kv_plan = None  # pinned seed plan not yet attached to a slot
+        self._session_published = False  # end-of-turn radix publish done
         self._prefill_t0: float | None = None  # first chunk dispatch time
         self._load_acct = 0  # outstanding token estimate (router weighting)
         # -- speculative decoding (gofr_tpu.spec; engine-maintained) --
@@ -489,6 +512,12 @@ class LLMEngine:
         quantize: bool = False,
         kv_window: int | None = None,
         prefix_cache_mb: float = 0.0,
+        kv_paged: bool | None = None,
+        kv_block: int | None = None,
+        kv_pool_blocks: int | None = None,
+        kv_int8: bool | None = None,
+        session_mb: float | None = None,
+        host_cache_mb: float | None = None,
         kv_label: str = "llm",
         version: str = "v1",
     ):
@@ -748,19 +777,30 @@ class LLMEngine:
         # registered model name, and replicated serving suffixes a replica
         # index — otherwise N replicas' resident-bytes gauges share one
         # label set and clobber each other on /metrics.
-        # Ring-capacity slack must cover every append width the engine
-        # dispatches: the largest prefill chunk shape AND the speculative
-        # verify width (draft + 1) — a rolling slot's capacity bound is
-        # what guarantees an append can never overwrite an in-window row
-        # (and that rolled-back stale rows reconstruct a full lap behind
-        # every query's window; ops.chunk_prefill_attention).
-        kv_slack = max(self.chunk_shapes) if self.chunked else 0
+        # UNIFIED capacity accounting: every append width one device
+        # program can dispatch — the decode chunk, the chunked-prefill
+        # chunk shapes, the speculative verify width — goes to the
+        # CacheManager ONCE as append_widths; the rolling-ring capacity
+        # and the paged block reservation both derive from the same
+        # max() there, replacing the per-feature slack arithmetic the
+        # chunked-prefill and speculative-verify paths each used to
+        # layer onto the ring bound.
+        append_widths = [decode_chunk]
+        if self.chunked:
+            append_widths.extend(self.chunk_shapes)
         if self.speculative:
-            kv_slack = max(kv_slack, self.spec_draft + 1)
+            append_widths.append(self.spec_draft + 1)
+        if kv_paged is None:
+            from .kvcache import paged_default
+
+            kv_paged = paged_default()
         self.kv = CacheManager(
             cfg, slots, max_seq_len, decode_chunk,
             window=kv_window, prefix_cache_mb=prefix_cache_mb,
-            prefill_chunk=kv_slack,
+            append_widths=tuple(append_widths),
+            paged=kv_paged, block=kv_block, pool_blocks=kv_pool_blocks,
+            kv_int8=kv_int8, session_mb=session_mb,
+            host_cache_mb=host_cache_mb,
             metrics=metrics, model=kv_label,
         )
         self._sharded = mesh is not None and param_specs is not None
@@ -800,7 +840,12 @@ class LLMEngine:
             out = jnp.where(temps > 0.0, sampled, greedy).astype(jnp.int32)
             return finite_guard(logits, out) if _numeric_check else out
 
-        keep_logits = self.kv.prefix is not None
+        # last-token logits ride the prefill programs whenever ANY prefix
+        # index can serve exact hits from them: the contiguous PrefixCache
+        # or the paged radix tree (kvcache.paged)
+        keep_logits = self.kv.prefix is not None or (
+            self.kv.paged and self.kv.share
+        )
 
         def _prefill_op(params, pack, rng):
             """pack [nb, bucket+2] int32: tokens | lengths | temps-as-bits.
@@ -1070,11 +1115,311 @@ class LLMEngine:
                 f"llm.step_v{Wv}", _verify, model=self.label,
                 metrics=metrics, donate_argnums=(1, 2),
             )
+
+        # -- paged-pool program family (kvcache.paged; docs/advanced-guide/
+        # kv-cache.md). Same scheduler contracts as the contiguous family
+        # above, but the slot KV lives in ONE block pool read/written
+        # through per-slot block tables: decode attention goes through
+        # ops.paged_chunk_decode_attention (Pallas paged kernel on TPU,
+        # dense-gather fallback elsewhere), appends/verifies gather the
+        # dense per-slot view at the program boundary and scatter exactly
+        # the rows they wrote back through the table (write indices from
+        # DEVICE lengths — rollback/pipeline safe). A host `live` mask
+        # rides every decode-bearing program: the contiguous path could
+        # afford clamped garbage writes for stale-active lanes, but a
+        # paged stale lane's table may point at blocks that now belong to
+        # someone else.
+        if self.kv.paged:
+            from .kvcache.paged import (
+                copy_blocks, gather_slots, scatter_rows,
+            )
+            from .models.transformer import decode_chunk_paged
+            from .ops import paged_kernel_ok
+
+            Bp = self.kv.block
+            _cap = self.kv.capacity
+            _int8 = self.kv.int8
+            _use_kernel = paged_kernel_ok(cfg.head_dim, Bp)
+
+            def _sc(scales):
+                return scales if _int8 else None
+
+            def _gather_view(cache, scales, tables, lengths):
+                sc = scales if _int8 else None
+                return gather_slots(
+                    cache.k, cache.v, tables, lengths,
+                    scales=(None if sc is None else (sc[0], sc[1])),
+                    dtype=cfg.dtype,
+                )
+
+            def _pool_scatter(cache, scales, tables, rows_k, rows_v, pos, valid):
+                k2, v2, sc2 = scatter_rows(
+                    cache.k, cache.v, tables, rows_k, rows_v, pos, valid,
+                    scales=_sc(scales),
+                )
+                return cache._replace(k=k2, v=v2), (sc2 if _int8 else scales)
+
+            def _rows_at(stack, pos):
+                """[L, S, C, hkv, hd] rows at per-slot positions [S, W]."""
+                idx = jnp.clip(pos, 0, stack.shape[2] - 1)
+                return jnp.take_along_axis(
+                    stack, idx[None, :, :, None, None], axis=2
+                )
+
+            def _make_paged_chunk_op(K: int):
+                def _chunk(params, tail, cache, scales, tables, live, active, temps, rng):
+                    eff = jnp.logical_and(active, live)
+                    if _use_kernel:
+                        toks, last, cache, sc_out, rng = decode_chunk_paged(
+                            params, cfg, tail, cache, (scales if _int8 else None),
+                            tables, eff, temps, rng,
+                            n_steps=K, sample_fn=_sample, block=Bp,
+                        )
+                        return toks, last, cache, (
+                            sc_out if _int8 else scales
+                        ), rng
+                    dense = _gather_view(cache, scales, tables, cache.length)
+                    toks, last, nd, rng = chunk_fn(
+                        params, cfg, tail, dense, eff, temps, rng,
+                        n_steps=K, sample_fn=_sample, ring=0,
+                    )
+                    pos = cache.length[:, None] + jnp.arange(K, dtype=jnp.int32)[None, :]
+                    valid = eff[:, None] & (pos < _cap)
+                    cache, scales = _pool_scatter(
+                        cache, scales, tables,
+                        _rows_at(nd.k, pos), _rows_at(nd.v, pos), pos, valid,
+                    )
+                    return toks, last, cache._replace(length=nd.length), scales, rng
+
+                return instrument_jit(
+                    f"llm.decode_chunk{K}", _chunk, model=self.label,
+                    metrics=metrics,
+                    donate_argnums=((2, 3) if _int8 else (2,)),
+                )
+
+            self._chunk_ops = {decode_chunk: _make_paged_chunk_op(decode_chunk)}
+            if self._chunk_short != decode_chunk:
+                self._chunk_ops[self._chunk_short] = _make_paged_chunk_op(
+                    self._chunk_short
+                )
+
+            def _insert_paged(cache, scales, new_cache, meta, tables):
+                """Wave-admission insert: scatter each prefilled row's
+                valid prefix through its slot's block table and set the
+                device lengths. meta [2, M]: slot | row (pads repeat
+                entry 0 — duplicate writes carry identical values)."""
+                slot_idx, rowsel = meta[0], meta[1]
+                tsub = jnp.take(
+                    tables, jnp.clip(slot_idx, 0, slots - 1), axis=0
+                )  # [M, MB]
+                nk = jnp.take(new_cache.k, rowsel, axis=1)  # [L, M, W, ...]
+                nv = jnp.take(new_cache.v, rowsel, axis=1)
+                lens = jnp.take(new_cache.length, rowsel, axis=0)  # [M]
+                W = nk.shape[2]
+                pos = jnp.broadcast_to(
+                    jnp.arange(W, dtype=jnp.int32)[None, :],
+                    (slot_idx.shape[0], W),
+                )
+                valid = pos < jnp.minimum(lens, _cap)[:, None]
+                cache, scales = _pool_scatter(
+                    cache, scales, tsub, nk, nv, pos, valid
+                )
+                length = cache.length.at[slot_idx].set(lens, mode="drop")
+                return cache._replace(length=length), scales
+
+            self._insert_paged_op = instrument_jit(
+                "llm.insert_many", _insert_paged, model=self.label,
+                metrics=metrics, donate_argnums=((0, 1) if _int8 else (0,)),
+            )
+
+            def _seed(cache, scales, srcs, dsts, slot_idx, seed_lens):
+                """Exact-hit/session seeding: block-copy partial tails
+                (srcs -> dsts; pad lanes dst >= NB are dropped) and set
+                device lengths (pad lanes slot >= slots are dropped)."""
+                k2, v2, sc2 = copy_blocks(
+                    cache.k, cache.v, srcs, dsts, scales=_sc(scales)
+                )
+                length = cache.length.at[slot_idx].set(seed_lens, mode="drop")
+                return (
+                    cache._replace(k=k2, v=v2, length=length),
+                    (sc2 if _int8 else scales),
+                )
+
+            self._seed_op = instrument_jit(
+                "llm.kv_seed", _seed, model=self.label, metrics=metrics,
+                donate_argnums=((0, 1) if _int8 else (0,)),
+            )
+
+            def _restore(cache, scales, hk, hv, hs, dsts):
+                """Session restore: host-fetched blocks land back in the
+                pool at freshly-allocated ids (byte-identical h2d)."""
+                k2 = cache.k.at[:, dsts].set(hk, mode="drop")
+                v2 = cache.v.at[:, dsts].set(hv, mode="drop")
+                if _int8:
+                    scales = scales.at[:, :, dsts].set(hs, mode="drop")
+                return cache._replace(k=k2, v=v2), scales
+
+            self._restore_base = _restore
+            self._restore_ops: dict[int, Any] = {}
+
+            def _make_paged_step_op(shape: int):
+                K = decode_chunk
+
+                def _step(params, cache, scales, tables, live, tail, active,
+                          temps, pack, meta, rng):
+                    tokens = pack[:, :shape]
+                    cursors = pack[:, shape]
+                    n_new = pack[:, shape + 1]
+                    req_temps = jax.lax.bitcast_convert_type(
+                        pack[:, shape + 2], jnp.float32
+                    )
+                    slot_idx, finish = meta[0], meta[1]
+                    tsub = jnp.take(
+                        tables, jnp.clip(slot_idx, 0, slots - 1), axis=0
+                    )
+                    sub = _gather_view(cache, scales, tsub, cursors)
+                    logits, sub2 = prefill_append(
+                        params, cfg, tokens, sub, cursors, n_new, ring=0,
+                    )
+                    c = shape
+                    pos_a = cursors[:, None] + jnp.arange(c, dtype=jnp.int32)[None, :]
+                    valid_a = (
+                        jnp.arange(c, dtype=jnp.int32)[None, :] < n_new[:, None]
+                    ) & (pos_a < _cap)
+                    cache, scales = _pool_scatter(
+                        cache, scales, tsub,
+                        _rows_at(sub2.k, pos_a), _rows_at(sub2.v, pos_a),
+                        pos_a, valid_a,
+                    )
+                    length = cache.length.at[slot_idx].set(
+                        cursors + n_new, mode="drop"
+                    )
+                    cache = cache._replace(length=length)
+                    rng, sub_rng = jax.random.split(rng)
+                    first = _sample(logits, req_temps, sub_rng)
+                    fin_slot = jnp.where(finish == 1, slot_idx, _slots_oob)
+                    mid_slot = jnp.where(finish == 1, _slots_oob, slot_idx)
+                    active = active.at[mid_slot].set(False, mode="drop")
+                    tail = tail.at[fin_slot].set(first, mode="drop")
+                    active = active.at[fin_slot].set(True, mode="drop")
+                    temps = temps.at[fin_slot].set(req_temps, mode="drop")
+                    kept = logits if keep_logits else None
+                    eff = jnp.logical_and(active, live)
+                    if _use_kernel:
+                        toks, last, cache, sc, rng = decode_chunk_paged(
+                            params, cfg, tail, cache, (scales if _int8 else None),
+                            tables, eff, temps, rng,
+                            n_steps=K, sample_fn=_sample, block=Bp,
+                        )
+                        scales = sc if _int8 else scales
+                    else:
+                        dense = _gather_view(cache, scales, tables, cache.length)
+                        toks, last, nd, rng = chunk_fn(
+                            params, cfg, tail, dense, eff, temps, rng,
+                            n_steps=K, sample_fn=_sample, ring=0,
+                        )
+                        pos = cache.length[:, None] + jnp.arange(
+                            K, dtype=jnp.int32
+                        )[None, :]
+                        valid = eff[:, None] & (pos < _cap)
+                        cache, scales = _pool_scatter(
+                            cache, scales, tables,
+                            _rows_at(nd.k, pos), _rows_at(nd.v, pos), pos, valid,
+                        )
+                        cache = cache._replace(length=nd.length)
+                    return first, kept, toks, last, cache, scales, active, temps, rng
+
+                name = f"llm.step_p{shape}_d{K}"
+                return instrument_jit(
+                    name, _step, model=self.label, metrics=metrics,
+                    donate_argnums=((1, 2, 6, 7) if _int8 else (1, 6, 7)),
+                )
+
+            if self.chunked:
+                self._step_ops = {
+                    shape: _make_paged_step_op(shape)
+                    for shape in self.chunk_shapes
+                }
+
+            if self.speculative:
+                from .models.transformer import verify_chunk as verify_fn
+
+                Kd = self.spec_draft
+                Wv = Kd + 1
+
+                def _verify_paged(params, cache, scales, tables, tail, temps, pack, rng):
+                    drafts = pack[:, :Kd]
+                    n_draft = pack[:, Kd]
+                    sel = pack[:, Kd + 1] == 1
+                    n_in = jnp.where(sel, n_draft + 1, 0)
+                    toks = jnp.concatenate([tail[:, None], drafts], axis=1)
+                    dense = _gather_view(cache, scales, tables, cache.length)
+                    logits, nd = verify_fn(
+                        params, cfg, toks, dense, cache.length, n_in, ring=0,
+                    )
+                    pos = cache.length[:, None] + jnp.arange(
+                        Wv, dtype=jnp.int32
+                    )[None, :]
+                    valid = (
+                        jnp.arange(Wv, dtype=jnp.int32)[None, :] < n_in[:, None]
+                    ) & (pos < _cap)
+                    cache, scales = _pool_scatter(
+                        cache, scales, tables,
+                        _rows_at(nd.k, pos), _rows_at(nd.v, pos), pos, valid,
+                    )
+                    rng, sub = jax.random.split(rng)
+                    keys = jax.random.split(sub, Wv)
+                    ys = jnp.stack(
+                        [_sample(logits[:, j], temps, keys[j]) for j in range(Wv)],
+                        axis=1,
+                    )
+                    agree = (ys[:, :Kd] == drafts) & (
+                        jnp.arange(Kd, dtype=jnp.int32)[None, :]
+                        < n_draft[:, None]
+                    )
+                    acc = jnp.cumprod(agree.astype(jnp.int32), axis=1).sum(axis=1)
+                    bonus = jnp.take_along_axis(ys, acc[:, None], axis=1)[:, 0]
+                    new_len = jnp.where(sel, cache.length + acc + 1, cache.length)
+                    cache = cache._replace(length=new_len)
+                    tail = jnp.where(sel, bonus, tail)
+                    return ys, acc, cache, scales, tail, rng
+
+                self._verify_op = instrument_jit(
+                    f"llm.step_v{Wv}", _verify_paged, model=self.label,
+                    metrics=metrics,
+                    donate_argnums=((1, 2, 4) if _int8 else (1, 4)),
+                )
         self._rng = jax.random.PRNGKey(0)
 
-        self.cache = self.kv.init_cache(slots)
-        if device is not None:
-            self.cache = jax.device_put(self.cache, device)
+        if self.kv.paged:
+            # ONE block pool backs every slot; per-slot block tables map
+            # logical rows to pool rows. self.cache keeps the KVCache
+            # shape contract (k/v/length) so the donation chains and
+            # state threading below are identical to the contiguous
+            # layout — only the k/v geometry differs.
+            self.cache, self._kv_scales = self.kv.pool_arrays(jnp)
+            if self._kv_scales is None:
+                self._kv_scales = jnp.zeros((0,), jnp.float32)
+            self._tables_dev = jnp.zeros(
+                (slots, self.kv.table_width), jnp.int32
+            )
+            if device is not None:
+                self.cache = jax.device_put(self.cache, device)
+                self._kv_scales = jax.device_put(self._kv_scales, device)
+                self._tables_dev = jax.device_put(self._tables_dev, device)
+        else:
+            self._kv_scales = None
+            self._tables_dev = None
+            self.cache = self.kv.init_cache(slots)
+            if device is not None:
+                self.cache = jax.device_put(self.cache, device)
+        # host-side upper bound on each slot's device length (paged block
+        # allocation watermark; conservative under speculative pipelining)
+        self._kv_hi = [0] * slots
+        # end-of-turn session publishes deferred from the collector to the
+        # scheduler thread (the only thread allowed to dispatch device
+        # work against the donated pool): (slot, request) pairs
+        self._session_pub: deque = deque()
         self._slot_req: list[GenRequest | None] = [None] * slots
         # device-resident batch state: chain tail, active mask, temps.
         # active is never cleared on retire (a stale True only advances a
@@ -1178,6 +1523,19 @@ class LLMEngine:
         if req.max_new_tokens - req.emitted > room:
             req.max_new_tokens = room + req.emitted
             req.capped = True
+        if self.kv.paged:
+            # a request whose worst case exceeds the WHOLE pool could
+            # never be admitted — reject now instead of queueing forever
+            # (pool-pressure queueing is for requests that fit eventually)
+            need = self.kv.blocks_for(
+                self.kv.reserve_tokens(plen, req.max_new_tokens)
+            )
+            if need > self.kv.pool.n_blocks:
+                raise ValueError(
+                    f"request needs {need} KV blocks, pool holds "
+                    f"{self.kv.pool.n_blocks} (raise kv_pool_blocks / "
+                    "TPU_LLM_KV_POOL_BLOCKS)"
+                )
         # -- overload control (docs/advanced-guide/overload.md) -----------
         # Anything except the literal "batch" is interactive: the edges
         # forward the X-GoFr-Priority header verbatim, and a typo must
@@ -1830,6 +2188,58 @@ class LLMEngine:
             tail = jnp.zeros((self.slots,), jnp.int32)
             active = jnp.zeros((self.slots,), bool)
             temps = jnp.zeros((self.slots,), jnp.float32)
+            if self.kv.paged:
+                # paged program family: same chain, pool-layout operands.
+                # Zero tables/live/packs make every write a dropped
+                # scatter — block 0 is never touched, state stays zeros.
+                scales = self._kv_scales
+                tables = jnp.zeros(
+                    (self.slots, self.kv.table_width), jnp.int32
+                )
+                live = jnp.zeros((self.slots,), bool)
+                M = self.admit_cap
+                oob_b = self.kv.pool.n_blocks
+                for nb in nbs:
+                    scratch = self.kv.init_cache(nb)
+                    cache, scales = self._insert_paged_op(
+                        cache, scales, scratch, meta[:2], tables
+                    )
+                    cache, scales = self._seed_op(
+                        cache, scales,
+                        jnp.full((M,), oob_b, jnp.int32),
+                        jnp.full((M,), oob_b, jnp.int32),
+                        jnp.full((M,), self.slots, jnp.int32),
+                        jnp.zeros((M,), jnp.int32),
+                    )
+                    self._admit_update(
+                        jnp.zeros((self.slots,), jnp.int32),
+                        jnp.zeros((self.slots,), bool),
+                        jnp.zeros((self.slots,), jnp.float32),
+                        jnp.zeros((nb,), jnp.int32), meta,
+                    )
+                for shape, op in sorted(self._step_ops.items()):
+                    for nb in nbs:
+                        pack = jnp.zeros((nb, shape + 3), jnp.int32)
+                        smeta = jnp.full((2, nb), self.slots, jnp.int32).at[1].set(0)
+                        _f, _kept, _toks, tail, cache, scales, active, temps, _ = op(
+                            self.params, cache, scales, tables, live,
+                            tail, active, temps, pack, smeta, zero_rng,
+                        )
+                if self._verify_op is not None:
+                    vpack = jnp.zeros(
+                        (self.slots, self.spec_draft + 2), jnp.int32
+                    )
+                    _ys, _acc, cache, scales, tail, _ = self._verify_op(
+                        self.params, cache, scales, tables, tail, temps,
+                        vpack, zero_rng,
+                    )
+                for op in self._chunk_ops.values():
+                    toks, last, cache, scales, _ = op(
+                        self.params, tail, cache, scales, tables, live,
+                        active, temps, zero_rng,
+                    )
+                self._kv_scales = scales
+                return last, cache
             for nb in nbs:
                 scratch = self.kv.init_cache(nb)
                 cache = self._insert_many(cache, scratch, meta)
@@ -2171,6 +2581,13 @@ class LLMEngine:
         slot = r.slot
         if slot is not None and self._slot_req[slot] is r:
             self._slot_req[slot] = None
+            if self.kv.paged:
+                # the preempting request is about to seed this slot —
+                # return the blocks now (in-flight programs targeting
+                # them were dispatched earlier and execute before any
+                # re-user's writes; single-device program order)
+                self.kv.release_slot(slot, r)
+                self._kv_hi[slot] = 0
         r.slot = None
         entries = list(self._inflight)
         if self._processing is not None:
@@ -2344,14 +2761,45 @@ class LLMEngine:
         # without this the router undercounts a replica mid-admission and
         # least-loaded piles every request onto it
         self._admitting += len(pulled)
-        # prefix-cache consult: a hit skips its prefill wave entirely — the
+        # prefix consult: a hit skips its prefill wave entirely — the
         # retained KV rows and stored last-token logits go through the SAME
         # insert path as a prefilled wave (one _insert_many scatter + one
         # tail merge), so shared-prefix traffic costs no device prefill.
-        # lookup() pins each entry (refcount) until its rows are inserted.
+        # Contiguous layout: PrefixCache.lookup pins each entry until its
+        # rows are inserted. Paged layout: the radix tree serves exact
+        # hits (partials need the chunked scheduler's append path) and a
+        # block RESERVATION gates admission — a pool that cannot host the
+        # request's worst case keeps it queued instead of overcommitting.
         hits: list[tuple[GenRequest, Any]] = []
         misses: list[GenRequest] = pulled
-        if self.kv.prefix is not None:
+        if self.kv.paged:
+            # NOTE: no session restore here — the wave scheduler has no
+            # mid-prompt append path, so a restored session could only
+            # serve exact end records (which session publishes don't
+            # store logits for); restoring would be pure wasted DMA +
+            # pool churn. Sessions want the chunked scheduler.
+            hits, misses, blocked = [], [], []
+            for r in pulled:
+                plan = self.kv.lookup_seed(r.prompt_tokens, allow_partial=False)
+                r._kv_plan = plan
+                if not self.kv.admit_reserve(
+                    len(r.prompt_tokens), r.max_new_tokens, plan
+                ):
+                    self._kv_release_plan(r)
+                    blocked.append(r)
+                    continue
+                r._kv_resv = self.kv.reserve_need(
+                    len(r.prompt_tokens), r.max_new_tokens, plan
+                )
+                (hits.append((r, plan)) if plan is not None else misses.append(r))
+            if blocked:
+                with self._lock:
+                    self._waiting = blocked + self._waiting
+                    self._admitting -= len(blocked)
+                pulled = [r for r in pulled if r not in blocked]
+            if not pulled:
+                return False
+        elif self.kv.prefix is not None:
             hits, misses = [], []
             for r in pulled:
                 e = self.kv.prefix.lookup(self.kv.prefix.key_for(r.prompt_tokens))
@@ -2361,6 +2809,12 @@ class LLMEngine:
         except BaseException:
             self._requeue_stranded(pulled)
             raise
+        finally:
+            if self.kv.paged:
+                # plans never attached (escaping device errors, groups
+                # not reached) must drop their pins
+                for r, _plan in hits:
+                    self._kv_release_plan(r)
 
     def _admit_waves(
         self,
@@ -2376,9 +2830,12 @@ class LLMEngine:
             # unpin EVERY looked-up entry in all paths — including the
             # groups never reached when an earlier group's device call
             # escapes to the scheduler's recovery. A pin that never drops
-            # makes its entry uneviction-able forever.
-            for _, e in hits:
-                self.kv.prefix.release(e)
+            # makes its entry uneviction-able forever. (Paged hits carry
+            # SeedPlans, not pinned entries — radix mutation is
+            # scheduler-thread-only, so nothing to release.)
+            if self.kv.prefix is not None:
+                for _, e in hits:
+                    self.kv.prefix.release(e)
         # group by bucket to share prefill executions; chunks of admit_cap
         by_bucket: dict[int, list[GenRequest]] = {}
         for r in misses:
@@ -2426,6 +2883,17 @@ class LLMEngine:
                 reqs, first_dev, new_cache, free,
                 wave_nb=nb, wave_t0=t0, bucket=bucket,
             )
+            if self.kv.paged and self.kv.share:
+                # publish AFTER the insert (paged publishing shares the
+                # SLOT's resident blocks in place — they must hold the
+                # rows first); the contiguous path published the wave's
+                # own rows pre-insert above
+                for j, r in enumerate(reqs):
+                    if r.slot is not None and self._slot_req[r.slot] is r:
+                        self._kv_publish(
+                            r.slot, r,
+                            None if logits_dev is None else logits_dev[j : j + 1],
+                        )
         return True
 
     def _admit_exact_hits(
@@ -2438,6 +2906,8 @@ class LLMEngine:
         Callers own the pins — their finally releases EVERY looked-up
         entry, including groups never reached when a device call escapes
         to the scheduler's recovery."""
+        if self.kv.paged:
+            return self._admit_exact_hits_paged(hits, free)
         jnp = self._jnp
         for i in range(0, len(hits), self.admit_cap):
             group = hits[i : i + self.admit_cap]
@@ -2455,6 +2925,74 @@ class LLMEngine:
             for r in reqs:
                 r.prefix_hit = True
             self._slot_in(reqs, first_dev, new_cache, free, wave_t0=t0)
+
+    def _admit_exact_hits_paged(
+        self, hits: list[tuple[GenRequest, Any]], free: list[int]
+    ) -> None:
+        """Paged exact hits: NO KV rows move for the shared prefix — the
+        slot's block table points at the radix blocks in place
+        (refcount++); only the sub-block tail is block-copied (COW by
+        construction) and the first token re-samples from the stored
+        last-token logits, exactly the PrefixCache exact-hit contract."""
+        jnp = self._jnp
+        M = self.admit_cap
+        for i in range(0, len(hits), M):
+            group = hits[i : i + M]
+            reqs = [r for r, _ in group]
+            nb = self._wave_width(len(reqs))
+            t0 = time.perf_counter()
+            rows = [p.logits for _, p in group]
+            rows += [rows[0]] * (nb - len(group))
+            logits = jnp.concatenate(rows, axis=0)
+            temps = np.zeros((nb,), np.float32)
+            temps[: len(reqs)] = [r.temperature for r in reqs]
+            first_dev, self._rng = self._hit_first_op(
+                logits, jnp.asarray(temps), self._rng
+            )
+            now = time.perf_counter()
+            for r in reqs:
+                self._observe_admission(r, now)
+            oob_b = self.kv.pool.n_blocks
+            with self._work_cv:
+                srcs = np.full((M,), oob_b, np.int32)
+                dsts = np.full((M,), oob_b, np.int32)
+                slot_idx = np.full((M,), self.slots, np.int32)
+                lens = np.zeros((M,), np.int32)
+                meta = np.zeros((3, M), np.int32)
+                taken: list[tuple[int, GenRequest]] = []
+                for j, (r, plan) in enumerate(group):
+                    slot = free.pop(0)
+                    self._assign_slot(r, slot, now)
+                    info = self._kv_attach(r, slot, plan)
+                    taken.append((slot, r))
+                    r.prefix_hit = True
+                    r.prefill_pos = len(r.prompt_tokens)
+                    r.prefill_done = True
+                    self._load_credit(r, len(r.prompt_tokens))
+                    for s_, d_ in info["copies"]:
+                        srcs[j], dsts[j] = s_, d_
+                    slot_idx[j] = slot
+                    lens[j] = info["seed_len"]
+                    meta[0, j], meta[1, j] = slot, j
+                    meta[2, j] = np.float32(r.temperature).view(np.int32)
+                for j in range(len(group), M):
+                    meta[:, j] = meta[:, 0]
+                self.cache, self._kv_scales = self._seed_op(
+                    self.cache, self._kv_scales,
+                    jnp.asarray(srcs), jnp.asarray(dsts),
+                    jnp.asarray(slot_idx), jnp.asarray(lens),
+                )
+                md = jnp.asarray(meta)
+                self._tail, self._active, self._temps = self._admit_update(
+                    self._tail, self._active, self._temps, first_dev, md
+                )
+                self._start_fetch(first_dev)
+                self._inflight.append((
+                    "prefill", first_dev, taken,
+                    {"t0": t0, "nb": 0, "bucket": None},
+                ))
+                self._admitting -= len(reqs)
+                self._work_cv.notify()
 
     def _requeue_stranded(self, pulled: list[GenRequest]) -> None:
         """An escaping admission error strands requests already sliced out
@@ -2474,6 +3012,15 @@ class LLMEngine:
             ]
             self._waiting = stranded + self._waiting
             self._admitting -= len(stranded)
+        if self.kv.paged:
+            # hand unconsumed block promises and plan pins back: a
+            # reservation/pin whose request re-queued would otherwise
+            # shrink the pool forever
+            for r in stranded:
+                if r._kv_resv:
+                    self.kv.unreserve(r._kv_resv)
+                    r._kv_resv = 0
+                self._kv_release_plan(r)
 
     def _observe_admission(self, r: GenRequest, now: float) -> None:
         """queue_wait closes at admission (slot assigned, KV en route)."""
@@ -2508,6 +3055,209 @@ class LLMEngine:
         self._slot_req[slot] = r
         r.slot = slot
 
+    # -- paged-pool plumbing (kvcache.paged; SCHEDULER THREAD ONLY — the
+    # helpers below dispatch device work against the donated pool) -------
+    def _tables_device(self):
+        """Device mirror of the block tables, re-shipped only when the
+        host bookkeeping changed (one small h2d per table mutation, not
+        per dispatch)."""
+        t = self.kv.take_tables()
+        if t is not None:
+            self._tables_dev = self._jnp.asarray(t)
+        return self._tables_dev
+
+    def _kv_attach(self, r: GenRequest, slot: int, plan) -> dict:
+        """Bind a slot's block table to its (possibly shared) seed plan;
+        releases the previous occupant's blocks in the same move. The
+        plan's lookup-time pins transfer to the slot (attach_seed)."""
+        plen = len(r.prompt_tokens)
+        info = self.kv.attach_seed(slot, plan, r, plen, r.max_new_tokens)
+        r._kv_limit = self.kv.reserve_tokens(plen, r.max_new_tokens)
+        r._kv_resv = 0  # admission promise consumed (now on the slot)
+        r._kv_plan = None  # pins adopted by the slot table
+        self._kv_hi[slot] = info["seed_len"]
+        return info
+
+    def _kv_release_plan(self, r: GenRequest) -> None:
+        """Drop an unconsumed seed plan's pins (blocked requeues,
+        stranded admissions, groups never reached after an escaping
+        device error). Idempotent — attach clears the plan."""
+        plan = r._kv_plan
+        if plan is not None:
+            r._kv_plan = None
+            self.kv.release_plan(plan)
+
+    def _kv_publish(self, slot: int, r: GenRequest, logits_dev=None, *,
+                    session: bool = False) -> None:
+        """Publish a slot's resident prefix into the radix tree: full
+        blocks shared in place (refcount++), the sub-block tail COPIED
+        into a radix-owned block (one tiny device dispatch), last-token
+        logits retained for exact hits. session=True publishes the whole
+        conversation (prompt + emitted) and pins it to the session id."""
+        if not self.kv.paged or self.kv.radix is None:
+            return
+        # session publishes drop the LAST emitted token: a sampled token's
+        # K/V row is only written when it re-enters as the next step's
+        # input, so the final token of a finished stream has no resident
+        # row — the next turn re-prefills it along with the new text
+        tokens = r.prompt_tokens + (r.history[:-1] if session else [])
+        if not tokens:
+            return
+        plan = self.kv.publish_plan(slot, tokens, want_tail=True)
+        if plan is None:
+            return
+        jnp = self._jnp
+        if plan["tail_dst"] >= 0:
+            # padded to the SAME (admit_cap,) shape the exact-hit seeds
+            # and warmup use — a (1,)-shaped variant would compile a
+            # fresh executable on the scheduler thread at the first
+            # publish, mid-serving (pad lanes: src clipped, dst/slot
+            # out of bounds -> dropped)
+            M = self.admit_cap
+            oob_b = self.kv.pool.n_blocks
+            srcs = np.full((M,), oob_b, np.int32)
+            dsts = np.full((M,), oob_b, np.int32)
+            srcs[0], dsts[0] = plan["tail_src"], plan["tail_dst"]
+            self.cache, self._kv_scales = self._seed_op(
+                self.cache, self._kv_scales,
+                jnp.asarray(srcs), jnp.asarray(dsts),
+                jnp.full((M,), self.slots, jnp.int32),  # no length change
+                jnp.zeros((M,), jnp.int32),
+            )
+        self.kv.publish_commit(
+            plan, tokens, logits=logits_dev,
+            logits_nbytes=(0 if logits_dev is None else int(logits_dev.nbytes)),
+            session_id=(r.session_id if session else None),
+        )
+
+    def _kv_session_flush(self) -> None:
+        """Process end-of-turn session publishes the collector deferred
+        (only the scheduler may dispatch against the donated pool). Slot
+        ownership is re-checked: under slot pressure a reassigned slot's
+        publish is skipped — the session goes cold, never corrupt."""
+        while self._session_pub:
+            slot, r = self._session_pub.popleft()
+            if self.kv.slot_owner(slot) is r and not r._session_published:
+                self._kv_publish(slot, r, None, session=True)
+            r._session_published = True
+
+    def _kv_sweep(self) -> None:
+        """Return retired occupants' blocks to the pool. Runs after the
+        session flush so an end-of-turn publish still sees its blocks;
+        finished session turns awaiting their publish keep them one more
+        pass."""
+        for i in range(self.slots):
+            r = self.kv.slot_owner(i)
+            if not isinstance(r, GenRequest):
+                continue
+            if r.finish_reason is None or r.finish_reason == "failover":
+                continue
+            if (
+                r.session_id and not r._session_published
+                and r.finish_reason in ("eos", "length")
+            ):
+                continue
+            cur = self._slot_req[i]
+            if cur is None or cur is r:
+                self.kv.release_slot(i, r)
+                self._kv_hi[i] = 0
+
+    def _kv_session_spill(self) -> None:
+        """LRU-spill cold sessions' blocks to the host tier when their
+        device budget is exceeded: fetch the blocks (d2h), hand them to
+        the offload store, release the device copies."""
+        if not self.kv.paged or self.kv.sessions is None:
+            return
+        cands = self.kv.spill_candidates()
+        if not cands:
+            return
+        from .kvcache.paged import gather_blocks_host
+
+        for s in cands:
+            path = self.kv.session_path(s.id)
+            if path is None:
+                continue
+            blocks = list(path["blocks"])
+            if path["tail"] >= 0:
+                blocks.append(path["tail"])
+            if not blocks:
+                continue
+            sc = self._kv_scales if self.kv.int8 else None
+            k, v, scales = gather_blocks_host(
+                self.cache.k, self.cache.v, blocks, scales=sc
+            )
+            payload = {
+                "tokens": path["tokens"], "k": k, "v": v, "sc": scales,
+                "n_full": len(path["blocks"]), "tail_len": path["tail_len"],
+            }
+            nbytes = k.nbytes + v.nbytes + (
+                scales.nbytes if scales is not None else 0
+            )
+            self.kv.spill_commit(s.id, payload, nbytes)
+
+    def _session_prepare(self, sid: str) -> None:
+        """Admission-side session touch: a spilled conversation is
+        restored block-wise (h2d into fresh pool blocks, re-inserted
+        into the radix) BEFORE the radix consult, so the next turn's
+        prompt block-shares the whole history. A pool too tight to
+        restore leaves the session cold — full re-prefill, never an
+        error."""
+        if not self.kv.paged or self.kv.sessions is None or not sid:
+            return
+        if self.kv.session_touch(sid) != "spilled":
+            return
+        payload = self.kv.restore_fetch(sid)
+        if payload is None or payload.get("k") is None:
+            return
+        n = int(payload["k"].shape[1])
+        ids = self.kv.alloc_restore(n)
+        if ids is None:
+            # the payload is consumed and the pool cannot host it: drop
+            # the session cleanly (a "spilled" entry with no payload
+            # would leak in the registry and dead-end every later turn)
+            self.kv.session_forget(sid)
+            return
+        jnp = self._jnp
+        width = 1 << max(0, n - 1).bit_length()  # pow-2 compile shapes
+        op = self._restore_ops.get(width)
+        if op is None:
+            from .profiling import instrument_jit
+
+            op = instrument_jit(
+                f"llm.kv_restore{width}", self._restore_base,
+                model=self.label, metrics=self.metrics,
+                donate_argnums=((0, 1) if self.kv.int8 else (0,)),
+            )
+            self._restore_ops[width] = op
+        pad = width - n
+
+        def padh(a, axis):
+            if pad == 0:
+                return a
+            pw = [(0, 0)] * a.ndim
+            pw[axis] = (0, pad)
+            return np.pad(a, pw)
+
+        hk = jnp.asarray(padh(payload["k"], 1))
+        hv = jnp.asarray(padh(payload["v"], 1))
+        hs = (
+            jnp.asarray(padh(payload["sc"], 2)) if self.kv.int8
+            else jnp.zeros((0,), jnp.float32)
+        )
+        dsts = jnp.asarray(
+            np.asarray(ids + [self.kv.pool.n_blocks] * pad, np.int32)
+        )
+        with self._work_cv:
+            self.cache, self._kv_scales = op(
+                self.cache, self._kv_scales, hk, hv, hs, dsts
+            )
+        n_full = int(payload["n_full"])
+        tail_block = ids[n_full] if n > n_full else -1
+        self.kv.restore_commit(
+            sid, payload["tokens"], ids[:n_full], tail_block,
+            int(payload["tail_len"]),
+        )
+
     def _admit_chunked(self) -> bool:
         """Chunked-scheduler admission: assign waiting requests to
         (virtually) free slots IMMEDIATELY — no wave-fill hold, because
@@ -2537,7 +3287,45 @@ class LLMEngine:
         hits: list[tuple[GenRequest, Any]] = []
         partials: list[tuple[GenRequest, Any]] = []
         rest: list[GenRequest] = pulled
-        if self.kv.prefix is not None:
+        if self.kv.paged:
+            # radix consult at BLOCK granularity: exact end records skip
+            # prefill entirely; any block-aligned shared prefix seeds the
+            # slot mid-prompt (the generalization of lookup_longest —
+            # sibling prompts share every common block, not just stored
+            # whole rows). The block reservation gates admission: a pool
+            # that cannot host a request keeps it queued.
+            rest, blocked = [], []
+            for r in pulled:
+                if r.session_id:
+                    self._session_prepare(r.session_id)
+                plan = (
+                    self.kv.lookup_seed(r.prompt_tokens)
+                    if self.kv.share else None
+                )
+                r._kv_plan = plan
+                if not self.kv.admit_reserve(
+                    len(r.prompt_tokens), r.max_new_tokens, plan
+                ):
+                    self._kv_release_plan(r)
+                    blocked.append(r)
+                    continue
+                r._kv_resv = self.kv.reserve_need(
+                    len(r.prompt_tokens), r.max_new_tokens, plan
+                )
+                if plan is None:
+                    rest.append(r)
+                elif plan.exact:
+                    hits.append((r, plan))
+                else:
+                    partials.append((r, plan))
+            if blocked:
+                with self._lock:
+                    self._waiting = blocked + self._waiting
+                    self._admitting -= len(blocked)
+                pulled = [r for r in pulled if r not in blocked]
+            if not pulled:
+                return False
+        elif self.kv.prefix is not None:
             rest = []
             for r in pulled:
                 # mid-prompt seeding is a dense-layout move: a rolling
@@ -2556,36 +3344,55 @@ class LLMEngine:
                     partials.append((r, e))
         try:
             # exact hits ride the wave path's machinery unchanged: stored
-            # logits -> first token, rows -> insert_many, slot activated
+            # logits -> first token, rows -> insert_many (contiguous) or
+            # table seeding (paged), slot activated
             self._admit_exact_hits(hits, free)
-            # partial hits: one insert wave seeds the shared rows, the
-            # cursor starts at the entry's length, remaining chunks run
+            # partial hits: seed the shared prefix, start the prefill
+            # cursor mid-prompt, remaining chunks run through unified steps
             now = time.perf_counter()
-            for i in range(0, len(partials), self.admit_cap):
-                group = partials[i : i + self.admit_cap]
-                nb = self._wave_width(len(group))
-                new_cache, _logits = self.kv.prefix.assemble(
-                    [e for _, e in group], nb, self.kv.capacity
-                )
+            if self.kv.paged:
+                # block-granular seeding is pure table bookkeeping: the
+                # slot's table points at the shared radix blocks in
+                # place — ZERO device work; the first append's pack
+                # carries the cursor, so even lengths need no scatter
                 with self._work_cv:
-                    meta = np.zeros((3, self.admit_cap), np.int32)
-                    for j, (r, e) in enumerate(group):
+                    for r, plan in partials:
                         slot = free.pop(0)
                         self._assign_slot(r, slot, now)
+                        self._kv_attach(r, slot, plan)
                         r.prefix_hit = True
-                        r.prefill_pos = e.length
-                        r._rows_hi = e.length
-                        self._load_credit(r, e.length)
-                        meta[0, j], meta[1, j] = slot, j
-                    for j in range(len(group), self.admit_cap):
-                        meta[:, j] = meta[:, 0]
-                    self.cache = self._insert_many(
-                        self.cache, new_cache, jnp.asarray(meta)
-                    )
-                    for r, _e in group:
+                        r.prefill_pos = plan.shared
+                        r._rows_hi = plan.shared
+                        self._load_credit(r, plan.shared)
                         self._observe_admission(r, now)
                         self._prefilling.append(r)
-                    self._admitting -= len(group)
+                    self._admitting -= len(partials)
+            else:
+                for i in range(0, len(partials), self.admit_cap):
+                    group = partials[i : i + self.admit_cap]
+                    nb = self._wave_width(len(group))
+                    new_cache, _logits = self.kv.prefix.assemble(
+                        [e for _, e in group], nb, self.kv.capacity
+                    )
+                    with self._work_cv:
+                        meta = np.zeros((3, self.admit_cap), np.int32)
+                        for j, (r, e) in enumerate(group):
+                            slot = free.pop(0)
+                            self._assign_slot(r, slot, now)
+                            r.prefix_hit = True
+                            r.prefill_pos = e.length
+                            r._rows_hi = e.length
+                            self._load_credit(r, e.length)
+                            meta[0, j], meta[1, j] = slot, j
+                        for j in range(len(group), self.admit_cap):
+                            meta[:, j] = meta[:, 0]
+                        self.cache = self._insert_many(
+                            self.cache, new_cache, jnp.asarray(meta)
+                        )
+                        for r, _e in group:
+                            self._observe_admission(r, now)
+                            self._prefilling.append(r)
+                        self._admitting -= len(group)
         except BaseException:
             # pulled-but-unslotted requests (later groups, the whole miss
             # list) are otherwise unreachable from recovery — see
@@ -2593,14 +3400,21 @@ class LLMEngine:
             self._requeue_stranded(pulled)
             raise
         finally:
-            # unpin EVERY looked-up entry in all paths — including groups
-            # never reached when an earlier group's device call escapes to
-            # the scheduler's recovery. A pin that never drops makes its
-            # entry uneviction-able forever.
-            for _r, e in hits:
-                self.kv.prefix.release(e)
-            for _r, e in partials:
-                self.kv.prefix.release(e)
+            # unpin EVERY looked-up entry/plan in all paths — including
+            # groups never reached when an earlier group's device call
+            # escapes to the scheduler's recovery. A pin that never
+            # drops makes its entry uneviction-able (contiguous) or
+            # leaks pool refs (paged).
+            if self.kv.prefix is not None:
+                for _r, e in hits:
+                    self.kv.prefix.release(e)
+                for _r, e in partials:
+                    self.kv.prefix.release(e)
+            elif self.kv.paged:
+                for r, _plan in hits:
+                    self._kv_release_plan(r)
+                for r, _plan in partials:
+                    self._kv_release_plan(r)
         # misses: slot residency only; chunks flow through unified steps
         if rest:
             now = time.perf_counter()
@@ -2608,6 +3422,8 @@ class LLMEngine:
                 for r in rest:
                     slot = free.pop(0)
                     self._assign_slot(r, slot, now)
+                    if self.kv.paged:
+                        self._kv_attach(r, slot, None)
                     self._observe_admission(r, now)
                     self._prefilling.append(r)
                 self._admitting -= len(rest)
@@ -2650,13 +3466,25 @@ class LLMEngine:
                 r.prefill_pos = len(r.prompt_tokens)
                 r.prefill_done = True
                 self._load_credit(r, len(r.prompt_tokens))
+                if self.kv.paged:
+                    # bind the table + materialize blocks for the prompt
+                    # rows the insert scatter is about to write
+                    self._kv_attach(r, slot, None)
+                    self.kv.ensure(slot, len(r.prompt_tokens))
+                    self._kv_hi[slot] = len(r.prompt_tokens)
                 meta[0, j], meta[1, j] = slot, j
                 meta[2, j] = np.float32(r.temperature).view(np.int32)
             # pad entries duplicate entry 0 (idempotent)
             for j in range(len(reqs), self.admit_cap):
                 meta[:, j] = meta[:, 0]
             md = jnp.asarray(meta)  # ONE packed h2d per wave
-            self.cache = self._insert_many(self.cache, new_cache, md)
+            if self.kv.paged:
+                self.cache, self._kv_scales = self._insert_paged_op(
+                    self.cache, self._kv_scales, new_cache, md[:2],
+                    self._tables_device(),
+                )
+            else:
+                self.cache = self._insert_many(self.cache, new_cache, md)
             self._tail, self._active, self._temps = self._admit_update(
                 self._tail, self._active, self._temps, first_dev, md
             )
@@ -2919,6 +3747,15 @@ class LLMEngine:
             finish = "length"
         if finish is not None:
             r.finish_reason = finish
+            if (
+                self.kv.paged and r.session_id
+                and finish in ("eos", "length")
+                and self.kv.slot_owner(slot) is r
+            ):
+                # defer the end-of-turn session publish to the scheduler
+                # (only it may dispatch against the donated pool); the
+                # block sweep keeps this slot's blocks until then
+                self._session_pub.append((slot, r))
             self._observe_finish(r, time.perf_counter(), fetch_t=now)
             r.out.put(None)
             if self._slot_req[slot] is r:
@@ -2958,11 +3795,43 @@ class LLMEngine:
             )
             self._fault("device_step")
             t0 = time.perf_counter()
-            with self._hb_dispatch.beat("dispatch:chunk"):
-                toks, last, self.cache, self._rng = self._chunk_ops[k](
-                    self.params, self._tail, self.cache,
-                    self._active, self._temps, self._rng,
-                )
+            if self.kv.paged:
+                # allocate blocks ahead of the chunk's cursor advance and
+                # build the host liveness mask. Two exclusions: stale
+                # lanes (their tables may name reassigned blocks) and
+                # SATISFIED lanes — a request whose in-flight coverage
+                # already reaches max_new must stop advancing, or chunks
+                # driven by OTHER slots' demand would walk its device
+                # length past the materialized watermark and scatter
+                # through stale table entries (cross-slot corruption;
+                # the contiguous path could afford the clamped garbage)
+                steps = self._inflight_steps()
+                live = np.zeros((self.slots,), bool)
+                for i, r in enumerate(snapshot):
+                    if r is None:
+                        continue
+                    if r.emitted + steps.get(i, 0) >= r.max_new_tokens:
+                        continue
+                    live[i] = True
+                    self._kv_hi[i] = min(
+                        self._kv_hi[i] + k, r._kv_limit or self.kv.capacity
+                    )
+                    self.kv.ensure(i, self._kv_hi[i])
+                td = self._tables_device()
+                with self._hb_dispatch.beat("dispatch:chunk"):
+                    toks, last, self.cache, self._kv_scales, self._rng = (
+                        self._chunk_ops[k](
+                            self.params, self._tail, self.cache,
+                            self._kv_scales, td, self._jnp.asarray(live),
+                            self._active, self._temps, self._rng,
+                        )
+                    )
+            else:
+                with self._hb_dispatch.beat("dispatch:chunk"):
+                    toks, last, self.cache, self._rng = self._chunk_ops[k](
+                        self.params, self._tail, self.cache,
+                        self._active, self._temps, self._rng,
+                    )
             self._tail = last
             self._start_fetch(toks)
             self._inflight.append(("chunk", toks, snapshot, k, t0))
@@ -3069,6 +3938,15 @@ class LLMEngine:
                 # retaining pos + shape would store garbage rows in the
                 # prefix cache and bill them against its byte budget
                 r._rows_hi = max(r._rows_hi, pos + n)
+                if self.kv.paged:
+                    # blocks for the appended rows (+ the fused decode
+                    # chunk when this row activates)
+                    hi = pos + n + (K if done else 0)
+                    self._kv_hi[r.slot] = min(
+                        max(self._kv_hi[r.slot], hi),
+                        r._kv_limit or self.kv.capacity,
+                    )
+                    self.kv.ensure(r.slot, self._kv_hi[r.slot])
                 self._load_credit(r, n)
                 prefill_tokens += n
                 spans.append((pos, n))
@@ -3077,12 +3955,43 @@ class LLMEngine:
                     finishes.append((j, r.slot, r))
             op = self._step_ops[shape]
             t0 = time.perf_counter()
-            with self._hb_dispatch.beat("dispatch:step"):
-                first_dev, logits_dev, toks_dev, last, cache, active, temps, rng = op(
-                    self.params, self.cache, self._tail, self._active,
-                    self._temps, jnp.asarray(pack), jnp.asarray(meta),
-                    self._rng,
-                )
+            if self.kv.paged:
+                steps_cov = self._inflight_steps()
+                live = np.zeros((self.slots,), bool)
+                for i, r in enumerate(self._slot_req):
+                    if r is None or not r.prefill_done:
+                        continue
+                    if (
+                        r.emitted + steps_cov.get(i, 0) >= r.max_new_tokens
+                        and not any(s == i for _j, s, _r in finishes)
+                    ):
+                        # satisfied lane: must not advance past its
+                        # materialized blocks (see _dispatch)
+                        continue
+                    live[i] = True
+                    if not any(s == i for _j, s, _r in finishes):
+                        # already-decoding slots advance K this step
+                        self._kv_hi[i] = min(
+                            self._kv_hi[i] + K,
+                            r._kv_limit or self.kv.capacity,
+                        )
+                        self.kv.ensure(i, self._kv_hi[i])
+                td = self._tables_device()
+                with self._hb_dispatch.beat("dispatch:step"):
+                    (first_dev, logits_dev, toks_dev, last, cache,
+                     self._kv_scales, active, temps, rng) = op(
+                        self.params, self.cache, self._kv_scales, td,
+                        jnp.asarray(live), self._tail, self._active,
+                        self._temps, jnp.asarray(pack), jnp.asarray(meta),
+                        self._rng,
+                    )
+            else:
+                with self._hb_dispatch.beat("dispatch:step"):
+                    first_dev, logits_dev, toks_dev, last, cache, active, temps, rng = op(
+                        self.params, self.cache, self._tail, self._active,
+                        self._temps, jnp.asarray(pack), jnp.asarray(meta),
+                        self._rng,
+                    )
             self._tail = last
             self.cache, self._active, self._temps, self._rng = (
                 cache, active, temps, rng,
@@ -3090,10 +3999,16 @@ class LLMEngine:
             if finishes:
                 self._start_fetch(first_dev)
             self._start_fetch(toks_dev)
-            # retain finished prompts for prefix reuse: rows sliced from
-            # the slot cache AFTER the append (device-ordered before any
-            # later mutation), trimmed to the rows actually written
-            if self.kv.prefix is not None and logits_dev is not None:
+            # retain finished prompts for prefix reuse: contiguous rows
+            # sliced from the slot cache AFTER the append (device-ordered
+            # before any later mutation) / paged blocks shared in place
+            if self.kv.paged and self.kv.share:
+                for j, slot, r in finishes:
+                    self._kv_publish(
+                        slot, r,
+                        None if logits_dev is None else logits_dev[j : j + 1],
+                    )
+            elif self.kv.prefix is not None and logits_dev is not None:
                 for j, slot, r in finishes:
                     keep_rows = (
                         self.kv.capacity if self.kv.rolling
@@ -3272,11 +4187,33 @@ class LLMEngine:
                 if not n_draft[slot]:
                     self.spec_plain += 1
             t0 = time.perf_counter()
-            with self._hb_dispatch.beat("dispatch:verify"):
-                ys, acc, cache, tail, self._rng = self._verify_op(
-                    self.params, self.cache, self._tail, self._temps,
-                    jnp.asarray(pack), self._rng,
-                )
+            if self.kv.paged:
+                # blocks for the verify's transient rows: [length,
+                # length + W) per selected lane — the rollback leaves
+                # rejected rows in PRIVATE blocks above the cursor,
+                # rewritten by the next append (the contiguous path's
+                # stale-row contract, at block granularity)
+                for slot, r in sel:
+                    self._kv_hi[slot] = min(
+                        self._kv_hi[slot] + W,
+                        r._kv_limit or self.kv.capacity,
+                    )
+                    self.kv.ensure(slot, self._kv_hi[slot])
+                td = self._tables_device()
+                with self._hb_dispatch.beat("dispatch:verify"):
+                    ys, acc, cache, self._kv_scales, tail, self._rng = (
+                        self._verify_op(
+                            self.params, self.cache, self._kv_scales, td,
+                            self._tail, self._temps, jnp.asarray(pack),
+                            self._rng,
+                        )
+                    )
+            else:
+                with self._hb_dispatch.beat("dispatch:verify"):
+                    ys, acc, cache, tail, self._rng = self._verify_op(
+                        self.params, self.cache, self._tail, self._temps,
+                        jnp.asarray(pack), self._rng,
+                    )
             self.cache, self._tail = cache, tail
             self._start_fetch(ys)
             self._start_fetch(acc)
@@ -3686,6 +4623,14 @@ class LLMEngine:
                 if self._poison_fault():
                     break  # tagged payload killed this replica (terminal)
                 try:
+                    if self.kv.paged:
+                        # paged-pool housekeeping, in dependency order:
+                        # publish finished session turns (needs the
+                        # blocks), return retired slots' blocks, spill
+                        # cold sessions past their device budget
+                        self._kv_session_flush()
+                        self._kv_sweep()
+                        self._kv_session_spill()
                     did = self._admit()
                     if self._stop:
                         break
@@ -3825,6 +4770,13 @@ class LLMEngine:
             )
         self._zero_state_gauges()
         self._teardown_profiling()
+        try:
+            # a dead engine's pool/radix/session bookkeeping (and its
+            # resident-bytes gauges) must not survive it — same contract
+            # as close(); device buffers free with the engine object
+            self.kv.close()
+        except Exception:  # noqa: BLE001 — dying must not re-raise
+            pass
         if self.ledger is not None:
             self.ledger.set_active(self.label, set())  # see close()
         self._kick.set()
@@ -4199,6 +5151,14 @@ class ReplicatedLLMEngine:
         # rollout candidate before it is admitted to routing (sanity, not
         # token equality — versions legitimately differ)
         self._shadow_ring: deque = deque(maxlen=8)
+        # Session affinity (docs/advanced-guide/kv-cache.md#sessions):
+        # the paged session tier is PER-REPLICA state, so a conversation
+        # routed to a different replica pays a full re-prefill. Remember
+        # which replica holds each session and prefer it while it
+        # accepts; bounded LRU so abandoned conversations cannot grow
+        # the map forever.
+        self._session_affinity: OrderedDict[str, int] = OrderedDict()
+        self._session_affinity_cap = 65536
         self._specs = specs
         self._engine_kw = engine_kw
         if failover_retries is None:
@@ -4645,6 +5605,21 @@ class ReplicatedLLMEngine:
         # the exclusion set alone is not a terminator.
         tried: set[int] = set()
         first_err: Exception | None = None
+        # session affinity: the replica holding this conversation's KV
+        # (resident or host-spilled) serves the next turn as a prefix
+        # hit; any other replica re-prefills the whole history. Falls
+        # back to normal routing when the remembered replica is gone or
+        # not accepting — sessions degrade, never error.
+        prefer = None
+        sid = req.session_id
+        if sid:
+            eid = self._session_affinity.get(sid)
+            if eid is not None:
+                prefer = next(
+                    (e for e in self.engines if id(e) == eid), None
+                )
+                if prefer is not None and not prefer.accepting():
+                    prefer = None
         for attempt in range(2 * len(self.engines) + 2):
             if attempt > 0 and not self.retry_budget.take():
                 self.retry_budget_exhausted += 1
@@ -4652,13 +5627,21 @@ class ReplicatedLLMEngine:
                 raise first_err  # budget spent: surface the original error
             if attempt > 0:
                 self._observe_retry_budget()
-            eng = self._pick(exclude=tried)
+            if prefer is not None and id(prefer) not in tried:
+                eng = prefer
+            else:
+                eng = self._pick(exclude=tried)
             try:
                 out = eng.submit(req)
             except (EngineStoppedError, EngineDraining) as e:
                 first_err = first_err or e
                 tried.add(id(eng))
                 continue
+            if sid:
+                self._session_affinity.pop(sid, None)
+                self._session_affinity[sid] = id(eng)
+                while len(self._session_affinity) > self._session_affinity_cap:
+                    self._session_affinity.popitem(last=False)
             # shadow-probe source (rollouts): remember a bounded prefix
             # of real accepted prompts; a rollout candidate replays a few
             # before admission (deque append is thread-safe, O(1))

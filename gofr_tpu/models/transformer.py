@@ -577,6 +577,132 @@ def decode_chunk(
     return toks, last, KVCache(k=new_k, v=new_v, length=new_len), rng
 
 
+def decode_chunk_paged(
+    params: dict,
+    cfg: TransformerConfig,
+    tokens: jnp.ndarray,  # [b] last sampled token per sequence
+    pool: KVCache,  # k/v [L, NB, B, hkv, hd] block pool; length [b]
+    scales: jnp.ndarray | None,  # [2, L, NB, B, hkv] f32 (int8 pool) or None
+    tables: jnp.ndarray,  # [b, MB] int32 — logical block -> pool block
+    active: jnp.ndarray,  # [b] bool — only active slots advance/write
+    temps: jnp.ndarray,  # [b] f32 sampling temperatures
+    rng: jax.Array,
+    *,
+    n_steps: int,
+    sample_fn,
+    block: int,
+    use_kernel: bool | None = None,
+    interpret: bool = False,
+) -> tuple[jnp.ndarray, jnp.ndarray, KVCache, jnp.ndarray | None, jax.Array]:
+    """decode_chunk against a BLOCK-PAGED pool (gofr_tpu.kvcache.paged).
+
+    Same fused-chunk structure as decode_chunk — the pool is read-only
+    inside the chunk, each step's K/V lands at the uniform position
+    `step` of the small per-chunk buffer, one merge at chunk end — but
+    the main-region attention READS THROUGH THE BLOCK TABLE
+    (ops.paged_chunk_decode_attention: Pallas paged kernel on TPU,
+    dense-gather fallback elsewhere) and the merge scatters the chunk's
+    rows through the table into pool blocks. Write indices derive from
+    DEVICE lengths, so pipelined dispatches and speculative rollbacks
+    can never mis-aim a write; `active` must already exclude slots whose
+    request retired (their table entries may point at reassigned
+    blocks — the engine passes its host-side liveness mask, where the
+    contiguous path could afford clamped garbage writes).
+
+    Greedy outputs are token-identical to decode_chunk on the gathered
+    dense view: every (query, key) pair sees the same dot products and
+    the same positional masks, only the storage layout differs.
+
+    Returns (tokens [n_steps, b], last [b], pool', scales', rng).
+    """
+    from ..kvcache.paged import scatter_rows
+    from ..ops import paged_chunk_decode_attention
+
+    L, b = cfg.n_layers, tokens.shape[0]
+    hq, hkv, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    K = n_steps
+    quant = scales is not None and scales.size > 0
+    kb0 = jnp.zeros((L, b, K, hkv, hd), cfg.dtype)
+    vb0 = jnp.zeros((L, b, K, hkv, hd), cfg.dtype)
+    rng, sub = jax.random.split(rng)
+    keys = jax.random.split(sub, K)
+    ks_all = scales[0] if quant else None  # [L, NB, B, hkv]
+    vs_all = scales[1] if quant else None
+
+    def step(carry, inp):
+        tok, kb, vb = carry
+        k_i, key = inp
+        positions = (pool.length + k_i)[:, None]  # [b, 1]
+        x = _embed_tokens(params, cfg, tok[:, None])
+
+        def layer(x, xs):
+            if quant:
+                lp, kp_l, vp_l, ks_l, vs_l, kb_l, vb_l = xs
+            else:
+                lp, kp_l, vp_l, kb_l, vb_l = xs
+                ks_l = vs_l = None
+            h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
+            q = qmm(h, lp["wq"])
+            if cfg.qkv_bias:
+                q = q + lp["bq"].astype(q.dtype)
+            q = q.reshape(b, 1, hq, hd)
+            kv = qmm(h, lp["wkv"])
+            if cfg.qkv_bias:
+                kv = kv + lp["bkv"].astype(kv.dtype)
+            kv = kv.reshape(b, 1, hkv, 2, hd)
+            k_new, v_new = kv[:, :, :, 0], kv[:, :, :, 1]
+            q = apply_rope(q, positions, cfg.rope_theta)
+            k_new = apply_rope(k_new, positions, cfg.rope_theta)
+            kb_l = jax.lax.dynamic_update_slice(
+                kb_l, k_new.astype(kb_l.dtype), (0, k_i, 0, 0)
+            )
+            vb_l = jax.lax.dynamic_update_slice(
+                vb_l, v_new.astype(vb_l.dtype), (0, k_i, 0, 0)
+            )
+            attn = paged_chunk_decode_attention(
+                q, kp_l, vp_l, tables, kb_l, vb_l, pool.length, k_i,
+                logit_cap=cfg.attn_logit_cap, window=cfg.sliding_window,
+                k_scales=ks_l, v_scales=vs_l,
+                use_kernel=use_kernel, interpret=interpret,
+            )
+            x = x + qmm(attn.reshape(b, 1, hq * hd), lp["wo"]).astype(x.dtype)
+            h = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
+            x = x + qmm(
+                _act_fn(cfg)(qmm(h, lp["w_gate"])) * qmm(h, lp["w_up"]),
+                lp["w_down"],
+            )
+            return x, (kb_l, vb_l)
+
+        xs = (
+            (params["layers"], pool.k, pool.v, ks_all, vs_all, kb, vb)
+            if quant else (params["layers"], pool.k, pool.v, kb, vb)
+        )
+        x, (kb, vb) = jax.lax.scan(layer, x, xs)
+        logits = _unembed_last(params, cfg, x)
+        nt = sample_fn(logits, temps, key).astype(jnp.int32)
+        return (nt, kb, vb), nt
+
+    (last, kb, vb), toks = jax.lax.scan(
+        step, (tokens, kb0, vb0), (jnp.arange(K, dtype=jnp.int32), keys)
+    )
+
+    # merge: the chunk's K rows scatter through the table at positions
+    # [length, length + K) — private (refcount-1) blocks by the engine's
+    # seed/COW construction, so no shared block is ever written
+    cap = tables.shape[1] * block
+    pos = pool.length[:, None] + jnp.arange(K, dtype=jnp.int32)[None, :]
+    valid = active[:, None] & (pos < cap)
+    k2, v2, sc2 = scatter_rows(
+        pool.k, pool.v, tables, kb, vb, pos, valid,
+        scales=(scales if quant else None),
+    )
+    new_len = jnp.where(active, jnp.minimum(pool.length + K, cap), pool.length)
+    return (
+        toks, last, KVCache(k=k2, v=v2, length=new_len),
+        (sc2 if quant else scales), rng,
+    )
+
+
 def _append_forward(
     params: dict,
     cfg: TransformerConfig,

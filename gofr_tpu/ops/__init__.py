@@ -15,6 +15,9 @@ from .attention import (
     flash_attention,
     mha_reference,
     multi_head_attention,
+    paged_chunk_decode_attention,
+    paged_gather,
+    paged_kernel_ok,
     ring_positions,
 )
 from .norms import rms_norm
@@ -27,6 +30,9 @@ __all__ = [
     "decode_attention",
     "chunk_decode_attention",
     "chunk_prefill_attention",
+    "paged_chunk_decode_attention",
+    "paged_gather",
+    "paged_kernel_ok",
     "ring_positions",
     "rms_norm",
     "apply_rope",

@@ -613,6 +613,304 @@ def chunk_prefill_attention(
 
 
 # ---------------------------------------------------------------------------
+# Paged attention (block-pool KV; gofr_tpu.kvcache.paged)
+# ---------------------------------------------------------------------------
+#
+# Decode against a BLOCK-PAGED KV pool: per-sequence block tables map
+# logical row p to pool row table[p // B] * B + p % B, so the decode read
+# stream follows the table instead of a contiguous slab. Two paths,
+# selected at trace time exactly like the flash kernel:
+#
+# - Pallas TPU kernel (_paged_decode_partials): grid (batch, kv_head,
+#   table_slot); the block table and the per-sequence valid bounds ride
+#   as SCALAR PREFETCH operands, so each grid cell's BlockSpec index_map
+#   DMAs pool block table[b, j] directly — the pool is never gathered
+#   into a dense copy, which is the whole point (decode is HBM-bound;
+#   a gather would double the dominant stream). Returns online-softmax
+#   PARTIALS (normalized output + running max + denom) so the caller can
+#   merge the chunk ring buffer region with one rescale.
+# - Dense-gather reference (paged_gather): jnp.take the table rows into
+#   the contiguous layout and reuse the proven attention above — the
+#   CPU/old-jax fallback and the test oracle. Bit-exact with the
+#   contiguous engine because gathering blocks in table order
+#   reconstructs the same slab.
+#
+# int8 KV blocks (TPU_LLM_KV_INT8): the pool stores int8 rows plus one
+# f32 scale per (row, kv_head); both paths dequantize after the read, so
+# the HBM stream the decode loop is bound by moves at half width.
+
+
+def paged_gather(k_pool, v_pool, tables, *, k_scales=None, v_scales=None, dtype=None):
+    """[NB, B, hkv, d] pools -> dense [b, MB*B, hkv, d] views through
+    [b, MB] block tables (the reference read path). Stale table entries
+    gather stale blocks — callers mask by position exactly as on the
+    contiguous layout."""
+
+    def take(pool, sc):
+        g = jnp.take(pool, tables, axis=0, mode="clip")  # [b, MB, B, hkv, d]
+        b, MB, B, hkv, d = g.shape
+        g = g.reshape(b, MB * B, hkv, d)
+        if sc is not None:
+            s = jnp.take(sc, tables, axis=0, mode="clip").reshape(b, MB * B, hkv)
+            g = g.astype(dtype) * s[..., None].astype(dtype)
+        return g
+
+    return take(k_pool, k_scales), take(v_pool, v_scales)
+
+
+def _paged_decode_kernel(
+    # scalar prefetch: block tables + per-sequence valid bounds
+    tbl_ref, lo_ref, hi_ref,
+    # inputs (q, k block, v block[, k scales, v scales]), outputs, scratch
+    *refs,
+    block: int,
+    scale: float,
+    logit_cap: float,
+    quantized: bool,
+):
+    if quantized:
+        q_ref, k_ref, v_ref, ks_ref, vs_ref, o_ref, m_ref, l_ref, m_s, l_s, acc_s = refs
+    else:
+        q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, m_s, l_s, acc_s = refs
+        ks_ref = vs_ref = None
+    bi = pl.program_id(0)
+    ji = pl.program_id(2)
+    nj = pl.num_programs(2)
+
+    @pl.when(ji == 0)
+    def _init():
+        m_s[:] = jnp.full_like(m_s, NEG_INF)
+        l_s[:] = jnp.zeros_like(l_s)
+        acc_s[:] = jnp.zeros_like(acc_s)
+
+    lo = lo_ref[bi]
+    hi = hi_ref[bi]
+    base = ji * block  # logical position of this table slot's first row
+    live = jnp.logical_and(base < hi, base + block > lo)
+
+    @pl.when(live)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32) * scale  # [group, d]
+        k = k_ref[0, :, 0].astype(jnp.float32)  # [block, d]
+        v = v_ref[0, :, 0].astype(jnp.float32)
+        if ks_ref is not None:
+            k = k * ks_ref[0, :, 0][:, None]
+            v = v * vs_ref[0, :, 0][:, None]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [group, block]
+        if logit_cap > 0.0:
+            s = logit_cap * jnp.tanh(s / logit_cap)
+        pos = base + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(jnp.logical_and(pos >= lo, pos < hi), s, NEG_INF)
+        m_prev = m_s[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_s[:] = jnp.broadcast_to(
+            alpha * l_s[:, :1] + jnp.sum(p, axis=-1, keepdims=True), l_s.shape
+        )
+        m_s[:] = jnp.broadcast_to(m_new, m_s.shape)
+        acc_s[:] = acc_s[:] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    @pl.when(ji == nj - 1)
+    def _finalize():
+        denom = l_s[:, :1]
+        denom = jnp.where(denom == 0.0, 1.0, denom)
+        o_ref[0, 0] = (acc_s[:] / denom).astype(o_ref.dtype)
+        m_ref[0, 0] = m_s[:].astype(m_ref.dtype)
+        l_ref[0, 0] = l_s[:].astype(l_ref.dtype)
+
+
+def _paged_decode_partials(
+    q: jnp.ndarray,  # [b, hq, d] one query per sequence
+    k_pool: jnp.ndarray,  # [NB, B, hkv, d]
+    v_pool: jnp.ndarray,
+    tables: jnp.ndarray,  # [b, MB] int32 pool block per logical slot
+    lo: jnp.ndarray,  # [b] int32 first valid logical position (window)
+    hi: jnp.ndarray,  # [b] int32 one past the last valid position
+    *,
+    scale: float,
+    logit_cap: float = 0.0,
+    k_scales=None,  # [NB, B, hkv] f32 (int8 pool)
+    v_scales=None,
+    interpret: bool = False,
+):
+    """Pallas paged-attention decode over the valid band [lo, hi):
+    returns (o [b, hq, d] f32 normalized, m [b, hq] f32, l [b, hq] f32)
+    online-softmax partials for region merging."""
+    if not _HAS_PLTPU:
+        raise RuntimeError("paged decode kernel requires pallas TPU support")
+    b, hq, d = q.shape
+    NB, B, hkv, _ = k_pool.shape
+    MB = tables.shape[1]
+    group = hq // hkv
+    quantized = k_scales is not None
+
+    qt = q.reshape(b, hkv, group, d)
+
+    def q_index(bi, hi_, ji, tbl, lo_, hi__):
+        return (bi, hi_, 0, 0)
+
+    def kv_index(bi, hi_, ji, tbl, lo_, hi__):
+        return (tbl[bi, ji], 0, hi_, 0)
+
+    in_specs = [
+        pl.BlockSpec((1, 1, group, d), q_index),
+        pl.BlockSpec((1, B, 1, d), kv_index),
+        pl.BlockSpec((1, B, 1, d), kv_index),
+    ]
+    operands = [qt, k_pool, v_pool]
+    if quantized:
+
+        def sc_index(bi, hi_, ji, tbl, lo_, hi__):
+            return (tbl[bi, ji], 0, hi_)
+
+        in_specs += [
+            pl.BlockSpec((1, B, 1), sc_index),
+            pl.BlockSpec((1, B, 1), sc_index),
+        ]
+        operands += [k_scales, v_scales]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(b, hkv, MB),
+        in_specs=in_specs,
+        out_specs=[
+            pl.BlockSpec((1, 1, group, d), q_index),
+            pl.BlockSpec((1, 1, group, 128), q_index),
+            pl.BlockSpec((1, 1, group, 128), q_index),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((group, 128), jnp.float32),
+            pltpu.VMEM((group, 128), jnp.float32),
+            pltpu.VMEM((group, d), jnp.float32),
+        ],
+    )
+    kernel = functools.partial(
+        _paged_decode_kernel,
+        block=B, scale=scale, logit_cap=logit_cap, quantized=quantized,
+    )
+    o, m, l = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((b, hkv, group, d), jnp.float32),
+            jax.ShapeDtypeStruct((b, hkv, group, 128), jnp.float32),
+            jax.ShapeDtypeStruct((b, hkv, group, 128), jnp.float32),
+        ],
+        interpret=interpret,
+    )(
+        tables.astype(jnp.int32), lo.astype(jnp.int32), hi.astype(jnp.int32),
+        *operands,
+    )
+    return (
+        o.reshape(b, hq, d),
+        m[..., 0].reshape(b, hq),
+        l[..., 0].reshape(b, hq),
+    )
+
+
+def paged_kernel_ok(head_dim: int, block: int, *, interpret: bool = False) -> bool:
+    """Whether the Pallas paged-decode kernel can serve this config:
+    TPU backend (or interpret mode for tests), lane-aligned head_dim,
+    sublane-aligned block size."""
+    if not _HAS_PLTPU:
+        return False
+    if not interpret and jax.default_backend() != "tpu":
+        return False
+    return head_dim % 128 == 0 and block % 8 == 0
+
+
+def paged_chunk_decode_attention(
+    q: jnp.ndarray,  # [b, 1, hq, d]
+    k_pool: jnp.ndarray,  # [NB, B, hkv, d] (one layer's pool)
+    v_pool: jnp.ndarray,
+    tables: jnp.ndarray,  # [b, MB] int32
+    k_buf: jnp.ndarray,  # [b, chunk, hkv, d] — this chunk's new K rows
+    v_buf: jnp.ndarray,
+    lengths: jnp.ndarray,  # [b] valid pool prefix (at chunk START)
+    step: jnp.ndarray,  # scalar int32 — current step within the chunk
+    *,
+    scale: float | None = None,
+    logit_cap: float = 0.0,
+    window: int = 0,
+    k_scales=None,
+    v_scales=None,
+    use_kernel: bool | None = None,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """chunk_decode_attention reading the MAIN region through a block
+    table: pool rows hold logical positions [0, lengths) via the table,
+    the chunk ring buffer holds positions [lengths, lengths + step]. The
+    Pallas path never materializes the gathered cache (partials merged
+    with the dense buffer region by one rescale); the reference path
+    gathers and defers to chunk_decode_attention — both produce the
+    contiguous path's exact masks and dot products, which is what the
+    paged==contiguous token-equality tests pin."""
+    b, sq, hq, d = q.shape
+    B = k_pool.shape[1]
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    if use_kernel is None:
+        use_kernel = paged_kernel_ok(d, B, interpret=interpret)
+    if not use_kernel:
+        kc, vc = paged_gather(
+            k_pool, v_pool, tables,
+            k_scales=k_scales, v_scales=v_scales, dtype=q.dtype,
+        )
+        return chunk_decode_attention(
+            q, kc, vc, k_buf, v_buf, lengths, step,
+            scale=scale, logit_cap=logit_cap, window=window, ring=0,
+        )
+    # main region via the paged kernel: valid band [lo, hi)
+    hi = lengths
+    if window > 0:
+        lo = jnp.maximum(lengths + step - window + 1, 0)
+    else:
+        lo = jnp.zeros_like(lengths)
+    o_m, m_m, l_m = _paged_decode_partials(
+        q[:, 0], k_pool, v_pool, tables, lo, hi,
+        scale=scale, logit_cap=logit_cap,
+        k_scales=k_scales, v_scales=v_scales, interpret=interpret,
+    )
+    # buffer region (dense, [b, chunk]) — same mask set as
+    # chunk_decode_attention's buffer half
+    hkv = k_buf.shape[2]
+    group = hq // hkv
+    chunk = k_buf.shape[1]
+    qg = (q.astype(jnp.float32) * scale).reshape(b, 1, hkv, group, d)
+    s_buf = jnp.einsum(
+        "bqhgd,bkhd->bhgqk", qg, k_buf.astype(jnp.float32),
+        preferred_element_type=jnp.float32,
+    )  # [b, hkv, group, 1, chunk]
+    if logit_cap > 0.0:
+        s_buf = logit_cap * jnp.tanh(s_buf / logit_cap)
+    buf_mask = jnp.arange(chunk)[None, :] <= step
+    if window > 0:
+        buf_mask = buf_mask & (jnp.arange(chunk)[None, :] > step - window)
+    s_buf = jnp.where(buf_mask[:, None, None, None, :], s_buf, NEG_INF)
+    m_b = jnp.max(s_buf, axis=-1)  # [b, hkv, group, 1]
+    p_buf = jnp.exp(s_buf - m_b[..., None])
+    l_b = jnp.sum(p_buf, axis=-1)
+    o_b = jnp.einsum(
+        "bhgqk,bkhd->bhgqd", p_buf, v_buf.astype(jnp.float32),
+        preferred_element_type=jnp.float32,
+    )  # [b, hkv, group, 1, d] — UNNORMALIZED (divided below)
+    m_b = m_b.reshape(b, hq)
+    l_b = l_b.reshape(b, hq)
+    o_b = o_b.reshape(b, hq, d)
+    # merge the two regions' online-softmax partials
+    m = jnp.maximum(m_m, m_b)
+    a_m = jnp.exp(m_m - m) * l_m
+    a_b = jnp.exp(m_b - m)
+    denom = a_m + a_b * l_b
+    denom = jnp.where(denom == 0.0, 1.0, denom)
+    out = (o_m * a_m[..., None] + o_b * a_b[..., None]) / denom[..., None]
+    return out[:, None].astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
 # Dispatcher
 # ---------------------------------------------------------------------------
 

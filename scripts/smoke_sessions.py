@@ -1,0 +1,182 @@
+#!/usr/bin/env python
+"""CI smoke: paged-KV session tier end-to-end over real sockets.
+
+Boots a tiny-model app on the CPU backend with two registered engines —
+"chat" (paged pool + session tier) and "control" (same shapes, no
+sessions) — and drives 2-turn conversations over HTTP with the
+``X-GoFr-Session`` header (docs/advanced-guide/kv-cache.md#sessions):
+
+- second-turn latency beats first-turn latency: the session's resident
+  blocks make turn 2 a block-granular prefix hit over the whole
+  history, so only the new text prefills (long prompt, 2-token
+  completions — prefill dominates the wall),
+- a forced spill to the host tier followed by a resume produces a body
+  BYTE-IDENTICAL to the sessionless control engine's for the same
+  tokens (restore is exact, greedy continuations prove it),
+- the session/pool counters are live on the real /metrics socket.
+
+Usage: JAX_PLATFORMS=cpu python scripts/smoke_sessions.py
+Exit codes: 0 clean, non-zero assertion failure (message on stderr).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import statistics
+import sys
+import time
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    import jax
+
+    from gofr_tpu import App
+    from gofr_tpu.config import new_mock_config
+    from gofr_tpu.handler import llm_request_kwargs
+    from gofr_tpu.llm import GenRequest
+    from gofr_tpu.models import TransformerConfig, init_params
+
+    cfg = TransformerConfig.tiny()
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    app = App(config=new_mock_config({
+        "APP_NAME": "sessions-smoke", "HTTP_PORT": "0", "METRICS_PORT": "0",
+        "LOG_LEVEL": "ERROR", "TPU_TELEMETRY_INTERVAL_S": "0",
+        "REQUEST_TIMEOUT": "120",
+    }))
+    kw = dict(
+        slots=2, max_seq_len=320, prefill_buckets=(64, 192),
+        decode_chunk=4, warmup=False,
+    )
+    app.container.tpu().register_llm(
+        "chat", cfg, params, session_mb=64.0, prefix_cache_mb=16.0, **kw
+    )
+    app.container.tpu().register_llm("control", cfg, params, **kw)
+
+    def gen(name):
+        def handler(ctx):
+            body = ctx.bind()
+            req = GenRequest(
+                list(body["tokens"]),
+                max_new_tokens=int(body.get("max_new_tokens", 2)),
+                **llm_request_kwargs(ctx),
+            )
+            return {"tokens": ctx.tpu().llm(name).submit(req).tokens()}
+
+        return handler
+
+    app.post("/chat", gen("chat"))
+    app.post("/control", gen("control"))
+    app.run_in_background()
+    base = f"http://127.0.0.1:{app.http_server.port}"
+    mbase = f"http://127.0.0.1:{app.metrics_server.port}"
+    try:
+        rng_tokens = [((i * 37) % (cfg.vocab_size - 2)) + 1 for i in range(180)]
+
+        def post(route, tokens, session="", n=2):
+            headers = {"Content-Type": "application/json"}
+            if session:
+                headers["X-GoFr-Session"] = session
+            req = urllib.request.Request(
+                f"{base}/{route}",
+                data=json.dumps(
+                    {"tokens": tokens, "max_new_tokens": n}
+                ).encode(),
+                headers=headers, method="POST",
+            )
+            t0 = time.perf_counter()
+            with urllib.request.urlopen(req, timeout=120) as r:
+                body = r.read()
+            return body, time.perf_counter() - t0
+
+        # warm every executable shape on a throwaway conversation first:
+        # first-turn-vs-second-turn must compare PREFILL work, not the
+        # one-time compile bill
+        warm_prompt = [3] * 170
+        wb, _ = post("chat", warm_prompt, session="warm")
+        wt2 = warm_prompt + json.loads(wb)["data"]["tokens"] + [5, 6]
+        post("chat", wt2, session="warm")
+        post("control", wt2)
+
+        chat = app.container.tpu().llm("chat")
+        t1s, t2s = [], []
+        for i in range(3):
+            prompt = [((t + i) % (cfg.vocab_size - 2)) + 1 for t in rng_tokens]
+            body1, dt1 = post("chat", prompt, session=f"conv{i}")
+            out1 = json.loads(body1)["data"]["tokens"]
+            deadline = time.time() + 20
+            while time.time() < deadline:
+                if chat.kv.sessions.stats()["publishes"] >= i + 2:
+                    break
+                time.sleep(0.02)
+            turn2 = prompt + out1 + [7, 8, 9]
+            body2, dt2 = post("chat", turn2, session=f"conv{i}")
+            # correctness against the sessionless control engine
+            cbody, _ = post("control", turn2)
+            assert body2 == cbody, (body2, cbody)
+            t1s.append(dt1)
+            t2s.append(dt2)
+        med1, med2 = statistics.median(t1s), statistics.median(t2s)
+        assert med2 < med1, (
+            f"second-turn latency {med2 * 1e3:.1f}ms did not beat "
+            f"first-turn {med1 * 1e3:.1f}ms (no shared-prefix win?)"
+        )
+        st = chat.stats()["kvcache"]
+        assert st["prefix"]["partial_hits"] >= 3, st["prefix"]
+        print(f"2-turn conversations: turn1 {med1 * 1e3:.1f}ms -> "
+              f"turn2 {med2 * 1e3:.1f}ms "
+              f"(partial hits {st['prefix']['partial_hits']})")
+
+        # forced spill -> restore: byte-identical continuation
+        sess = chat.kv.sessions
+        sess.device_budget = 1
+        chat._kick.set()
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            if sess.stats()["resident"] == 0:
+                break
+            time.sleep(0.02)
+        stats = sess.stats()
+        assert stats["spilled"] >= 3, stats
+        assert stats["offload"]["spilled_bytes"] > 0, stats
+        sess.device_budget = 64 * 2**20
+        prompt = [((t + 0) % (cfg.vocab_size - 2)) + 1 for t in rng_tokens]
+        out1 = json.loads(post("control", prompt)[0])["data"]["tokens"]
+        turn3 = prompt + out1 + [7, 8, 9, 10, 11]
+        rbody, _ = post("chat", turn3, session="conv0")
+        cbody, _ = post("control", turn3)
+        assert rbody == cbody, (
+            f"restored body diverged:\n  chat    {rbody!r}\n"
+            f"  control {cbody!r}"
+        )
+        assert sess.stats()["offload"]["restores"] >= 1, sess.stats()
+        print(f"spill+restore: {stats['spilled']} sessions spilled "
+              f"({stats['offload']['spilled_bytes']} bytes), restored "
+              f"body byte-identical ({len(rbody)} bytes)")
+
+        # counters over the real /metrics socket
+        with urllib.request.urlopen(f"{mbase}/metrics", timeout=15) as r:
+            expo = r.read().decode()
+        for name in (
+            "app_kvcache_session_events",
+            "app_kvcache_session_count",
+            "app_kvcache_spilled_bytes",
+            "app_kvcache_blocks_in_use",
+            "app_kvcache_blocks_shared",
+        ):
+            assert name in expo, f"{name} missing from /metrics"
+        assert 'event="publish"' in expo and 'event="spill"' in expo, (
+            "session lifecycle events missing"
+        )
+        print("session counters visible on /metrics")
+        print("SMOKE OK")
+        return 0
+    finally:
+        app.shutdown()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -177,6 +177,36 @@ def test_summary_line_carries_speculative():
     assert "speculative" not in bench._summary_line(_serving_result())
 
 
+def test_summary_line_carries_sessions():
+    """BENCH_r14+: the paged-pool sessions point rides the summary as a
+    compact block (paged/int8 vs contiguous decode ratios, HBM bytes per
+    idle session vs slot residency, warm second-turn TTFT, cold resume
+    vs full re-prefill)."""
+    r = _serving_result()
+    r["detail"]["sessions"] = {
+        "paged_tok_s": 23000.0, "contig_tok_s": 24000.0,
+        "paged_vs_contig": 0.96, "int8_tok_s": 29000.0,
+        "int8_vs_contig": 1.21, "sessions": 32, "shared_frac": 0.5,
+        "hbm_bytes_per_idle_session": 1200000, "slot_equiv_bytes": 5400000,
+        "idle_session_vs_slot": 0.22, "blocks_shared": 40,
+        "first_turn_ttft_ms": 90.0, "second_turn_ttft_ms": 31.0,
+        "spilled_sessions": 32, "spilled_mb": 36.0,
+        "cold_resume_ttft_ms": 45.0, "reprefill_ttft_ms": 95.0,
+        "resume_vs_reprefill": 0.47,
+    }
+    s = bench._summary_line(r)
+    assert s["sessions"] == {
+        "paged_vs_contig": 0.96, "int8_vs_contig": 1.21,
+        "idle_session_vs_slot": 0.22,
+        "hbm_bytes_per_idle_session": 1200000,
+        "second_turn_ttft_ms": 31.0, "cold_resume_ttft_ms": 45.0,
+        "resume_vs_reprefill": 0.47,
+    }
+    assert len(json.dumps(s)) < 1800
+    # absent block (--no-sessions / CPU runs) must not leak a key
+    assert "sessions" not in bench._summary_line(_serving_result())
+
+
 def test_summary_line_carries_rollout():
     """BENCH_r13+: the live weight-rollout point rides the summary as a
     compact block (terminal state, error count, time-to-fully-shifted,

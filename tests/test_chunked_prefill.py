@@ -293,6 +293,7 @@ class TestStepDeactivatesReusedSlot:
         eng = LLMEngine(
             CFG, params, slots=2, max_seq_len=64, prefill_buckets=(8,),
             prefill_chunk=8, step_token_budget=16, warmup=False,
+            kv_paged=False,  # pins the dense step-op signature
         )
         try:
             op = eng._step_ops[8]
@@ -409,6 +410,7 @@ class TestPrefixSeeding:
         eng = LLMEngine(
             CFG, params, slots=2, max_seq_len=64, prefill_buckets=(8,),
             prefill_chunk=8, warmup=False, prefix_cache_mb=8.0,
+            kv_paged=False,  # pins PrefixCache row-trim accounting
         )
         try:
             prompt = list(range(1, 10))  # 9 tokens straddle the 8-chunk
@@ -425,6 +427,7 @@ class TestPrefixSeeding:
         eng = LLMEngine(
             CFGW, params_w, slots=2, max_seq_len=64, prefill_buckets=(16,),
             prefill_chunk=16, warmup=False, prefix_cache_mb=8.0,
+            kv_paged=False,  # pins the rolling layout's partial-probe skip
         )
         try:
             shared = list(range(1, 18))
@@ -657,7 +660,7 @@ class TestAdmissionFailureRecovery:
         eng = LLMEngine(
             CFG, params, slots=2, max_seq_len=64, prefill_buckets=(8,),
             prefill_chunk=8, step_token_budget=16, prefix_cache_mb=4,
-            warmup=False,
+            warmup=False, kv_paged=False,  # wedges PrefixCache.assemble
         )
         try:
             prompt = [7, 3, 1, 4]

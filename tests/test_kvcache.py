@@ -88,15 +88,19 @@ class TestRingPack:
 
 
 class TestRollingEngine:
+    # kv_paged=False throughout: these tests pin the CONTIGUOUS layouts
+    # (rolling ring vs dense slab), kept as the paged pool's A/B lever —
+    # paged engines are pinned against them in tests/test_paged_kv.py
     @pytest.fixture(scope="class")
     def engines(self, params_w):
         rolling = LLMEngine(
             CFGW, params_w, slots=2, max_seq_len=64, prefill_buckets=(16, 32),
-            warmup=False,
+            warmup=False, kv_paged=False,
         )
         dense = LLMEngine(
             CFGW, params_w, slots=2, max_seq_len=64, prefill_buckets=(16, 32),
-            warmup=False, kv_window=0,  # force the dense slab (A/B lever)
+            warmup=False, kv_paged=False,
+            kv_window=0,  # force the dense slab (A/B lever)
         )
         yield rolling, dense
         rolling.close()
@@ -127,6 +131,7 @@ class TestRollingEngine:
         eng = LLMEngine(
             CFGW, params_w, slots=2, max_seq_len=256, prefill_buckets=(128,),
             prefill_chunk=16, warmup=False,  # chunk shape caps the ring slack
+            kv_paged=False,
         )
         try:
             kv = eng.kv.stats()
@@ -197,6 +202,9 @@ class TestPrefixCacheUnit:
 
 
 class TestPrefixEngine:
+    # kv_paged=False: these pin the contiguous whole-row PrefixCache
+    # (byte formulas, wave accounting); the paged radix equivalents live
+    # in tests/test_paged_kv.py / tests/test_sessions.py
     def test_cached_matches_uncached_and_skips_prefill(self, params):
         from gofr_tpu.metrics import new_metrics_manager
 
@@ -204,10 +212,11 @@ class TestPrefixEngine:
         eng = LLMEngine(
             CFG, params, slots=4, max_seq_len=64, prefill_buckets=(8, 16),
             warmup=False, prefix_cache_mb=8.0, metrics=metrics,
+            kv_paged=False,
         )
         plain = LLMEngine(
             CFG, params, slots=4, max_seq_len=64, prefill_buckets=(8, 16),
-            warmup=False,
+            warmup=False, kv_paged=False,
         )
         try:
             prompt = [5, 9, 2]
@@ -246,7 +255,7 @@ class TestPrefixEngine:
         evictions fire, and every completion stays correct."""
         eng = LLMEngine(
             CFG, params, slots=2, max_seq_len=64, prefill_buckets=(8,),
-            warmup=False, prefix_cache_mb=0.02,
+            warmup=False, prefix_cache_mb=0.02, kv_paged=False,
         )
         try:
             prompts = [[i + 1, i + 2, i + 3] for i in range(6)]
@@ -265,7 +274,7 @@ class TestPrefixEngine:
         comes from the stored logits; determinism is a greedy property)."""
         eng = LLMEngine(
             CFG, params, slots=2, max_seq_len=64, prefill_buckets=(8,),
-            warmup=False, prefix_cache_mb=8.0,
+            warmup=False, prefix_cache_mb=8.0, kv_paged=False,
         )
         try:
             eng.generate([4, 4, 4], max_new_tokens=4)  # seed the cache
@@ -283,7 +292,7 @@ class TestPrefixEngine:
         windowed config reproduces the uncached stream exactly."""
         eng = LLMEngine(
             CFGW, params_w, slots=2, max_seq_len=64, prefill_buckets=(16, 32),
-            warmup=False, prefix_cache_mb=8.0,
+            warmup=False, prefix_cache_mb=8.0, kv_paged=False,
         )
         try:
             rng = np.random.default_rng(5)

@@ -285,7 +285,7 @@ class TestDebugIntrospection:
             assert eng["slots"] == 2 and len(eng["slot_table"]) == 2
             assert eng["label"] == "tiny"
             assert eng["phases"]["ttft"]["count"] >= 1
-            assert eng["kvcache"]["layout"] in ("dense", "rolling")
+            assert eng["kvcache"]["layout"] in ("paged", "dense", "rolling")
         finally:
             app.shutdown()
 

@@ -783,7 +783,12 @@ class TestEdges:
         kw = llm_request_kwargs(ctx_for(
             {"x-gofr-priority": "Batch", "x-gofr-client": "tenant-a"}
         ))
-        assert kw == {"priority": "batch", "client": "tenant-a"}
+        assert kw == {
+            "priority": "batch", "client": "tenant-a", "session_id": "",
+        }
+        # session id rides the same kwargs (paged KV session tier)
+        kw = llm_request_kwargs(ctx_for({"x-gofr-session": "conv-7"}))
+        assert kw["session_id"] == "conv-7"
         # API key fallback for keyed deployments: HASHED, never verbatim
         # — ledger client ids surface on the debug/stats routes, and a
         # raw key there would be a credential disclosure
