@@ -771,6 +771,15 @@ def bench_serving(args) -> dict:
             args, cfg, eng.params if quantize else params, quantize
         )
 
+    # sharded operating point (BENCH_r15+): TP=1/2/4 decode tok/s + QPS
+    # scaling over ICI submeshes, disaggregated-vs-colocated TTFT under
+    # the mixed 16/120 interactive load, KV-handoff latency percentiles
+    # (gofr_tpu.llm_disagg; docs/advanced-guide/sharded-serving.md)
+    if on_tpu and not args.no_sharded:
+        detail["sharded"] = _bench_sharded(
+            args, cfg, eng.params if quantize else params, quantize
+        )
+
     # prefix-cache operating point: 50% shared-prefix traffic — hits skip
     # the prefill wave entirely, so the engine can exceed the NO-CACHE
     # device ceiling (per-request prefill is the larger serial share at
@@ -1306,6 +1315,151 @@ def _bench_sessions(args, cfg, params, quantize: bool) -> dict:
         eng.close()
 
 
+def _bench_sharded(args, cfg, params, quantize: bool) -> dict:
+    """Sharded-serving point (BENCH_r15+): the multi-chip half of the
+    serving story (docs/advanced-guide/sharded-serving.md).
+
+    Three sub-measurements:
+
+    - **TP scaling**: decode tok/s (decode-heavy closed run) and
+      closed-loop QPS at the SLO shapes for TP=1/2/4 — one engine
+      tensor-parallel over an ICI submesh, weight shards all-gathered
+      with collective-compute overlap on the decode path. The
+      adjudicated numbers are the scaling ratios vs TP=1.
+    - **disaggregated vs colocated**: a 1-prefill + 1-decode role pair
+      vs a colocated 2-replica fleet under the mixed 16/120-token
+      open-loop interactive load — TTFT p99 and interactive p99/p50
+      both ways (long prompts stop stealing decode steps from
+      interactive streams on the disaggregated side).
+    - **KV handoff latency percentiles**: submit -> decode-admit wall
+      for the prefill->decode block transfers, from the engine's own
+      window.
+    """
+    import jax
+
+    from gofr_tpu.llm import LLMEngine, ReplicatedLLMEngine
+    from gofr_tpu.llm_disagg import DisaggregatedLLMEngine
+    from gofr_tpu.parallel import make_mesh, param_specs
+
+    n_dev = len(jax.devices())
+    S, K = args.prefill_len, args.decode_chunk
+    dec_tokens = max(4 * args.new_tokens, 64)
+    slots = min(args.batch, 64)
+    out: dict = {"devices": n_dev}
+
+    # -- TP scaling: decode tok/s + closed-loop QPS at TP=1/2/4 ----------
+    tp_scaling: dict = {}
+    base_tok_s = base_qps = None
+    for tp in (1, 2, 4):
+        if tp > n_dev:
+            continue
+        mesh = specs = None
+        if tp > 1:
+            mesh = make_mesh(
+                {"data": 1, "model": tp}, devices=jax.devices()[:tp]
+            )
+            specs = param_specs(cfg, mesh)
+        eng = LLMEngine(
+            cfg, params, slots=slots, max_seq_len=S + dec_tokens + 2 * K,
+            prefill_buckets=(max(16, S // 4), S), decode_chunk=K,
+            admit_cap=args.admit_cap, quantize=quantize,
+            mesh=mesh, param_specs=specs,
+        )
+        try:
+            _closed_loop(eng, cfg, S - 8, 8, 16, 16)  # warm the shapes
+            dec = _closed_loop(eng, cfg, S - 8, dec_tokens, slots * 2, 64)
+            slo = _closed_loop(
+                eng, cfg, S - 8, args.new_tokens,
+                max(64, args.requests // 2), args.clients,
+            )
+            tok_s = dec["qps"] * dec_tokens
+            row = {
+                "decode_tok_s": round(tok_s, 0),
+                "qps": slo["qps"],
+                "p99_ms": slo["p99_ms"],
+            }
+            if tp == 1:
+                base_tok_s, base_qps = tok_s, slo["qps"]
+            else:
+                row["decode_scaling_vs_tp1"] = round(
+                    tok_s / max(1e-9, base_tok_s), 2
+                )
+                row["qps_scaling_vs_tp1"] = round(
+                    slo["qps"] / max(1e-9, base_qps), 2
+                )
+            tp_scaling[f"tp{tp}"] = row
+        finally:
+            eng.close()
+    out["tp"] = tp_scaling
+
+    # -- disaggregated vs colocated under the mixed 16/120 load ----------
+    if n_dev >= 2:
+        rate = max(8.0, args.interactive_rate / 4)
+        mix = (16, S - 8)
+        fleet_kw = dict(
+            slots=slots, max_seq_len=S + args.new_tokens + 2 * K,
+            prefill_buckets=(max(16, S // 4), S), decode_chunk=K,
+            admit_cap=args.admit_cap, quantize=quantize, supervise=False,
+        )
+        def warm_fleet(eng):
+            # stats-free warm (fleet/disagg engines do not expose the
+            # single-engine telemetry _closed_loop deltas): every prompt
+            # length in the mix, both pools touched
+            from gofr_tpu.llm import GenRequest
+
+            rng_np = np.random.default_rng(7)
+            reqs = [
+                eng.submit(GenRequest(
+                    rng_np.integers(1, cfg.vocab_size, size=pl).tolist(),
+                    max_new_tokens=args.new_tokens,
+                ))
+                for pl in mix
+                for _ in range(8)
+            ]
+            for r in reqs:
+                r.tokens(timeout=600)
+
+        co = ReplicatedLLMEngine(cfg, params, replicas=2, **fleet_kw)
+        try:
+            warm_fleet(co)
+            co_res = _open_loop(
+                co, cfg, mix, args.new_tokens, rate, args.open_loop_s
+            )
+        finally:
+            co.close()
+        dis = DisaggregatedLLMEngine(
+            cfg, params, replicas=2, prefill_replicas=1, **fleet_kw
+        )
+        try:
+            warm_fleet(dis)
+            dis_res = _open_loop(
+                dis, cfg, mix, args.new_tokens, rate, args.open_loop_s
+            )
+            hand = dis.stats()["handoff"]
+        finally:
+            dis.close()
+        lat = hand.get("latency") or {}
+        out["disagg"] = {
+            "offered_qps": rate,
+            "colocated_ttft_p99_ms": co_res["ttft_p99_ms"],
+            "disagg_ttft_p99_ms": dis_res["ttft_p99_ms"],
+            "ttft_p99_vs_colocated": round(
+                dis_res["ttft_p99_ms"] / max(1e-9, co_res["ttft_p99_ms"]), 3
+            ),
+            "colocated_p99_over_p50": round(
+                co_res["p99_ms"] / max(1e-9, co_res["p50_ms"]), 2
+            ),
+            "disagg_p99_over_p50": round(
+                dis_res["p99_ms"] / max(1e-9, dis_res["p50_ms"]), 2
+            ),
+            "handoff_ok": hand.get("ok", 0),
+            "handoff_miss": hand.get("miss", 0),
+            "handoff_p50_ms": round(1e3 * (lat.get("p50") or 0.0), 1),
+            "handoff_p99_ms": round(1e3 * (lat.get("p99") or 0.0), 1),
+        }
+    return out
+
+
 def _bench_speculative(args, cfg, params, quantize: bool) -> dict:
     """Speculative-decoding point (BENCH_r12+): decode-heavy closed runs
     (short prompts, long completions — decode wall dominates) on two
@@ -1809,6 +1963,8 @@ def main() -> None:
                     help="skip the 4k-prompt sliding-window operating point")
     ap.add_argument("--no-prefix-cache", action="store_true",
                     help="skip the 50%%-shared-prefix prefix-cache point")
+    ap.add_argument("--no-sharded", action="store_true",
+                    help="skip the TP-scaling + disaggregated point")
     ap.add_argument("--no-sessions", action="store_true",
                     help="skip the sessions point (paged KV pool: "
                          "bytes/idle-session, cold resume, paged vs "
@@ -1928,6 +2084,22 @@ def _summary_line(result: dict) -> dict:
             "cold_resume_ttft_ms": se.get("cold_resume_ttft_ms"),
             "resume_vs_reprefill": se.get("resume_vs_reprefill"),
         }
+    if d.get("sharded"):  # BENCH_r15+: TP submeshes + disaggregation
+        sh = d["sharded"]
+        row = {}
+        for tp in ("tp2", "tp4"):
+            if tp in (sh.get("tp") or {}):
+                row[f"{tp}_decode_scaling"] = sh["tp"][tp].get(
+                    "decode_scaling_vs_tp1"
+                )
+                row[f"{tp}_qps_scaling"] = sh["tp"][tp].get(
+                    "qps_scaling_vs_tp1"
+                )
+        dg = sh.get("disagg") or {}
+        row["disagg_ttft_p99_vs_colocated"] = dg.get("ttft_p99_vs_colocated")
+        row["disagg_p99_over_p50"] = dg.get("disagg_p99_over_p50")
+        row["handoff_p99_ms"] = dg.get("handoff_p99_ms")
+        s["sharded"] = row
     if d.get("speculative"):  # BENCH_r12+: spec-on vs spec-off decode
         sp = d["speculative"]
         s["speculative"] = {

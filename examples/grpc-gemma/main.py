@@ -63,6 +63,54 @@ def _session_kw() -> dict:
     return kw
 
 
+def _topology_kw(cfg) -> dict:
+    """Multi-chip topology from LLM_TP / LLM_DISAGG
+    (docs/advanced-guide/sharded-serving.md):
+
+    - ``LLM_TP=K`` carves the device slice into K-chip tensor-parallel
+      submeshes — one replica per submesh (dp x tp serving). Unset with
+      >1 devices keeps the legacy default: ONE engine tensor-parallel
+      over the whole slice.
+    - ``LLM_DISAGG=1`` splits the replicas into prefill/decode role
+      pools with device-to-device KV handoff
+      (``LLM_DISAGG_PREFILL_REPLICAS`` sizes the prefill pool; the
+      TPU_LLM_DISAGG_PREFILL_REPLICAS app-config knob still applies
+      when unset).
+    """
+    import jax
+
+    kw: dict = {}
+    n_dev = len(jax.devices())
+    tp_env = os.environ.get("LLM_TP", "")
+    tp = int(tp_env or 0)
+    if os.environ.get("LLM_DISAGG", "").lower() in ("1", "true"):
+        kw["disagg"] = True
+        pr = int(os.environ.get("LLM_DISAGG_PREFILL_REPLICAS", "0") or 0)
+        if pr:
+            kw["prefill_replicas"] = pr
+        if tp > 1:
+            from gofr_tpu.parallel import tp_submeshes
+
+            kw["meshes"] = tp_submeshes(cfg, tp)
+        else:
+            kw["replicas"] = max(2, n_dev)
+        return kw
+    if tp > 1:
+        from gofr_tpu.parallel import tp_submeshes
+
+        meshes = tp_submeshes(cfg, tp)
+        if len(meshes) == 1:
+            kw["mesh"], kw["param_specs"] = meshes[0]
+        else:
+            kw["meshes"] = meshes
+    elif n_dev > 1 and tp_env == "":
+        from gofr_tpu.parallel import make_mesh, param_specs
+
+        mesh = make_mesh({"data": 1, "model": n_dev})
+        kw = {"mesh": mesh, "param_specs": param_specs(cfg, mesh)}
+    return kw
+
+
 def build_engine(app):
     global TOKENIZER
     import jax
@@ -108,13 +156,11 @@ def build_engine(app):
         except FileNotFoundError:
             app.logger.warn(f"no tokenizer.json under {tok_path}; id-only API")
 
-    kw = {}
-    n_dev = len(jax.devices())
-    if n_dev > 1:
-        from gofr_tpu.parallel import make_mesh, param_specs
-
-        mesh = make_mesh({"data": 1, "model": n_dev})
-        kw = {"mesh": mesh, "param_specs": param_specs(cfg, mesh)}
+    # LLM_TP=K: K-chip tensor-parallel submesh replicas; LLM_DISAGG=1:
+    # disaggregated prefill/decode pools with KV handoff (see
+    # _topology_kw; docs/advanced-guide/sharded-serving.md). Unset with
+    # >1 devices keeps one engine TP across the whole slice.
+    kw = _topology_kw(cfg)
     app.container.tpu().register_llm(
         "gemma", cfg, params,
         slots=int(os.environ.get("LLM_SLOTS", "4")),

@@ -278,6 +278,19 @@ class TPURuntime:
         # on) — docs/advanced-guide/resilience.md
         self.default_llm_step_watchdog = get("TPU_LLM_STEP_WATCHDOG_S", "")
         self.default_llm_numeric_check = get("TPU_LLM_NUMERIC_CHECK", "")
+        # sharded / disaggregated serving knobs (docs/advanced-guide/
+        # sharded-serving.md): TPU_LLM_TP runs each replica
+        # tensor-parallel over a submesh of that many chips;
+        # TPU_LLM_DISAGG splits the fleet into prefill/decode role pools
+        # with device-to-device KV handoff
+        self.default_llm_tp = get("TPU_LLM_TP", "")
+        self.default_llm_disagg = get("TPU_LLM_DISAGG", "")
+        self.default_llm_disagg_prefill = get(
+            "TPU_LLM_DISAGG_PREFILL_REPLICAS", ""
+        )
+        self.default_llm_handoff_timeout = get(
+            "TPU_LLM_KV_HANDOFF_TIMEOUT_S", ""
+        )
         self._models: dict[str, _Model] = {}
         self._lock = threading.Lock()
         if metrics is not None:
@@ -452,6 +465,13 @@ class TPURuntime:
         `replicas=N` (or `devices=[...]` / `meshes=[(mesh, specs), ...]`)
         for data-parallel replicated serving — N independent engines with
         a per-request router behind the same handle (SURVEY §2.8 row 1).
+        TPU_LLM_TP=K runs each replica tensor-parallel over its own
+        K-chip submesh (collective-compute overlap on the decode path via
+        TPU_LLM_TP_OVERLAP, on by default), and TPU_LLM_DISAGG=1 splits
+        the fleet into prefill-role and decode-role pools with
+        device-to-device KV handoff
+        (TPU_LLM_DISAGG_PREFILL_REPLICAS / TPU_LLM_KV_HANDOFF_TIMEOUT_S;
+        docs/advanced-guide/sharded-serving.md).
         KV layout/residency policy comes from gofr_tpu.kvcache: the
         block-paged pool with radix prefix sharing by default
         (TPU_LLM_KV_PAGED/TPU_LLM_KV_BLOCK/TPU_LLM_KV_INT8), the
@@ -542,7 +562,50 @@ class TPURuntime:
         if name in self._llms:
             self._llms[name].close()
         replicas = engine_kw.pop("replicas", None)
-        if (replicas or 1) > 1 or "devices" in engine_kw or "meshes" in engine_kw:
+        # TPU_LLM_TP=N: each replica runs tensor-parallel over its own
+        # N-chip submesh (docs/advanced-guide/sharded-serving.md) — the
+        # device list is carved into replica submeshes and the standard
+        # Megatron param_specs derived per mesh. Explicit meshes= wins.
+        if (
+            self.default_llm_tp not in ("", "0", "1")
+            and "meshes" not in engine_kw
+            and "devices" not in engine_kw
+            and "mesh" not in engine_kw
+        ):
+            from ...parallel import tp_submeshes
+
+            engine_kw["meshes"] = tp_submeshes(
+                cfg, int(self.default_llm_tp), replicas=replicas,
+            )
+            replicas = None
+        # explicit per-model override beats the process-wide config knob
+        # (a smoke/test app can serve a disaggregated engine next to a
+        # colocated control engine from one runtime)
+        disagg = engine_kw.pop("disagg", None)
+        if disagg is None:
+            disagg = self.default_llm_disagg not in ("", "0")
+        if disagg:
+            from ...llm_disagg import DisaggregatedLLMEngine
+
+            dkw = {}
+            if (
+                self.default_llm_disagg_prefill != ""
+                and "prefill_replicas" not in engine_kw
+            ):
+                dkw["prefill_replicas"] = int(self.default_llm_disagg_prefill)
+            if (
+                self.default_llm_handoff_timeout != ""
+                and "handoff_timeout_s" not in engine_kw
+            ):
+                dkw["handoff_timeout_s"] = float(
+                    self.default_llm_handoff_timeout
+                )
+            engine = DisaggregatedLLMEngine(
+                cfg, params, replicas=replicas,
+                logger=self.logger, metrics=self.metrics, **dkw, **engine_kw,
+            )
+            build_kw = {}  # role pools retain their own rebuild inputs
+        elif (replicas or 1) > 1 or "devices" in engine_kw or "meshes" in engine_kw:
             engine = ReplicatedLLMEngine(
                 cfg, params, replicas=replicas,
                 logger=self.logger, metrics=self.metrics, **engine_kw,

@@ -587,14 +587,19 @@ class CacheManager:
         return min(prompt_len + max_new - 1 + self.append_slack, self.capacity)
 
     # -- paged layout: admission ------------------------------------------
-    def lookup_seed(self, prompt_tokens, *, allow_partial: bool = True) -> SeedPlan | None:
+    def lookup_seed(
+        self, prompt_tokens, *, allow_partial: bool = True,
+        count: bool = True,
+    ) -> SeedPlan | None:
         """Radix consult for one prompt. Exact end records reproduce the
         old PrefixCache exact-hit contract (stored tail rows + logits —
         prefill skipped entirely); otherwise the longest block-aligned
         shared prefix is returned, CLAMPED to prompt_len - 1 so at least
         one token still runs through prefill (last-token logits).
         allow_partial=False restricts to exact probes (the wave
-        scheduler has no mid-prompt append path)."""
+        scheduler has no mid-prompt append path). count=False skips the
+        app_kvcache_events series (KV-handoff export probes are not
+        admission traffic)."""
         if self.radix is None:
             return None
         with self._plock:
@@ -604,7 +609,8 @@ class CacheManager:
                 # prefill can only be skipped when the stored last-token
                 # logits exist (session end records keep rows, not
                 # logits — those degrade to the partial path below)
-                self._count("hit")
+                if count:
+                    self._count("hit")
                 plan = SeedPlan(
                     blocks=m.blocks, shared=m.shared, exact=True,
                     tail_src=(
@@ -615,9 +621,11 @@ class CacheManager:
             else:
                 shared = min(m.shared, ((n - 1) // self.block) * self.block)
                 if shared <= 0 or not allow_partial:
-                    self._count("miss")
+                    if count:
+                        self._count("miss")
                     return None
-                self._count("partial_hit")
+                if count:
+                    self._count("partial_hit")
                 plan = SeedPlan(
                     blocks=m.blocks[: shared // self.block], shared=shared,
                     exact=False, tail_src=-1, tail_len=0, logits=None,
@@ -892,6 +900,39 @@ class CacheManager:
                 self.radix._evict_node(node)
                 node = parent
             self._count_session("spill")
+            self._update_gauges()
+
+    def release_blocks(self, blocks: list[int]) -> None:
+        """Drop one reference per block (a failed handoff import's
+        allocation, before any table/radix adopted it)."""
+        if not blocks:
+            return
+        with self._plock:
+            self.pool.decref(blocks)
+            self._update_gauges()
+
+    def handoff_commit(
+        self, tokens, blocks: list[int], tail_block: int, tail_len: int,
+        *, logits=None, logits_nbytes: int = 0,
+    ) -> None:
+        """Adopt KV blocks a peer engine transferred in
+        (docs/advanced-guide/sharded-serving.md#disaggregation): insert
+        the prompt into the radix WITH its stored last-token logits, so
+        the next admission of this exact prompt skips prefill — the
+        disaggregated decode contract. Same reference discipline as
+        restore_commit: insert() dedups against prefixes that grew here
+        while the transfer flew; our allocation refs on deduplicated
+        blocks release right below, and the tail block is adopted by the
+        end record without an extra ref."""
+        with self._plock:
+            self.radix.insert(
+                list(tokens), blocks,
+                tail_block=(tail_block if tail_block >= 0 else None),
+                tail_len=tail_len,
+                logits=logits, logits_nbytes=logits_nbytes,
+            )
+            self.pool.decref(blocks)
+            self._count("store")
             self._update_gauges()
 
     def restore_fetch(self, sid: str) -> dict | None:

@@ -97,11 +97,17 @@ Design (all shapes static; a bounded set of compiled executables):
   compute-vs-HBM classification (stats()["mfu"], app_llm_mfu gauges;
   docs/advanced-guide/profiling.md).
 
-Tensor parallelism: pass mesh + param_specs; the slot cache is resharded by
-GSPMD from the params' shardings (KV replicated under MQA, sharded when the
-TP degree divides n_kv_heads) — identical code single-chip and multi-chip.
-Quantization: quantize=True serves int8 weights (models.quant), halving the
-HBM stream that bounds decode.
+Tensor parallelism: pass mesh + param_specs (or TPU_LLM_TP via
+register_llm) and the engine serves the model across an ICI submesh —
+the KV pool/slab is COMMITTED to parallel.sharding.kv_specs (heads
+sharded when the TP degree divides n_kv_heads, replicated under MQA),
+and the sharded decode path double-buffers the next layer's weight
+all-gather behind the current layer's matmul (TPU_LLM_TP_OVERLAP;
+docs/advanced-guide/sharded-serving.md) — identical tokens single-chip
+and multi-chip. Disaggregated prefill/decode role pools with
+device-to-device KV handoff live in gofr_tpu.llm_disagg.
+Quantization: quantize=True serves int8 weights (models.quant), halving
+the HBM stream that bounds decode.
 """
 
 from __future__ import annotations
@@ -158,6 +164,33 @@ def _register_phase_metrics(metrics) -> None:
                 "app_llm_step_seconds",
                 "llm unified step dispatch->fetch s (prefill chunks + "
                 "piggybacked decode)", TPU_BUCKETS,
+            )
+        # sharded / disaggregated serving (docs/advanced-guide/
+        # sharded-serving.md)
+        if not metrics.has("app_llm_kv_handoff_seconds"):
+            metrics.new_histogram(
+                "app_llm_kv_handoff_seconds",
+                "llm disaggregated prefill->decode KV handoff wall s "
+                "(export + transfer + import)", TPU_BUCKETS,
+            )
+        if not metrics.has("app_llm_collective_seconds"):
+            metrics.new_histogram(
+                "app_llm_collective_seconds",
+                "llm sharded-serving collective/transfer wall s "
+                "(phase=weight_shard|kv_handoff_gather|"
+                "kv_handoff_transfer|kv_handoff_scatter)", TPU_BUCKETS,
+            )
+        if not metrics.has("app_llm_tp_degree"):
+            metrics.new_gauge(
+                "app_llm_tp_degree",
+                "tensor-parallel degree of each engine's submesh "
+                "(1 = single-chip)",
+            )
+        if not metrics.has("app_llm_kv_handoffs_total"):
+            metrics.new_counter(
+                "app_llm_kv_handoffs_total",
+                "llm disaggregated KV handoffs "
+                "(outcome=ok|miss|fallback)",
             )
         if not metrics.has("app_llm_step_tokens"):
             metrics.new_histogram(
@@ -491,6 +524,8 @@ class LLMEngine:
         admit_delay_ms: float = 40.0,
         mesh=None,
         param_specs: Any = None,
+        tp_overlap: bool | None = None,
+        role: str = "",
         device=None,
         max_queue: int | None = None,
         ttft_deadline_ms: float | None = None,
@@ -727,6 +762,12 @@ class LLMEngine:
         # kv_label doubles as the engine's metric/trace label (register_llm
         # passes the registered model name; replicas get a /rN suffix)
         self.label = kv_label
+        # disaggregated serving role ("prefill" | "decode" | "" for a
+        # colocated engine): rides the phase histograms as a `role` label
+        # so TTFT/TPOT split per pool (docs/advanced-guide/
+        # sharded-serving.md). Empty = no label, series unchanged.
+        self.role = str(role)
+        self._role_labels = {"role": self.role} if self.role else {}
         # model-version label (docs/advanced-guide/rollouts.md): which
         # weight set this engine serves. Streams pin to it across
         # failover; the wide-event line and the per-version request
@@ -804,10 +845,46 @@ class LLMEngine:
             metrics=metrics, model=kv_label,
         )
         self._sharded = mesh is not None and param_specs is not None
+        self.mesh = mesh if self._sharded else None
+        # tensor-parallel degree (docs/advanced-guide/sharded-serving.md):
+        # the "model" axis of the replica's submesh; 1 for single-chip.
+        # Exported as app_llm_tp_degree so dashboards see the fleet shape.
+        self.tp_degree = (
+            int(dict(mesh.shape).get("model", 1)) if self._sharded else 1
+        )
+        # Collective-compute overlap (ROADMAP raw-speed side quest; ISSUE
+        # 12): the sharded DECODE path stores weights sharded and
+        # all-gathers the NEXT layer's shard while the current layer's
+        # matmul runs (parallel.sharding.replicate_gather through
+        # models.transformer._layer_scan). Also the numerics lever that
+        # pins TP==TP1 greedy token equality: gathered-weight compute has
+        # no partial-product psum, hence no reduction-order drift.
+        if tp_overlap is None:
+            tp_overlap = _os.environ.get("TPU_LLM_TP_OVERLAP", "1") != "0"
+        self.tp_overlap = bool(tp_overlap) and self.tp_degree > 1
+        if metrics is not None:
+            metrics.set_gauge(
+                "app_llm_tp_degree", float(self.tp_degree), model=kv_label,
+            )
+        self._tp_gather = None
+        if self.tp_overlap:
+            from .parallel.sharding import replicate_gather
+
+            self._tp_gather = replicate_gather(mesh)
         if mesh is not None and param_specs is not None:
             from .parallel.sharding import shard_params
 
+            t0_gather = time.perf_counter()
             params = shard_params(params, mesh, param_specs)
+            # initial shard placement: the weight-scatter wall a replica
+            # pays once at build (phase label mirrors the per-layer
+            # gathers the decode path then overlaps)
+            if metrics is not None:
+                metrics.record_histogram(
+                    "app_llm_collective_seconds",
+                    time.perf_counter() - t0_gather,
+                    model=kv_label, phase="weight_shard",
+                )
         elif device is not None:
             # replica pinning (data-parallel serving): committing params to
             # a device makes every jitted call and its donated state follow
@@ -879,6 +956,7 @@ class LLMEngine:
                 return chunk_fn(
                     params, cfg, tokens, cache, active, temps, rng,
                     n_steps=K, sample_fn=_sample, ring=self.kv.ring,
+                    overlap=self._tp_gather,
                 )
 
             return instrument_jit(
@@ -1031,6 +1109,7 @@ class LLMEngine:
                 toks, last, cache, rng = chunk_fn(
                     params, cfg, tail, cache, active, temps, rng,
                     n_steps=K, sample_fn=_sample, ring=self.kv.ring,
+                    overlap=self._tp_gather,
                 )
                 return first, kept, toks, last, cache, active, temps, rng
 
@@ -1174,6 +1253,7 @@ class LLMEngine:
                             params, cfg, tail, cache, (scales if _int8 else None),
                             tables, eff, temps, rng,
                             n_steps=K, sample_fn=_sample, block=Bp,
+                            overlap=self._tp_gather,
                         )
                         return toks, last, cache, (
                             sc_out if _int8 else scales
@@ -1182,6 +1262,7 @@ class LLMEngine:
                     toks, last, nd, rng = chunk_fn(
                         params, cfg, tail, dense, eff, temps, rng,
                         n_steps=K, sample_fn=_sample, ring=0,
+                        overlap=self._tp_gather,
                     )
                     pos = cache.length[:, None] + jnp.arange(K, dtype=jnp.int32)[None, :]
                     valid = eff[:, None] & (pos < _cap)
@@ -1310,6 +1391,7 @@ class LLMEngine:
                             params, cfg, tail, cache, (scales if _int8 else None),
                             tables, eff, temps, rng,
                             n_steps=K, sample_fn=_sample, block=Bp,
+                            overlap=self._tp_gather,
                         )
                         scales = sc if _int8 else scales
                     else:
@@ -1317,6 +1399,7 @@ class LLMEngine:
                         toks, last, nd, rng = chunk_fn(
                             params, cfg, tail, dense, eff, temps, rng,
                             n_steps=K, sample_fn=_sample, ring=0,
+                            overlap=self._tp_gather,
                         )
                         pos = cache.length[:, None] + jnp.arange(
                             K, dtype=jnp.int32
@@ -1413,6 +1496,24 @@ class LLMEngine:
             self.cache = self.kv.init_cache(slots)
             if device is not None:
                 self.cache = jax.device_put(self.cache, device)
+        self._kv_sharding = None
+        if self._sharded:
+            # KV sharded along heads where the model allows, replicated
+            # under MQA (parallel.sharding.kv_specs) — committed once
+            # here; donation keeps the layout through every step/chunk/
+            # verify program, so the pool never silently migrates to one
+            # chip of the submesh.
+            from jax.sharding import NamedSharding
+
+            from .parallel.sharding import kv_specs
+
+            self._kv_sharding = NamedSharding(
+                mesh, kv_specs(cfg, mesh, paged=self.kv.paged)
+            )
+            self.cache = self.cache._replace(
+                k=jax.device_put(self.cache.k, self._kv_sharding),
+                v=jax.device_put(self.cache.v, self._kv_sharding),
+            )
         # host-side upper bound on each slot's device length (paged block
         # allocation watermark; conservative under speculative pipelining)
         self._kv_hi = [0] * slots
@@ -1420,6 +1521,10 @@ class LLMEngine:
         # scheduler thread (the only thread allowed to dispatch device
         # work against the donated pool): (slot, request) pairs
         self._session_pub: deque = deque()
+        # host-work closures other threads queue for the SCHEDULER thread
+        # (KV handoff export/import dispatch against the donated pool):
+        # (fn, box) pairs — box carries done-event/result/error back
+        self._sched_work: deque = deque()
         self._slot_req: list[GenRequest | None] = [None] * slots
         # device-resident batch state: chain tail, active mask, temps.
         # active is never cleared on retire (a stale True only advances a
@@ -1662,6 +1767,9 @@ class LLMEngine:
         with self._lock:
             return {
                 "version": self.version,
+                "tp_degree": self.tp_degree,
+                "tp_overlap": self.tp_overlap,
+                "role": self.role,
                 "disconnect_cancels": self.disconnect_cancels,
                 "errored": self.errored,
                 "slots": self.slots,
@@ -1792,6 +1900,9 @@ class LLMEngine:
         return {
             "label": self.label,
             "version": self.version,
+            "tp_degree": self.tp_degree,
+            "tp_overlap": self.tp_overlap,
+            "role": self.role,
             "alive": self.alive(),
             "draining": self._draining,
             "died_reason": self.died_reason,
@@ -1863,6 +1974,14 @@ class LLMEngine:
             + len(self._waiting)
             + self._admitting
         )
+
+    def resident_slots(self) -> int:
+        """Occupied decode slots RIGHT NOW — the decode-role routing
+        signal (disaggregated serving admits decode work by slot
+        residency, where the prefill role routes by queued prompt
+        tokens). Lock-free like load(): _slot_req is mutated in place,
+        a torn read costs at most one stale unit."""
+        return sum(r is not None for r in self._slot_req)
 
     def load_tokens(self) -> int:
         """Token-weighted routing signal: the estimated device work still
@@ -2098,6 +2217,7 @@ class LLMEngine:
 
     def close(self) -> None:
         self._stop = True
+        self._fail_sched_work()  # handoff waiters fail fast, not by timeout
         self._admit_q.put(None)
         self._kick.set()
         with self._work_cv:
@@ -3038,7 +3158,8 @@ class LLMEngine:
             self._phases["queue_wait"].observe(wait)
             if self.metrics is not None:
                 self.metrics.record_histogram(
-                    "app_llm_queue_wait_seconds", wait, model=self.label
+                    "app_llm_queue_wait_seconds", wait, model=self.label,
+                    **self._role_labels,
                 )
             self._phase_span(r, "llm.queue_wait", r.submitted_at, now)
 
@@ -3217,7 +3338,23 @@ class LLMEngine:
             # would leak in the registry and dead-end every later turn)
             self.kv.session_forget(sid)
             return
+        self._kv_restore_blocks(
+            payload["k"], payload["v"], payload.get("sc"), ids
+        )
+        n_full = int(payload["n_full"])
+        tail_block = ids[n_full] if n > n_full else -1
+        self.kv.restore_commit(
+            sid, payload["tokens"], ids[:n_full], tail_block,
+            int(payload["tail_len"]),
+        )
+
+    def _kv_restore_blocks(self, k, v, sc, ids: list[int]) -> None:
+        """Scatter block payloads (host numpy from a session spill, or
+        arrays a KV handoff placed on this engine's device) into freshly
+        allocated pool blocks through the padded restore-op family.
+        SCHEDULER THREAD ONLY — the restore op donates the pool."""
         jnp = self._jnp
+        n = len(ids)
         width = 1 << max(0, n - 1).bit_length()  # pow-2 compile shapes
         op = self._restore_ops.get(width)
         if op is None:
@@ -3231,17 +3368,18 @@ class LLMEngine:
             self._restore_ops[width] = op
         pad = width - n
 
-        def padh(a, axis):
+        def padd(a, axis):
+            a = jnp.asarray(a)
             if pad == 0:
                 return a
             pw = [(0, 0)] * a.ndim
             pw[axis] = (0, pad)
-            return np.pad(a, pw)
+            return jnp.pad(a, pw)
 
-        hk = jnp.asarray(padh(payload["k"], 1))
-        hv = jnp.asarray(padh(payload["v"], 1))
+        hk = padd(k, 1)
+        hv = padd(v, 1)
         hs = (
-            jnp.asarray(padh(payload["sc"], 2)) if self.kv.int8
+            padd(sc, 2) if self.kv.int8
             else jnp.zeros((0,), jnp.float32)
         )
         dsts = jnp.asarray(
@@ -3251,12 +3389,169 @@ class LLMEngine:
             self.cache, self._kv_scales = op(
                 self.cache, self._kv_scales, hk, hv, hs, dsts
             )
-        n_full = int(payload["n_full"])
-        tail_block = ids[n_full] if n > n_full else -1
-        self.kv.restore_commit(
-            sid, payload["tokens"], ids[:n_full], tail_block,
-            int(payload["tail_len"]),
-        )
+
+    # -- scheduler-thread host work (KV handoff; disaggregated serving) --
+    def _run_sched_work(self) -> None:
+        """Run host-work closures other threads queued for the scheduler
+        (the only thread allowed to dispatch against the donated pool
+        arrays). A closure's error lands in its caller's box — it must
+        never kill the engine loop."""
+        while self._sched_work:
+            try:
+                fn, box = self._sched_work.popleft()
+            except IndexError:  # racing _die's drain
+                break
+            try:
+                box["result"] = fn()
+            except Exception as e:  # noqa: BLE001 — caller's error, not ours
+                box["error"] = e
+            finally:
+                box["done"].set()
+
+    def _fail_sched_work(self) -> None:
+        """End every queued scheduler-work box (engine dying/closing) so
+        handoff callers fail fast instead of riding out their timeout."""
+        while self._sched_work:
+            try:
+                _fn, box = self._sched_work.popleft()
+            except IndexError:
+                break
+            box["error"] = EngineStoppedError("engine stopped")
+            box["done"].set()
+
+    def _run_on_scheduler(self, fn, timeout: float | None = None):
+        if not self.alive():
+            raise EngineStoppedError("engine stopped")
+        box: dict = {"done": threading.Event(), "result": None, "error": None}
+        self._sched_work.append((fn, box))
+        self._kick.set()
+        if not self.alive():
+            # raced _die/close past the check above: their one-shot
+            # _fail_sched_work may already have drained the deque before
+            # our append, so nothing would ever pop this box — drain it
+            # ourselves and fail fast instead of riding out the timeout
+            self._fail_sched_work()
+        wait_s = timeout if timeout is not None else 30.0
+        if not box["done"].wait(wait_s):
+            raise TimeoutError(
+                f"scheduler work timed out after {wait_s}s (engine "
+                f"{'alive' if self.alive() else 'dead'})"
+            )
+        if box["error"] is not None:
+            raise box["error"]
+        return box["result"]
+
+    def kv_placement(self):
+        """Where this engine's pool arrays live — the ``jax.device_put``
+        target for a direct device-to-device KV handoff (the committed
+        replica device, or the submesh NamedSharding of a TP engine).
+        None = unpinned default placement; handoff callers host-stage."""
+        if self._kv_sharding is not None:
+            return self._kv_sharding
+        return self.device
+
+    def kv_handoff_export(
+        self, prompt_tokens: list[int], *, timeout: float | None = None,
+    ) -> dict | None:
+        """Gather one exact published prompt's KV blocks plus its stored
+        last-token logits for a prefill->decode handoff
+        (docs/advanced-guide/sharded-serving.md#disaggregation). Returns
+        the payload the peer's :meth:`kv_handoff_import` consumes —
+        device arrays, so the caller chooses d2d ``jax.device_put`` or
+        byte-identical host staging — or None when the prompt is not an
+        exact published record (dropped publish, evicted, sharing off).
+        Runs on the scheduler thread (the pool arrays are donated)."""
+        if not self.kv.paged or self.kv.radix is None:
+            return None
+        jnp = self._jnp
+
+        def work():
+            t0 = time.perf_counter()
+            plan = self.kv.lookup_seed(
+                list(prompt_tokens), allow_partial=False, count=False
+            )
+            if plan is None or not plan.exact or plan.logits is None:
+                if plan is not None:
+                    self.kv.release_plan(plan)
+                return None
+            try:
+                blocks = list(plan.blocks)
+                tail = int(plan.tail_src)
+                all_blocks = blocks + ([tail] if tail >= 0 else [])
+                if not all_blocks:
+                    return None
+                idx = jnp.asarray(np.asarray(all_blocks, np.int32))
+                k = jnp.take(self.cache.k, idx, axis=1)
+                v = jnp.take(self.cache.v, idx, axis=1)
+                sc = (
+                    jnp.take(self._kv_scales, idx, axis=2)
+                    if self.kv.int8 else None
+                )
+                if self.metrics is not None:
+                    self.metrics.record_histogram(
+                        "app_llm_collective_seconds",
+                        time.perf_counter() - t0,
+                        model=self.label, phase="kv_handoff_gather",
+                    )
+                return {
+                    "tokens": list(prompt_tokens),
+                    "k": k, "v": v, "sc": sc,
+                    "n_full": len(blocks),
+                    "tail_len": int(plan.tail_len) if tail >= 0 else 0,
+                    "logits": plan.logits,
+                }
+            finally:
+                self.kv.release_plan(plan)
+
+        return self._run_on_scheduler(work, timeout)
+
+    def kv_handoff_import(
+        self, payload: dict, *, timeout: float | None = None,
+    ) -> bool:
+        """Adopt a peer's exported prompt KV: allocate pool blocks,
+        scatter the payload in (byte-identical — the restore-op family),
+        and publish the prompt into the radix WITH its last-token
+        logits, so this engine's next admission of that prompt is an
+        exact hit that skips prefill entirely (the disaggregated decode
+        contract). False = the pool cannot host it right now — the
+        caller submits anyway and the engine re-prefills (slower, never
+        wrong). Runs on the scheduler thread."""
+        if not self.kv.paged or self.kv.radix is None:
+            return False
+
+        def work():
+            t0 = time.perf_counter()
+            k = payload["k"]
+            n = int(k.shape[1])
+            ids = self.kv.alloc_restore(n)
+            if ids is None:
+                return False
+            try:
+                self._kv_restore_blocks(k, payload["v"], payload.get("sc"), ids)
+            except BaseException:
+                self.kv.release_blocks(ids)
+                raise
+            n_full = int(payload["n_full"])
+            tail_block = ids[n_full] if n > n_full else -1
+            logits = payload.get("logits")
+            logits_dev = None if logits is None else self._jnp.asarray(logits)
+            self.kv.handoff_commit(
+                payload["tokens"], ids[:n_full], tail_block,
+                int(payload["tail_len"]),
+                logits=logits_dev,
+                logits_nbytes=(
+                    0 if logits_dev is None else int(logits_dev.nbytes)
+                ),
+            )
+            if self.metrics is not None:
+                self.metrics.record_histogram(
+                    "app_llm_collective_seconds",
+                    time.perf_counter() - t0,
+                    model=self.label, phase="kv_handoff_scatter",
+                )
+            return True
+
+        return self._run_on_scheduler(work, timeout)
 
     def _admit_chunked(self) -> bool:
         """Chunked-scheduler admission: assign waiting requests to
@@ -3634,6 +3929,7 @@ class LLMEngine:
             if self.metrics is not None:
                 self.metrics.record_histogram(
                     "app_llm_time_per_output_token_seconds", tpot,
+                    **self._role_labels,
                     model=self.label,
                 )
         if r.finish_reason == "disconnect":
@@ -3732,7 +4028,8 @@ class LLMEngine:
                     self._phases["ttft"].observe(ttft)
                     if self.metrics is not None:
                         self.metrics.record_histogram(
-                            "app_llm_ttft_seconds", ttft, model=self.label
+                            "app_llm_ttft_seconds", ttft, model=self.label,
+                            **self._role_labels,
                         )
                         self.metrics.record_histogram(
                             "app_tpu_queue_wait", ttft, model="llm", op="ttft",
@@ -4347,6 +4644,7 @@ class LLMEngine:
             self.metrics.record_histogram(
                 "app_llm_decode_step_seconds", step_s,
                 model=self.label, chunk=str(k), wave=str(wave), fused="0",
+                **self._role_labels,
             )
         cols = toks.T  # [S, K]
         with self._lock:
@@ -4399,7 +4697,8 @@ class LLMEngine:
         self._phases["step"].observe(step_s)
         if self.metrics is not None:
             self.metrics.record_histogram(
-                "app_llm_step_seconds", step_s, model=self.label
+                "app_llm_step_seconds", step_s, model=self.label,
+                **self._role_labels,
             )
             if decoded:
                 self.metrics.record_histogram(
@@ -4453,6 +4752,7 @@ class LLMEngine:
                     "app_llm_decode_step_seconds", step_s / k,
                     model=self.label, chunk=str(k), wave=str(wave),
                     fused="1" if info["prefill_tokens"] else "0",
+                    **self._role_labels,
                 )
         with self._lock:
             for j, slot, r in finishes:
@@ -4543,7 +4843,8 @@ class LLMEngine:
                 model=self.label,
             )
             self.metrics.record_histogram(
-                "app_llm_step_seconds", dt, model=self.label
+                "app_llm_step_seconds", dt, model=self.label,
+                **self._role_labels,
             )
             wave = 1 << max(0, len(sel) - 1).bit_length() if sel else 0
             # chunk label "v{W}" marks verify walls: per-token cost here
@@ -4551,7 +4852,7 @@ class LLMEngine:
             self.metrics.record_histogram(
                 "app_llm_decode_step_seconds", per_tok,
                 model=self.label, chunk=f"v{info['W']}", wave=str(wave),
-                fused="0",
+                fused="0", **self._role_labels,
             )
         from .spec import SPEC_EMA_ALPHA
 
@@ -4623,6 +4924,7 @@ class LLMEngine:
                 if self._poison_fault():
                     break  # tagged payload killed this replica (terminal)
                 try:
+                    self._run_sched_work()
                     if self.kv.paged:
                         # paged-pool housekeeping, in dependency order:
                         # publish finished session turns (needs the
@@ -4745,6 +5047,7 @@ class LLMEngine:
             self._died = True
         self._stop = True
         self.died_reason = why
+        self._fail_sched_work()  # pending handoff work cannot run now
         if self.logger is not None:
             self.logger.error(f"LLM engine died: {why}")
         if lock_timeout is None:
@@ -5338,12 +5641,14 @@ class ReplicatedLLMEngine:
 
     def _spec_for_rebuild(self, i: int) -> tuple[dict, str] | None:
         """Placement policy for rebuilding slot i, consulting the device
-        ledger: the home device when it is usable (healthy, or in
-        probation — the canary gate guards the probe) and not occupied
-        by another live replica; otherwise an alternate same-platform
-        device that is usable and unoccupied ({"device": d} specs only —
-        a tensor-parallel submesh has no drop-in alternate, so a
-        quarantined submesh parks its slot). None = park."""
+        ledger: the home device/submesh when it is usable (healthy, or
+        in probation — the canary gate guards the probe) and not
+        occupied by another live replica; otherwise an alternate
+        same-platform device that is usable and unoccupied, or — for
+        tensor-parallel submeshes — an alternate SAME-SIZE submesh of
+        usable, unoccupied chips (elastic submesh placement;
+        docs/advanced-guide/sharded-serving.md). None = park: only when
+        no placement exists anywhere."""
         home = self._specs[i]
         hkey = self._device_keys[i]
         used = {
@@ -5355,7 +5660,7 @@ class ReplicatedLLMEngine:
             return home, hkey
         dev = home.get("device")
         if dev is None:
-            return None  # mesh spec: park until the home submesh reintegrates
+            return self._alternate_submesh_spec(i, home)
         import jax
 
         from .resilience import device_key
@@ -5369,6 +5674,61 @@ class ReplicatedLLMEngine:
                 continue
             return {"device": d}, k
         return None
+
+    def _alternate_submesh_spec(self, i: int, home: dict) -> tuple[dict, str] | None:
+        """Elastic SUBMESH placement: rebuild slot i's tensor-parallel
+        replica on an alternate same-size, same-shape submesh of usable,
+        unoccupied chips. The quarantined home submesh used to park its
+        slot unconditionally (PR 7); now it parks only when no such
+        submesh exists — the chips of every other live replica and the
+        members of every quarantined submesh are excluded, the alternate
+        mesh reuses the home mesh's axis names/shape, and the home's
+        param_specs carry over unchanged (PartitionSpecs are
+        mesh-independent)."""
+        mesh = home.get("mesh")
+        if mesh is None:
+            return None
+        try:
+            homedevs = list(mesh.devices.flat)
+        except AttributeError:  # duck-typed test meshes: nothing to re-place
+            return None
+        if not homedevs:
+            return None
+        import jax
+        import numpy as np
+
+        from .resilience import device_key, spec_device_key, split_device_key
+
+        n = len(homedevs)
+        platform = getattr(homedevs[0], "platform", None)
+        # chips occupied by OTHER live replicas, wherever elastic
+        # rebuilds currently place them
+        used: set[str] = set()
+        for j, e in enumerate(self.engines):
+            if j != i and e.alive():
+                used.update(split_device_key(self._current_keys[j]))
+        # members of every quarantined ledger unit: a submesh trips as a
+        # unit, so its chips are individually suspect until it
+        # reintegrates (probation members stay eligible — the canary
+        # gate judges the rebuild, exactly like single-device probation)
+        sick: set[str] = set()
+        for key, row in self.health.snapshot()["devices"].items():
+            if row["state"] == "quarantined":
+                sick.update(split_device_key(key))
+        cands = [
+            d for d in jax.devices()
+            if getattr(d, "platform", None) == platform
+            and device_key(d) not in used
+            and device_key(d) not in sick
+        ]
+        if len(cands) < n:
+            return None  # park: no same-size submesh of usable chips
+        new_mesh = jax.sharding.Mesh(
+            np.asarray(cands[:n]).reshape(mesh.devices.shape),
+            mesh.axis_names,
+        )
+        spec = dict(home, mesh=new_mesh)
+        return spec, spec_device_key(spec)
 
     def _canary_check(self, replacement: "LLMEngine") -> tuple[bool, str]:
         """Gate a rebuilt replica before it enters routing: the fixed

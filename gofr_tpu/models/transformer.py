@@ -209,6 +209,51 @@ def init_params(rng: jax.Array, cfg: TransformerConfig) -> dict:
 _ACTIVATIONS = {"gelu": jax.nn.gelu, "silu": jax.nn.silu}
 
 
+def _layer_scan(layers: dict, layer_fn, x, rest: tuple, overlap=None):
+    """Scan ``layer_fn(x, lp, rest_i) -> (x, ys_i)`` over the stacked
+    [n_layers, ...] weights.
+
+    ``overlap=None`` is the plain lax.scan every path used before. With
+    ``overlap`` (a pytree transform — parallel.sharding.replicate_gather
+    under tensor parallelism), the scan carry DOUBLE-BUFFERS the weights:
+    each step starts the all-gather of layer i+1's shards (no data
+    dependency on this step's compute, so XLA's async collectives /
+    latency-hiding scheduler run it behind layer i's matmuls) and
+    computes layer i with the already-gathered full weights. Gathered
+    compute is bit-identical to the single-device forward — no
+    partial-product psum, hence no collective reduction-order drift.
+    The final layer prefetches itself (clamped index); one redundant
+    gather, zero extra compute."""
+    if overlap is None:
+
+        def body(x, xs):
+            x, ys = layer_fn(x, xs[0], xs[1:])
+            return x, ys
+
+        return jax.lax.scan(body, x, (layers,) + tuple(rest))
+
+    L = jax.tree.leaves(layers)[0].shape[0]
+
+    def at(i):
+        return jax.tree.map(
+            lambda a: jax.lax.dynamic_index_in_dim(a, i, 0, keepdims=False),
+            layers,
+        )
+
+    def body(carry, xs):
+        x, g = carry
+        g_next = overlap(at(jnp.minimum(xs[0] + 1, L - 1)))
+        x, ys = layer_fn(x, g, xs[1:])
+        return (x, g_next), ys
+
+    (x, _), ys = jax.lax.scan(
+        body,
+        (x, overlap(at(0))),
+        (jnp.arange(L, dtype=jnp.int32),) + tuple(rest),
+    )
+    return x, ys
+
+
 def _act_fn(cfg: TransformerConfig):
     try:
         return _ACTIVATIONS[cfg.act]
@@ -455,6 +500,7 @@ def decode_chunk(
     sample_fn,  # (logits [b, vocab] f32, temps [b], key) -> tokens [b] int32
     unroll: int = 1,  # outer-scan unroll (XLA overlaps step boundaries)
     ring: int = 0,  # >0: cache is a rolling ring of this capacity (kvcache)
+    overlap=None,  # TP collective-compute overlap (see _layer_scan)
 ) -> tuple[jnp.ndarray, jnp.ndarray, KVCache, jax.Array]:
     """n_steps fused decode steps — the serving engine's hot loop.
 
@@ -497,8 +543,8 @@ def decode_chunk(
         positions = (cache.length + k_i)[:, None]  # [b, 1]
         x = _embed_tokens(params, cfg, tok[:, None])
 
-        def layer(x, xs):
-            lp, kc_l, vc_l, kb_l, vb_l = xs
+        def layer(x, lp, rest):
+            kc_l, vc_l, kb_l, vb_l = rest
             h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
             q = qmm(h, lp["wq"])
             if cfg.qkv_bias:
@@ -530,8 +576,9 @@ def decode_chunk(
             )
             return x, (kb_l, vb_l)
 
-        x, (kb, vb) = jax.lax.scan(
-            layer, x, (params["layers"], cache.k, cache.v, kb, vb)
+        x, (kb, vb) = _layer_scan(
+            params["layers"], layer, x, (cache.k, cache.v, kb, vb),
+            overlap=overlap,
         )
         logits = _unembed_last(params, cfg, x)
         nt = sample_fn(logits, temps, key).astype(jnp.int32)
@@ -593,6 +640,7 @@ def decode_chunk_paged(
     block: int,
     use_kernel: bool | None = None,
     interpret: bool = False,
+    overlap=None,  # TP collective-compute overlap (see _layer_scan)
 ) -> tuple[jnp.ndarray, jnp.ndarray, KVCache, jnp.ndarray | None, jax.Array]:
     """decode_chunk against a BLOCK-PAGED pool (gofr_tpu.kvcache.paged).
 
@@ -635,11 +683,11 @@ def decode_chunk_paged(
         positions = (pool.length + k_i)[:, None]  # [b, 1]
         x = _embed_tokens(params, cfg, tok[:, None])
 
-        def layer(x, xs):
+        def layer(x, lp, rest):
             if quant:
-                lp, kp_l, vp_l, ks_l, vs_l, kb_l, vb_l = xs
+                kp_l, vp_l, ks_l, vs_l, kb_l, vb_l = rest
             else:
-                lp, kp_l, vp_l, kb_l, vb_l = xs
+                kp_l, vp_l, kb_l, vb_l = rest
                 ks_l = vs_l = None
             h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
             q = qmm(h, lp["wq"])
@@ -673,11 +721,13 @@ def decode_chunk_paged(
             )
             return x, (kb_l, vb_l)
 
-        xs = (
-            (params["layers"], pool.k, pool.v, ks_all, vs_all, kb, vb)
-            if quant else (params["layers"], pool.k, pool.v, kb, vb)
+        rest = (
+            (pool.k, pool.v, ks_all, vs_all, kb, vb)
+            if quant else (pool.k, pool.v, kb, vb)
         )
-        x, (kb, vb) = jax.lax.scan(layer, x, xs)
+        x, (kb, vb) = _layer_scan(
+            params["layers"], layer, x, rest, overlap=overlap
+        )
         logits = _unembed_last(params, cfg, x)
         nt = sample_fn(logits, temps, key).astype(jnp.int32)
         return (nt, kb, vb), nt

@@ -12,9 +12,12 @@ from .multihost import init_distributed, is_primary, topology
 from .ring import ring_attention, ring_prefill
 from .sharding import (
     batch_spec,
+    kv_specs,
     mlp_param_specs,
     param_specs,
+    replicate_gather,
     shard_params,
+    tp_submeshes,
     with_shardings,
 )
 from .pipeline import (
@@ -38,6 +41,9 @@ __all__ = [
     "param_specs",
     "mlp_param_specs",
     "batch_spec",
+    "kv_specs",
+    "replicate_gather",
+    "tp_submeshes",
     "shard_params",
     "with_shardings",
     "ring_attention",

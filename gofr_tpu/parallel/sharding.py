@@ -5,12 +5,18 @@ Megatron-style tensor parallelism over the "model" axis:
   - wo / w_down:        row-parallel (input features sharded)
   - embed:          vocab-sharded (logit matmul reduces over model axis)
   - norms:          replicated
-KV projections are sharded only when the TP degree divides n_kv_heads —
-with MQA (Gemma-2B, n_kv_heads=1) KV is replicated, the standard layout,
-so decode all-gathers ride ICI only for Q/O. wkv's output columns pack
-heads outermost ([hkv, 2, hd] blocks, transformer._layer_body), so each TP
-shard of the flat dim holds whole (k, v) head pairs — never K on one half
-of the group and V on the other.
+Attention projections shard at WHOLE-HEAD granularity only: q/o when the
+TP degree divides n_heads, kv when it divides n_kv_heads — with MQA
+(Gemma-2B, n_kv_heads=1) KV is replicated, the standard layout, so decode
+all-gathers ride ICI only for Q/O. A shard boundary INSIDE a head is not
+just unconventional; on the pinned old-jax CPU stack GSPMD miscompiles
+the rope/attention reshapes it induces (tiny config at tp=8: logits off
+by ~1.0, cache rows off by ~3.5 — the "old-jax TP prefill drift" that
+failed tests/test_parallel.py since PR 2), so head-indivisible degrees
+replicate q/o and keep only the MLP/embed sharded. wkv's output columns
+pack heads outermost ([hkv, 2, hd] blocks, transformer._layer_body), so
+each TP shard of the flat dim holds whole (k, v) head pairs — never K on
+one half of the group and V on the other.
 
 GSPMD inserts the collectives; we only annotate. Specs are pytrees shaped
 exactly like the params pytree from models.init_params.
@@ -32,12 +38,14 @@ def param_specs(
 ) -> dict:
     tp = mesh.shape.get(model_axis, 1)
     shard_kv = cfg.n_kv_heads % tp == 0 if tp > 1 else True
+    shard_q = cfg.n_heads % tp == 0 if tp > 1 else True
     m = model_axis if tp > 1 else None
     kv = m if shard_kv else None
+    q = m if shard_q else None
     extra = {"unembed": P(m, None)} if untied else {}
     # Qwen2-style qkv biases follow their weight's output-column sharding
     bias = (
-        {"bq": P(None, m), "bkv": P(None, kv)}
+        {"bq": P(None, q), "bkv": P(None, kv)}
         if getattr(cfg, "qkv_bias", False)
         else {}
     )
@@ -48,9 +56,9 @@ def param_specs(
         "layers": {
             **bias,
             "attn_norm": P(None, None),
-            "wq": P(None, None, m),
+            "wq": P(None, None, q),
             "wkv": P(None, None, kv),
-            "wo": P(None, m, None),
+            "wo": P(None, q, None),
             "mlp_norm": P(None, None),
             "w_gate": P(None, None, m),
             "w_up": P(None, None, m),
@@ -77,6 +85,79 @@ def mlp_param_specs(params: dict, mesh: Mesh, *, model_axis: str = "model") -> d
 
 def batch_spec(mesh: Mesh, *, data_axis: str = "data") -> P:
     return P(data_axis if mesh.shape.get(data_axis, 1) > 1 else None)
+
+
+def kv_specs(
+    cfg: TransformerConfig, mesh: Mesh, *, model_axis: str = "model",
+    paged: bool = False,
+) -> P:
+    """PartitionSpec for the serving engine's KV arrays — the slot slab
+    [L, slots, rows, hkv, hd] or the paged block pool
+    [L, n_blocks, block, hkv, hd] (same rank, kv-heads at axis 3 either
+    way). Sharded along heads when the TP degree divides n_kv_heads;
+    REPLICATED under MQA/GQA remainders (the standard layout — with one
+    KV head there is nothing to split, and decode all-gathers then ride
+    ICI only for Q/O). ``paged`` is accepted for call-site clarity; both
+    layouts share the geometry."""
+    del paged  # same rank/axis order for the slab and the pool
+    tp = mesh.shape.get(model_axis, 1)
+    shard = tp > 1 and cfg.n_kv_heads % tp == 0
+    return P(None, None, None, model_axis if shard else None, None)
+
+
+def replicate_gather(mesh: Mesh):
+    """Collective-compute overlap seam (docs/advanced-guide/
+    sharded-serving.md): returns a pytree transform that forces every
+    leaf to the REPLICATED layout inside a jitted program —
+    with_sharding_constraint lowers to an all-gather of the leaf's
+    shards over ICI. The sharded decode path calls it on the NEXT
+    layer's weight shards from inside the layer scan, one layer ahead
+    of use: the gather has no data dependency on the current layer's
+    matmul, so XLA's async collectives / latency-hiding scheduler
+    overlap the two. Gathered-weight compute is also bit-identical to
+    the single-device forward (no partial-product psum, hence no
+    reduction-order drift) — the TP==TP1 token-equality tests pin it."""
+
+    def gather(tree):
+        return jax.tree.map(
+            lambda a: jax.lax.with_sharding_constraint(
+                a, NamedSharding(mesh, P())
+            ),
+            tree,
+        )
+
+    return gather
+
+
+def tp_submeshes(
+    cfg: TransformerConfig,
+    tp: int,
+    *,
+    replicas: int | None = None,
+    devices: list | None = None,
+) -> list[tuple[Mesh, dict]]:
+    """Carve the device list into ``replicas`` disjoint tensor-parallel
+    submeshes of ``tp`` chips each and pair every mesh with its
+    param_specs — the ``meshes=[...]`` input ReplicatedLLMEngine and the
+    disaggregated pools take (dp x tp serving from one call). Defaults
+    to as many replicas as the devices allow."""
+    import numpy as np
+
+    devices = list(devices if devices is not None else jax.devices())
+    tp = max(1, int(tp))
+    if replicas is None:
+        replicas = len(devices) // tp
+    if replicas < 1 or replicas * tp > len(devices):
+        raise ValueError(
+            f"need {max(1, replicas)} replica(s) x tp={tp} = "
+            f"{max(1, replicas) * tp} devices, have {len(devices)}"
+        )
+    out = []
+    for i in range(replicas):
+        sub = devices[i * tp : (i + 1) * tp]
+        mesh = Mesh(np.asarray(sub).reshape(1, tp), ("data", "model"))
+        out.append((mesh, param_specs(cfg, mesh)))
+    return out
 
 
 def shard_params(params: Any, mesh: Mesh, specs: Any) -> Any:

@@ -38,7 +38,13 @@ from __future__ import annotations
 import threading
 
 from .faults import FAULT_POINTS, FaultInjector, InjectedFault, default_injector
-from .health import DeviceHealthLedger, canary_check, device_key, spec_device_key
+from .health import (
+    DeviceHealthLedger,
+    canary_check,
+    device_key,
+    spec_device_key,
+    split_device_key,
+)
 from .overload import FairLedger, OverloadController, RetryBudget
 from .rollout import ModelHandle, RolloutController, RolloutError, RolloutInProgress
 from .supervisor import ReplicaSupervisor
@@ -64,6 +70,7 @@ __all__ = [
     "device_key",
     "register_resilience_metrics",
     "spec_device_key",
+    "split_device_key",
 ]
 
 # Serializes registration across engines (replicas register concurrently;

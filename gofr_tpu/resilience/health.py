@@ -50,6 +50,7 @@ __all__ = [
     "canary_check",
     "device_key",
     "spec_device_key",
+    "split_device_key",
 ]
 
 # Failure classes the ledger tallies. Everything a replica death can be
@@ -74,6 +75,14 @@ CANARY_MAX_NEW = 8
 def device_key(dev) -> str:
     """Stable string identity for one jax device ("cpu:0", "tpu:3")."""
     return f"{getattr(dev, 'platform', 'dev')}:{getattr(dev, 'id', 0)}"
+
+
+def split_device_key(key: str) -> list[str]:
+    """Member device keys of a (possibly "+"-joined submesh) health key.
+    The inverse view of spec_device_key: elastic SUBMESH placement needs
+    per-chip occupancy/health sets, while the ledger bills the submesh
+    as one unit."""
+    return key.split("+")
 
 
 def spec_device_key(spec: dict) -> str:

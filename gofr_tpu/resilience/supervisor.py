@@ -15,9 +15,12 @@ reintegrate):
   engine ran on; the :class:`DeviceHealthLedger` quarantines a device
   after K attributable failures in a sliding window.
 - **elastic rebuild**: a replica whose device is quarantined rebuilds
-  from the retained host params on an alternate healthy device; when no
-  alternate exists the slot is PARKED (capacity-degraded and visible as
-  such — ``app_llm_replicas_parked``, health "degraded") instead of
+  from the retained host params on an alternate healthy device — a
+  tensor-parallel replica on an alternate SAME-SIZE submesh of usable,
+  unoccupied chips (``fleet._alternate_submesh_spec``; docs/
+  advanced-guide/sharded-serving.md) — and when no alternate exists the
+  slot is PARKED (capacity-degraded and visible as such —
+  ``app_llm_replicas_parked``, health "degraded") instead of
   crash-looping, and restored the moment a device becomes usable again.
 - **canary gate**: every rebuilt replica must pass the fixed greedy
   probe (health.canary_check — token-compared against a healthy replica

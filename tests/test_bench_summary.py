@@ -207,6 +207,41 @@ def test_summary_line_carries_sessions():
     assert "sessions" not in bench._summary_line(_serving_result())
 
 
+def test_summary_line_carries_sharded():
+    """BENCH_r15+: the sharded-serving point rides the summary as a
+    compact block (TP decode/QPS scaling ratios vs TP=1, disaggregated
+    TTFT p99 vs colocated, interactive p99/p50, handoff p99)."""
+    r = _serving_result()
+    r["detail"]["sharded"] = {
+        "devices": 4,
+        "tp": {
+            "tp1": {"decode_tok_s": 24000.0, "qps": 290.0, "p99_ms": 160.0},
+            "tp2": {"decode_tok_s": 41000.0, "qps": 470.0, "p99_ms": 150.0,
+                    "decode_scaling_vs_tp1": 1.71, "qps_scaling_vs_tp1": 1.62},
+            "tp4": {"decode_tok_s": 70000.0, "qps": 820.0, "p99_ms": 140.0,
+                    "decode_scaling_vs_tp1": 2.92, "qps_scaling_vs_tp1": 2.83},
+        },
+        "disagg": {
+            "offered_qps": 62.5,
+            "colocated_ttft_p99_ms": 120.0, "disagg_ttft_p99_ms": 54.0,
+            "ttft_p99_vs_colocated": 0.45,
+            "colocated_p99_over_p50": 1.9, "disagg_p99_over_p50": 1.3,
+            "handoff_ok": 500, "handoff_miss": 2,
+            "handoff_p50_ms": 3.1, "handoff_p99_ms": 7.8,
+        },
+    }
+    s = bench._summary_line(r)
+    assert s["sharded"] == {
+        "tp2_decode_scaling": 1.71, "tp2_qps_scaling": 1.62,
+        "tp4_decode_scaling": 2.92, "tp4_qps_scaling": 2.83,
+        "disagg_ttft_p99_vs_colocated": 0.45,
+        "disagg_p99_over_p50": 1.3, "handoff_p99_ms": 7.8,
+    }
+    assert len(json.dumps(s)) < 1800
+    # absent block (--no-sharded / CPU runs) must not leak a key
+    assert "sharded" not in bench._summary_line(_serving_result())
+
+
 def test_summary_line_carries_rollout():
     """BENCH_r13+: the live weight-rollout point rides the summary as a
     compact block (terminal state, error count, time-to-fully-shifted,
