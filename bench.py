@@ -1932,10 +1932,423 @@ def bench_greet(args) -> dict:
     }
 
 
+# ---------------------------------------------------------------------------
+# scale-out: router tier over N engine PROCESSES (docs/advanced-guide/
+# scale-out.md). Runs entirely via subprocesses — the bench process never
+# initializes jax for this mode.
+# ---------------------------------------------------------------------------
+
+def _scaleout_spawn_engine(idx: int) -> dict:
+    import subprocess
+    import sys
+
+    from gofr_tpu.router.autoscaler import free_port
+
+    port, mport = free_port(), free_port()
+    env = {
+        **os.environ,
+        "PYTHONPATH": os.path.dirname(os.path.abspath(__file__))
+        + os.pathsep + os.environ.get("PYTHONPATH", ""),
+        "ENGINE_SLOTS": os.environ.get("ENGINE_SLOTS", "8"),
+        "ENGINE_MAX_QUEUE": "30000",
+        "ENGINE_WARMUP": "0",
+        "ENGINE_LOG_LEVEL": "ERROR",
+        # no session/prefix retention: identical bench prompts would
+        # otherwise flip the radix cache between hit/miss regimes under
+        # pool pressure — bimodal throughput masquerading as (non-)
+        # scaling. The QPS point measures honest prefill+decode.
+        "ENGINE_SESSION_MB": "0",
+        "JAX_PLATFORMS": os.environ.get("JAX_PLATFORMS", "cpu"),
+        # tiny-model ops gain nothing from intra-op threading, and N
+        # engine processes each spawning a whole-machine eigen pool
+        # would thrash each other off the linearity the bench measures
+        "XLA_FLAGS": (
+            os.environ.get("XLA_FLAGS", "")
+            + " --xla_cpu_multi_thread_eigen=false"
+        ).strip(),
+    }
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "gofr_tpu.router.engine_stub",
+         "--port", str(port), "--metrics-port", str(mport),
+         "--engine-id", f"e{idx}"],
+        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT,
+    )
+    return {"port": port, "metrics_port": mport, "proc": proc}
+
+
+def _scaleout_spawn_router(engine_ports: list[int], max_inflight: int) -> dict:
+    import subprocess
+    import sys
+
+    from gofr_tpu.router.autoscaler import free_port
+
+    port, mport = free_port(), free_port()
+    env = {
+        **os.environ,
+        "PYTHONPATH": os.path.dirname(os.path.abspath(__file__))
+        + os.pathsep + os.environ.get("PYTHONPATH", ""),
+        "HTTP_PORT": str(port), "METRICS_PORT": str(mport),
+        "LOG_LEVEL": "ERROR", "REQUEST_TIMEOUT": "600",
+        "TPU_ROUTER_BACKENDS": ",".join(
+            f"http://127.0.0.1:{p}" for p in engine_ports
+        ),
+        "TPU_ROUTER_POLL_INTERVAL_S": "0.2",
+        "TPU_ROUTER_PROXY_TIMEOUT_S": "600",
+        "TPU_ROUTER_UPSTREAM_TIMEOUT_S": "600",
+        "TPU_ROUTER_MAX_INFLIGHT": str(max_inflight),
+    }
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "gofr_tpu.router"],
+        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT,
+    )
+    return {"port": port, "metrics_port": mport, "proc": proc}
+
+
+def _scaleout_wait_http(port: int, path: str, ok, timeout_s: float) -> None:
+    import urllib.request
+
+    deadline = time.monotonic() + timeout_s
+    last = None
+    while time.monotonic() < deadline:
+        try:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=3
+            ) as r:
+                if ok(r):
+                    return
+        except Exception as e:  # noqa: BLE001 — still booting
+            last = e
+        time.sleep(0.1)
+    raise RuntimeError(f"http://127.0.0.1:{port}{path} not ready: {last!r}")
+
+
+def _scaleout_post(port: int, path: str, payload: dict, timeout: float = 120):
+    import urllib.request
+
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}", data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"}, method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def _scaleout_serial_p50(port: int, n: int, path: str = "/echo") -> float:
+    """Serial request latencies over ONE keep-alive connection —
+    identical request direct-vs-routed isolates the hop cost. The
+    default /echo path carries no engine work, so scheduler
+    quantization (admit delay, step cadence) can't pollute the delta."""
+    import http.client
+
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
+    body = json.dumps(
+        {"tokens": list(range(1, 9)), "max_new_tokens": 1}
+    ).encode()
+    times = []
+    for _ in range(n):
+        t0 = time.perf_counter()
+        conn.request("POST", path, body=body,
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        resp.read()
+        times.append(time.perf_counter() - t0)
+    conn.close()
+    return _percentile(times, 0.5)
+
+
+def _scaleout_closed_loop(ports: list[int], clients: int, warm_s: float,
+                          window_s: float, new_tokens: int) -> dict:
+    """Closed-loop QPS through the router tier: `clients` concurrent
+    asyncio clients (the framework's own pooled streaming client — one
+    socket per in-flight request, keep-alive reuse between turns) split
+    across the router replicas, counted over a steady window after a
+    ramp."""
+    from gofr_tpu.service import HTTPService
+
+    done = {"n": 0, "errors": 0, "ramp_errors": 0, "counting": False}
+
+    async def run():
+        svcs = [HTTPService(f"http://127.0.0.1:{p}") for p in ports]
+        for svc in svcs:
+            svc._pool.max_idle = clients // len(svcs) + 16
+        stop = asyncio.Event()
+
+        async def client(i: int):
+            svc = svcs[i % len(svcs)]
+            # distinct prompts per client lane: identical prompts would
+            # all share one radix prefix and measure the cache, not the
+            # fleet
+            base = (i % 64) + 1
+            payload = json.dumps({
+                "tokens": list(range(base, base + 8)),
+                "max_new_tokens": new_tokens,
+            }).encode()
+            headers = {"Content-Type": "application/json",
+                       "X-GoFr-Client": f"c{i % 64}"}
+            while not stop.is_set():
+                try:
+                    st = await svc.astream(
+                        "POST", "/generate", body=payload, headers=headers,
+                        timeout=600,
+                    )
+                    await st.aread()
+                    if st.status_code < 400:
+                        if done["counting"]:
+                            done["n"] += 1
+                    elif done["counting"]:  # steady-window errors only:
+                        done["errors"] += 1  # the ramp's dial storm is
+                    else:  # not the steady-state contract under test
+                        done["ramp_errors"] += 1
+                except asyncio.CancelledError:
+                    raise
+                except Exception:  # noqa: BLE001 — errors ARE data
+                    key = "errors" if done["counting"] else "ramp_errors"
+                    done[key] += 1
+                    await asyncio.sleep(0.05)
+
+        tasks = []
+        for i in range(clients):
+            tasks.append(asyncio.ensure_future(client(i)))
+            if i % 200 == 199:
+                await asyncio.sleep(0.05)  # stagger the dial storm
+        await asyncio.sleep(warm_s)
+        done["counting"] = True
+        t0 = time.monotonic()
+        await asyncio.sleep(window_s)
+        done["counting"] = False
+        elapsed = time.monotonic() - t0
+        stop.set()
+        for t in tasks:
+            t.cancel()
+        await asyncio.gather(*tasks, return_exceptions=True)
+        for svc in svcs:
+            svc.close()
+        return elapsed
+
+    elapsed = asyncio.run(run())
+    return {
+        "qps": done["n"] / elapsed,
+        "completed": done["n"],
+        "errors": done["errors"],
+        "ramp_errors": done["ramp_errors"],
+        "window_s": round(elapsed, 2),
+    }
+
+
+def _scaleout_warm_engine(port: int) -> None:
+    """Warm one engine stub for the closed-loop phases: CONCURRENT
+    rounds, not serial ones — full-width admission and full-slot decode
+    programs only compile once multiple requests arrive together, and a
+    compile inside the measurement window would masquerade as (negative)
+    scaling noise."""
+    for _ in range(2):
+        threads = []
+        for _i in range(24):
+            t = threading.Thread(target=lambda: _scaleout_post(
+                port, "/generate",
+                {"tokens": list(range(1, 9)), "max_new_tokens": 8},
+            ))
+            t.start()
+            threads.append(t)
+        for t in threads:
+            t.join(timeout=120)
+
+
+def _scaleout_pool_hits(metrics_port: int) -> dict:
+    import urllib.request
+
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{metrics_port}/metrics", timeout=5
+        ) as r:
+            expo = r.read().decode()
+    except Exception:  # noqa: BLE001
+        return {}
+    out = {"hit": 0.0, "dial": 0.0}
+    for line in expo.splitlines():
+        if line.startswith("app_http_service_conn_pool_total"):
+            for key in out:
+                if f'result="{key}"' in line:
+                    out[key] += float(line.rsplit(" ", 1)[1])
+    return out
+
+
+def bench_scaleout(args) -> dict:
+    """QPS linearity across engine PROCESSES: closed-loop QPS through
+    the front router at 1/2/4 backend processes under `--scaleout-clients`
+    concurrent clients, plus the router-added serial p50 overhead
+    (direct-to-engine vs via-router, identical request). Fresh engines
+    per point — a prior point's backlog must not pollute the next."""
+    import resource
+
+    procs_list = [int(x) for x in args.scaleout_procs.split(",") if x]
+    clients = args.scaleout_clients
+    soft, hard = resource.getrlimit(resource.RLIMIT_NOFILE)
+    inf = resource.RLIM_INFINITY
+    if soft != inf and (hard == inf or hard > soft):
+        try:  # each concurrent client holds one socket in this process
+            resource.setrlimit(resource.RLIMIT_NOFILE, (hard, hard))
+            soft = hard
+        except (ValueError, OSError):
+            pass
+    if soft != inf and soft >= 0:  # unlimited -> no clamp at all
+        cap = max(64, soft - 2048)
+        if clients > cap:
+            print(f"scaleout: clamping clients {clients} -> {cap} "
+                  f"(RLIMIT_NOFILE {soft})")
+            clients = cap
+
+    def kill(procs):
+        for p in procs:
+            try:
+                p["proc"].kill()
+            except Exception:  # noqa: BLE001
+                pass
+        for p in procs:
+            try:
+                p["proc"].wait(timeout=10)
+            except Exception:  # noqa: BLE001
+                pass
+
+    # -- router hop overhead: one engine, serial, identical request -----
+    engines = [_scaleout_spawn_engine(0)]
+    router = None
+    try:
+        _scaleout_wait_http(
+            engines[0]["port"], "/.well-known/alive",
+            lambda r: r.status == 200, 120,
+        )
+        for _ in range(6):  # compile + warm the stub programs
+            _scaleout_post(
+                engines[0]["port"], "/generate",
+                {"tokens": list(range(1, 9)), "max_new_tokens": 8},
+            )
+        n_serial = 400
+        _scaleout_serial_p50(engines[0]["port"], 30)  # warm the edge
+        direct_p50 = _scaleout_serial_p50(engines[0]["port"], n_serial)
+        direct_gen_p50 = _scaleout_serial_p50(
+            engines[0]["port"], 100, path="/generate"
+        )
+        router = _scaleout_spawn_router(
+            [engines[0]["port"]], args.scaleout_max_inflight
+        )
+        _scaleout_wait_http(
+            router["port"], "/.well-known/router",
+            lambda r: all(
+                b["accepting"]
+                for b in json.loads(r.read())["data"]["fleet"]["backends"]
+            ), 60,
+        )
+        _scaleout_serial_p50(router["port"], 30)  # warm the hop path
+        routed_p50 = _scaleout_serial_p50(router["port"], n_serial)
+        routed_gen_p50 = _scaleout_serial_p50(
+            router["port"], 100, path="/generate"
+        )
+        overhead_ms = (routed_p50 - direct_p50) * 1e3
+    finally:
+        kill(engines + ([router] if router else []))
+
+    # -- QPS vs process count -------------------------------------------
+    # QPS vs process count. The router tier itself is stateless, so it
+    # runs REPLICATED (like any production front tier) — a constant
+    # count across phases, sized so one Python event loop's ~1 ms/req
+    # ceiling never masquerades as an engine limit. Clients split
+    # round-robin across router replicas; every router sees every
+    # engine.
+    points = []
+    n_routers = args.scaleout_routers
+    for n in procs_list:
+        engines = [_scaleout_spawn_engine(i) for i in range(n)]
+        routers = []
+        try:
+            for e in engines:
+                _scaleout_wait_http(
+                    e["port"], "/.well-known/alive",
+                    lambda r: r.status == 200, 120,
+                )
+            for e in engines:  # compile/warm every backend directly
+                _scaleout_warm_engine(e["port"])
+            routers = [
+                _scaleout_spawn_router(
+                    [e["port"] for e in engines], args.scaleout_max_inflight
+                )
+                for _ in range(n_routers)
+            ]
+            for router in routers:
+                _scaleout_wait_http(
+                    router["port"], "/.well-known/router",
+                    lambda r: sum(
+                        b["accepting"] for b in
+                        json.loads(r.read())["data"]["fleet"]["backends"]
+                    ) == n, 60,
+                )
+            ramp = max(3.0, clients / 3000)
+            res = _scaleout_closed_loop(
+                [r["port"] for r in routers], clients, warm_s=ramp + 2.0,
+                window_s=args.scaleout_window_s, new_tokens=8,
+            )
+            res["procs"] = n
+            pool = {"hit": 0.0, "dial": 0.0}
+            for router in routers:
+                for k, v in _scaleout_pool_hits(
+                    router["metrics_port"]
+                ).items():
+                    pool[k] += v
+            res["pool"] = pool
+            points.append(res)
+            print(f"scaleout {n}p: {res['qps']:.1f} qps "
+                  f"({res['completed']} done, {res['errors']} errors)")
+        finally:
+            kill(engines + routers)
+
+    by_n = {p["procs"]: p for p in points}
+    # scaling ratios only exist relative to a MEASURED 1-process point:
+    # with `--scaleout-procs 2,4` (or a baseline that completed nothing)
+    # a fabricated denominator would land absurd x-factors in the BENCH
+    # summary line as if measured — report null instead
+    qps1 = by_n.get(1, {}).get("qps") or None
+    scaling = {
+        f"x{n}": (round(by_n[n]["qps"] / qps1, 2) if qps1 else None)
+        for n in by_n if n != 1
+    }
+    top = max(by_n)
+    return {
+        "metric": "scaleout_qps",
+        "value": round(by_n[top]["qps"], 1),
+        "unit": f"req/s ({top} engine processes, 8-tok completions)",
+        "vs_baseline": (
+            round(by_n[top]["qps"] / (qps1 * top), 3) if qps1 else None
+        ),
+        "detail": {
+            "scaleout": {
+                "clients": clients,
+                "window_s": args.scaleout_window_s,
+                "points": [
+                    {k: (round(v, 2) if isinstance(v, float) else v)
+                     for k, v in p.items()} for p in points
+                ],
+                "qps_scaling": scaling,
+                "router_overhead_p50_ms": round(overhead_ms, 3),
+                "direct_p50_ms": round(direct_p50 * 1e3, 2),
+                "routed_p50_ms": round(routed_p50 * 1e3, 2),
+                "direct_generate_p50_ms": round(direct_gen_p50 * 1e3, 2),
+                "routed_generate_p50_ms": round(routed_gen_p50 * 1e3, 2),
+                "host_cores": os.cpu_count(),
+            },
+        },
+    }
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
+    import sys
+
+    # `bench.py scaleout` (ISSUE 13 spelling) == `--model scaleout`
+    if len(sys.argv) > 1 and sys.argv[1] == "scaleout":
+        sys.argv[1:2] = ["--model", "scaleout"]
     ap.add_argument(
-        "--model", choices=("serving", "mlp", "greet"), default=None,
+        "--model", choices=("serving", "mlp", "greet", "scaleout"),
+        default=None,
         help="default: serving on TPU, mlp on CPU (2B init on CPU is minutes)",
     )
     # gemma serving knobs (defaults = measured sweet spot on v5e:
@@ -2000,7 +2413,28 @@ def main() -> None:
     ap.add_argument("--max-batch", type=int, default=64)
     ap.add_argument("--max-inflight", type=int, default=32)
     ap.add_argument("--max-delay-ms", type=float, default=1.0)
+    # scale-out (router tier over engine processes; CPU harness)
+    ap.add_argument("--scaleout-procs", default="1,2,4",
+                    help="engine process counts to measure, comma-separated")
+    ap.add_argument("--scaleout-clients", type=int, default=10000,
+                    help="concurrent closed-loop clients through the router "
+                         "(clamped to the fd limit)")
+    ap.add_argument("--scaleout-window-s", type=float, default=8.0,
+                    help="steady measurement window per process count")
+    ap.add_argument("--scaleout-max-inflight", type=int, default=512,
+                    help="router upstream in-flight cap (queues the rest "
+                         "at the router; bounds sockets and engine queues)")
+    ap.add_argument("--scaleout-routers", type=int, default=2,
+                    help="router replicas (stateless tier; constant across "
+                         "phases so QPS ratios isolate ENGINE scaling)")
     args = ap.parse_args()
+
+    if args.model == "scaleout":
+        # subprocess-only mode: the bench process itself never touches jax
+        result = bench_scaleout(args)
+        print(json.dumps(result))
+        print(json.dumps(_summary_line(result)))
+        return
 
     # config-1 greet subprocess runs BEFORE jax touches this process (the
     # whole point of the isolation — see _greet_subprocess). --model greet
@@ -2150,6 +2584,18 @@ def _summary_line(result: dict) -> dict:
             "time_to_fully_shifted_s": ro.get("time_to_fully_shifted_s"),
             "p99_shift_delta": ro.get("p99_shift_delta"),
         }
+    if d.get("scaleout"):  # BENCH_r16+: router tier QPS linearity
+        sc = d["scaleout"]
+        row = {
+            f"qps_{p['procs']}p": p.get("qps")
+            for p in (sc.get("points") or [])
+        }
+        row.update(sc.get("qps_scaling") or {})
+        row["router_overhead_p50_ms"] = sc.get("router_overhead_p50_ms")
+        row["clients"] = sc.get("clients")
+        errors = sum(p.get("errors", 0) for p in (sc.get("points") or []))
+        row["errors"] = errors
+        s["scaleout"] = row
     if d.get("subruns"):
         s["greet_qps"] = d["subruns"].get("greet_qps_cpu")
         s["mlp_qps"] = d["subruns"].get("mlp_qps")

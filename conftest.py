@@ -19,3 +19,22 @@ import jax  # noqa: E402
 # must not burn the chip, so it is overridden too.
 if os.environ.get("JAX_PLATFORMS") in (None, "", "axon"):
     jax.config.update("jax_platforms", "cpu")
+
+# Persistent XLA compilation cache for the whole suite: the tier-1 run is
+# dominated by compiles of tiny test models (engine equality/rollout/spec
+# tests re-build near-identical programs in every process), so a warm
+# cache cuts repeat runs by minutes. Must be configured HERE — before any
+# test compiles — because jax initializes its cache object on the first
+# compile and ignores later config updates (enable_compilation_cache in
+# engine init resets it, but non-engine tests would already have lost
+# theirs). Repo-local dir so CI workspaces carry it between runs.
+_xla_cache = os.environ.get("GOFR_XLA_CACHE_DIR") or os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), ".xla_cache"
+)
+try:
+    os.makedirs(_xla_cache, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", _xla_cache)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+except Exception:  # noqa: BLE001 — cache is an optimization only
+    pass

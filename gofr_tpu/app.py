@@ -429,6 +429,16 @@ class App:
                 rt.drain()
             except Exception as e:  # noqa: BLE001 — drain must reach shutdown
                 self.logger.error(f"drain: engine drain failed: {e!r}")
+        fr = getattr(self.container, "front_router", None)
+        if fr is not None:
+            try:
+                # stop the autoscaler but LEAVE managed engines serving:
+                # a rolling router deploy must not take the fleet's
+                # capacity down with it (container.close() reaps on a
+                # real process exit)
+                fr.drain()
+            except Exception as e:  # noqa: BLE001 — drain must reach shutdown
+                self.logger.error(f"drain: front-router drain failed: {e!r}")
         threading.Thread(
             target=self._drain_then_stop, args=(deadline_s,),
             name="app-drain", daemon=True,

@@ -43,6 +43,8 @@ class Container:
         self.pubsub = None
         self.mongo = None
         self.tpu_runtime = None
+        # scale-out proxy core (gofr_tpu.router.new_router_app attaches)
+        self.front_router = None
         self.start_time = time.time()
 
     # -- construction (container.go:73-154) --
@@ -187,7 +189,11 @@ class Container:
         self.mongo = db
 
     def close(self) -> None:
-        for attr in ("redis", "sql", "pubsub", "mongo", "tpu_runtime"):
+        # front_router: the scale-out proxy core (poll thread, breaker
+        # probes, autoscaler-managed engine processes) — attached by
+        # gofr_tpu.router.new_router_app
+        for attr in ("redis", "sql", "pubsub", "mongo", "tpu_runtime",
+                     "front_router"):
             ds = getattr(self, attr)
             if ds is not None and hasattr(ds, "close"):
                 try:

@@ -125,6 +125,15 @@ def llm_request_kwargs(ctx: Context) -> dict:
 
             client = "key:" + hashlib.sha256(key.encode()).hexdigest()[:12]
     if not client:
+        # behind the front router (or any proxy) the socket peer is the
+        # proxy for EVERY request — the original peer rides the first
+        # X-Forwarded-For hop instead. Same trust model as X-GoFr-Client
+        # (self-reported identities shape fair-queuing order, nothing
+        # more); docs/advanced-guide/scale-out.md.
+        fwd = hdr("X-Forwarded-For")
+        if fwd:
+            client = fwd.split(",")[0].strip()
+    if not client:
         # HTTP: the socket peer; gRPC: host_name() is the peer string
         # ("ipv4:addr:port"). HTTP's host_name() is the Host HEADER (the
         # server's own name) — useless as a client identity, so
@@ -213,12 +222,59 @@ def debug_engine_handler(ctx: Context) -> Any:
     saturated engine. Deliberately does NOT construct the TPU runtime:
     a pure-web app probing this route must not initialize jax."""
     rt = ctx.container.tpu_runtime
+    llms = getattr(rt, "_llms", {}) if rt is not None else {}
+    serving = _serving_summary(ctx.container, llms)
+    if ctx.param("serving") == "1":
+        # the front router's poll: just the routing signals, skipping
+        # the full per-replica debug state (slot tables, percentile
+        # summaries) — a fleet view polling N backends at poll-interval
+        # Hz must not cost the engines their GIL
+        return {"serving": serving}
     if rt is None:
-        return {"engines": {}, "note": "tpu runtime not initialized"}
-    llms = getattr(rt, "_llms", {})
+        return {
+            "engines": {}, "note": "tpu runtime not initialized",
+            "serving": serving,
+        }
     return {
         "platform": getattr(rt, "platform", None),
         "engines": {name: eng.debug_state() for name, eng in llms.items()},
+        "serving": serving,
+    }
+
+
+def _serving_summary(container, llms) -> dict:
+    """Compact per-process serving signals — the front router's fleet
+    view polls this block (docs/advanced-guide/scale-out.md) instead of
+    parsing the full per-replica debug state: queued tokens, measured
+    throughput, predicted queue wait, and whether this process should
+    be routed to at all."""
+    total_load = 0
+    total_tput = 0.0
+    models: dict[str, dict] = {}
+    for name, handle in llms.items():
+        eng = getattr(handle, "engine", handle)
+        try:
+            load = int(eng.load_tokens())
+            tput = eng.throughput_tok_s() or 0.0
+            wait = eng.predicted_wait_s()
+        except Exception:  # noqa: BLE001 — a dying engine must not 500 this
+            continue
+        total_load += load
+        total_tput += tput
+        models[name] = {
+            "load_tokens": load,
+            "throughput_tok_s": tput or None,
+            "predicted_wait_s": wait,
+        }
+    draining = bool(getattr(container, "draining", False))
+    return {
+        "draining": draining,
+        "load_tokens": total_load,
+        "throughput_tok_s": total_tput or None,
+        "predicted_wait_s": (
+            total_load / total_tput if total_tput > 1e-9 else None
+        ),
+        "models": models,
     }
 
 

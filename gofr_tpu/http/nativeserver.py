@@ -232,6 +232,11 @@ class _HTTPProtocol(asyncio.Protocol):
                     b'{"error":{"message":"internal error"}}',
                 )
             if self.closed or self.transport is None:
+                # client vanished while the handler ran: the response is
+                # undeliverable, but a streamed body still owns resources
+                # (engine slot, a proxy's in-flight permit + upstream
+                # socket) — close the producer instead of dropping it
+                await self._aclose_stream(resp)
                 return
             try:
                 if resp.stream is not None and method != "HEAD":

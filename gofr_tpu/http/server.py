@@ -295,9 +295,15 @@ class AsyncHTTPServer:
             if "transfer-encoding" not in seen:
                 head.append(b"Transfer-Encoding: chunked\r\n")
             head.append(b"\r\n")
-            writer.write(b"".join(head))
-            await writer.drain()
             try:
+                # header write INSIDE the try: a client gone before the
+                # first byte must still run the except path below, which
+                # closes the producing generator — an un-started
+                # generator's finally never runs, so dropping it here
+                # would leak the producer (and whatever it holds: an
+                # engine slot, a proxy's upstream socket)
+                writer.write(b"".join(head))
+                await writer.drain()
                 async for chunk in resp.stream:
                     if not chunk:
                         continue

@@ -2007,6 +2007,13 @@ class LLMEngine:
             return None
         return self.load_tokens() / tput
 
+    def throughput_tok_s(self) -> float | None:
+        """Measured serving throughput (EMA over recent device windows;
+        None until the first window). The front router pools this across
+        engine PROCESSES to price fleet admission the same way one
+        engine prices its own (docs/advanced-guide/scale-out.md)."""
+        return self._tput_ema
+
     def _observe_tput(self, tokens: int, dt: float) -> None:
         """Fold one finished device window (tokens served / wall) into
         the throughput EMA that prices predicted queue wait. Lock-free
@@ -6013,8 +6020,8 @@ class ReplicatedLLMEngine:
         """Retry-After for a fleet-level rejection: excess backlog over
         the cap, priced at the fleet's pooled measured throughput (1 s
         floor when no replica has an estimate yet)."""
-        tput = sum(e._tput_ema or 0.0 for e in self.engines if e.alive())
-        if tput <= 1e-9:
+        tput = self.throughput_tok_s()
+        if tput is None:
             return 1.0
         excess = max(0, queued_tokens - self.fleet_max_queue_tokens)
         return max(0.5, excess / tput) if excess else 1.0
@@ -6164,6 +6171,22 @@ class ReplicatedLLMEngine:
 
     def load_tokens(self) -> int:
         return sum(e.load_tokens() for e in self.engines)
+
+    def throughput_tok_s(self) -> float | None:
+        """Pooled measured throughput across live replicas (None until
+        any replica has a window) — the fleet's share of the scale-out
+        admission signal (docs/advanced-guide/scale-out.md)."""
+        tput = sum(e._tput_ema or 0.0 for e in self.engines if e.alive())
+        return tput if tput > 1e-9 else None
+
+    def predicted_wait_s(self) -> float | None:
+        """Fleet predicted queue wait: summed queued tokens over pooled
+        measured throughput (the per-engine estimate, lifted across
+        replicas)."""
+        tput = self.throughput_tok_s()
+        if tput is None:
+            return None
+        return self.load_tokens() / tput
 
     def stats(self) -> dict:
         per = [e.stats() for e in self.engines]

@@ -471,6 +471,22 @@ class DisaggregatedLLMEngine:
     def load_tokens(self) -> int:
         return self.prefill.load_tokens() + self.decode.load_tokens()
 
+    def throughput_tok_s(self) -> float | None:
+        """Pooled measured throughput across BOTH role pools — the
+        scale-out fleet view reads one number per process
+        (docs/advanced-guide/scale-out.md)."""
+        vals = [
+            p.throughput_tok_s() for p in (self.prefill, self.decode)
+        ]
+        tput = sum(v for v in vals if v)
+        return tput if tput > 1e-9 else None
+
+    def predicted_wait_s(self) -> float | None:
+        tput = self.throughput_tok_s()
+        if tput is None:
+            return None
+        return self.load_tokens() / tput
+
     def stats(self) -> dict:
         pre = self.prefill.stats()
         dec = self.decode.stats()

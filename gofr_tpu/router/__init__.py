@@ -1,0 +1,582 @@
+"""Front router: horizontal scale-out over independent engine processes.
+
+Everything before this subsystem serves from ONE process — replicas,
+TP submeshes, and disaggregated pools all live behind one HTTP edge,
+so throughput tops out at what one Python process can shovel. The
+front router is the framework's own inter-service surface
+(gofr_tpu.service: pooled keep-alive client + per-backend circuit
+breakers, docs/advanced-guide/circuit-breaker.md) turned into the
+serving data plane: a stateless process that load-balances over N
+engine processes (docs/advanced-guide/scale-out.md).
+
+Per request, in order:
+
+1. **Fleet admission** — predicted queue wait pooled across processes
+   (queued tokens / summed measured throughput, the PR 6 ladder lifted
+   a level) sheds with a Retry-After priced from fleet throughput
+   (``TPU_ROUTER_SHED_WAIT_S``).
+2. **Routing** — ``X-GoFr-Session`` requests go to their rendezvous-
+   ring owner (the process holding the conversation's KV blocks);
+   everything else to the least queued-tokens backend from the cached
+   fleet view (gofr_tpu/router/fleet.py).
+3. **Dispatch** — over a pooled keep-alive connection, headers
+   forwarded (traceparent re-stamped to the ``router.proxy`` span,
+   ``X-GoFr-*`` identity through to the engine's FairLedger,
+   ``X-Forwarded-For`` appended), bodies streamed chunk-by-chunk with
+   client-disconnect propagation across the hop.
+4. **Recovery** — transport errors / breaker-open / 5xx re-dispatch to
+   another backend under a retry budget; a 429 (and a 503 nobody else
+   can absorb) surfaces the BACKEND's Retry-After untouched — the
+   backend priced its own backoff, re-dispatching would amplify load.
+   An upstream TIMEOUT surfaces immediately: the slow backend may
+   still be executing the request, so a re-dispatch would run
+   non-idempotent work twice.
+
+An optional autoscaler (gofr_tpu/router/autoscaler.py) launches and
+drains engine subprocesses from the same predicted-wait signal,
+bounded by ``TPU_ROUTER_{MIN,MAX}_REPLICAS``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import threading
+import time
+
+from ..http.errors import ErrorServiceUnavailable, ErrorTooManyRequests
+from ..http.responder import Response
+from ..resilience import OverloadController, RetryBudget
+from ..service import CircuitOpenError
+from .autoscaler import DEFAULT_ENGINE_CMD, Autoscaler, ProcessLauncher, free_port
+from .fleet import Backend, FleetView
+from .ring import HashRing
+
+__all__ = [
+    "FrontRouter",
+    "new_router_app",
+    "FleetView",
+    "Backend",
+    "HashRing",
+    "Autoscaler",
+    "ProcessLauncher",
+    "DEFAULT_ENGINE_CMD",
+    "free_port",
+]
+
+# hop-by-hop headers (RFC 9110 §7.6.1) plus framing the proxy re-derives
+_STRIP_REQUEST = frozenset((
+    "connection", "keep-alive", "proxy-connection", "transfer-encoding",
+    "te", "trailer", "upgrade", "host", "content-length", "expect",
+    # re-stamped to the router.proxy span so the backend's spans parent
+    # under the hop, not beside it
+    "traceparent",
+    # folded into the appended X-Forwarded-For — forwarding the inbound
+    # header verbatim as well would send the chain twice
+    "x-forwarded-for",
+))
+_STRIP_RESPONSE = frozenset((
+    "connection", "keep-alive", "transfer-encoding", "content-length",
+))
+
+
+class _GuardedStream:
+    """Body iterator whose cleanup runs even if iteration never began.
+
+    Deliberately NOT an async generator: ``aclose()`` on a
+    never-started async generator skips its ``finally`` (the body was
+    never entered), so a client that vanishes before the first chunk —
+    the server fails the header write and closes the un-iterated
+    stream — would skip any teardown parked in a generator. The proxy
+    parks real resources there: the upstream socket abort + load
+    decrement (disconnect-cancellation crossing the hop), and the
+    in-flight-cap slot — leaking those under client churn ratchets the
+    router toward zero capacity. ``cleanup`` is an async callable run
+    exactly once, at exhaustion, failure, or close — started or not."""
+
+    def __init__(self, inner, cleanup):
+        self._inner = inner
+        self._cleanup = cleanup
+        self._done = False
+
+    def __aiter__(self):
+        return self
+
+    async def __anext__(self):
+        if self._done:
+            raise StopAsyncIteration
+        try:
+            return await self._inner.__anext__()
+        except BaseException:
+            # covers normal exhaustion (StopAsyncIteration) and
+            # upstream failure alike: resources free the moment the
+            # body stops producing, not when the wrapper is GC'd
+            await self.aclose()
+            raise
+
+    async def aclose(self) -> None:
+        if self._done:
+            return
+        self._done = True
+        # a STARTED inner generator still gets its own finally
+        aclose = getattr(self._inner, "aclose", None)
+        if aclose is not None:
+            try:
+                await aclose()
+            except Exception:  # noqa: BLE001 — cleanup below must still run
+                pass
+        await self._cleanup()
+
+
+class FrontRouter:
+    """The routing core: fleet view + admission + retry policy +
+    autoscaler, shared by every proxied request."""
+
+    def __init__(self, config, *, logger=None, metrics=None,
+                 now_fn=time.monotonic, service_factory=None):
+        self.logger = logger
+        self.metrics = metrics
+        self._now = now_fn
+        g = config.get_float
+        self.fleet = FleetView(
+            logger=logger, metrics=metrics,
+            poll_interval_s=g("TPU_ROUTER_POLL_INTERVAL_S", 0.5),
+            breaker_failures=config.get_int("TPU_ROUTER_BREAKER_FAILURES", 3),
+            breaker_interval_s=g("TPU_ROUTER_BREAKER_INTERVAL_S", 1.0),
+            now_fn=now_fn,
+            service_factory=service_factory,
+        )
+        self.admission = OverloadController(
+            shed_wait_s=g("TPU_ROUTER_SHED_WAIT_S", 0.0),
+            min_retry_after=g("TPU_ROUTER_MIN_RETRY_AFTER_S", 0.5),
+            now_fn=now_fn,
+        )
+        self.retry_budget = RetryBudget(
+            rate=g("TPU_ROUTER_RETRY_BUDGET_PER_S", 2.0),
+            burst=g("TPU_ROUTER_RETRY_BUDGET_BURST", 20.0),
+            now_fn=now_fn,
+        )
+        self.upstream_timeout_s = g("TPU_ROUTER_UPSTREAM_TIMEOUT_S", 120.0)
+        self.max_inflight = config.get_int("TPU_ROUTER_MAX_INFLIGHT", 0)
+        self._sem: tuple | None = None  # (loop, semaphore), lazily bound
+        self.sheds = 0
+        self.proxied = 0
+        self.retries = 0
+        self._live_pid = os.getpid()
+        self._pid_lock = threading.Lock()
+        self.autoscaler: Autoscaler | None = None
+        engine_cmd = config.get("TPU_ROUTER_ENGINE_CMD") or ""
+        if engine_cmd:
+            self.autoscaler = Autoscaler(
+                self.fleet,
+                ProcessLauncher(engine_cmd, logger=logger),
+                min_replicas=config.get_int("TPU_ROUTER_MIN_REPLICAS", 1),
+                max_replicas=config.get_int("TPU_ROUTER_MAX_REPLICAS", 4),
+                up_wait_s=g("TPU_ROUTER_SCALE_UP_WAIT_S", 2.0),
+                down_wait_s=g("TPU_ROUTER_SCALE_DOWN_WAIT_S", 0.25),
+                hold_s=g("TPU_ROUTER_SCALE_HOLD_S", 3.0),
+                cooldown_s=g("TPU_ROUTER_SCALE_COOLDOWN_S", 10.0),
+                now_fn=now_fn,
+                shed_count_fn=lambda: self.sheds,
+                metrics=metrics, logger=logger,
+            )
+        for addr in (config.get("TPU_ROUTER_BACKENDS") or "").split(","):
+            addr = addr.strip()
+            if addr:
+                self.fleet.add(addr)
+        if metrics is not None:
+            from ..metrics import HTTP_BUCKETS
+
+            metrics.new_counter(
+                "app_router_requests_total", "proxied requests by outcome"
+            )
+            metrics.new_counter(
+                "app_router_retries_total", "re-dispatches by reason"
+            )
+            metrics.new_counter(
+                "app_router_sheds_total", "fleet-admission 429s"
+            )
+            metrics.new_counter(
+                "app_router_affinity_total", "session routing by result"
+            )
+            metrics.new_histogram(
+                "app_router_proxy_seconds",
+                "router hop time to upstream response headers s",
+                HTTP_BUCKETS,
+            )
+            metrics.new_gauge(
+                "app_router_backends", "fleet membership by state"
+            )
+            metrics.new_gauge(
+                "app_router_fleet_load_tokens", "fleet queued-token total"
+            )
+            metrics.new_gauge(
+                "app_router_predicted_wait_s", "pooled predicted queue wait s"
+            )
+            metrics.new_gauge(
+                "app_router_replicas", "autoscaler-visible replica count"
+            )
+            metrics.new_counter(
+                "app_router_autoscale_total", "scale events by direction"
+            )
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> None:
+        if self.autoscaler is not None:
+            self.autoscaler.ensure_min()
+            self.fleet.add_tick_hook(self.autoscaler.tick)
+        self.fleet.poll_once()
+        self.fleet.start()
+        self._live_pid = os.getpid()
+
+    def _ensure_process_local(self) -> None:
+        """Prefork support (HTTP_WORKERS>1): the router is stateless and
+        jax-free, so it scales by process replication like any GoFr app —
+        but threads don't survive fork, so a forked worker must restart
+        the fleet poll in ITS process on first request. The autoscaler
+        stays with the original process (Autoscaler.tick no-ops in
+        children) — run autoscaled fleets single-worker."""
+        pid = os.getpid()
+        if pid == self._live_pid:
+            return
+        with self._pid_lock:
+            if pid == self._live_pid:
+                return
+            self.fleet.restart_after_fork()
+            self._live_pid = pid
+
+    def drain(self) -> None:
+        """Router drain: stop scaling (leave managed engines serving for
+        whoever replaces us); in-flight proxied streams finish on their
+        own connections."""
+        if self.autoscaler is not None:
+            self.autoscaler.close(reap_managed=False)
+
+    def close(self) -> None:
+        if self.autoscaler is not None:
+            self.autoscaler.close(reap_managed=True)
+        self.fleet.close()
+
+    def snapshot(self) -> dict:
+        return {
+            "fleet": self.fleet.snapshot(),
+            "admission": self.admission.snapshot(),
+            "retry_budget_remaining": round(self.retry_budget.remaining(), 2),
+            "proxied": self.proxied,
+            "sheds": self.sheds,
+            "retries": self.retries,
+            "autoscaler": (
+                self.autoscaler.snapshot()
+                if self.autoscaler is not None else None
+            ),
+        }
+
+    # -- routing -----------------------------------------------------------
+    def pick(self, session_id: str, exclude: set[str]) -> tuple[Backend | None, str]:
+        """-> (backend, affinity_result). Session requests go to their
+        ring owner; a draining/dead/excluded owner falls through the
+        rendezvous ranking, then to least-loaded."""
+        now = self._now()
+        if session_id:
+            ring = self.fleet.ring  # atomic snapshot
+            # owners() rank 0 IS the owner (same blake2b ranking that
+            # owner() maximizes) — one pass scores the fleet once
+            for rank, addr in enumerate(ring.owners(session_id)):
+                if addr in exclude:
+                    continue
+                b = self.fleet.get(addr)
+                if b is not None and b.accepting(now):
+                    return b, ("hit" if rank == 0 else "fallthrough")
+        candidates = [
+            b for b in self.fleet.accepting() if b.address not in exclude
+        ]
+        if not candidates:
+            return None, "miss" if session_id else "none"
+        b = min(candidates, key=lambda b: b.effective_load())
+        return b, ("miss" if session_id else "none")
+
+    def _count(self, name: str, **labels) -> None:
+        if self.metrics is not None:
+            self.metrics.increment_counter(name, **labels)
+
+    def _acquire_sem(self):
+        if self.max_inflight <= 0:
+            return None
+        loop = asyncio.get_running_loop()
+        if self._sem is None or self._sem[0] is not loop:
+            self._sem = (loop, asyncio.Semaphore(self.max_inflight))
+        return self._sem[1]
+
+    # -- the proxy ---------------------------------------------------------
+    async def proxy(self, ctx) -> Response:
+        self._ensure_process_local()
+        req = ctx.request
+        # fleet admission BEFORE any backend work: Retry-After is the
+        # time the pooled backlog needs to drain under the threshold
+        wait = self.fleet.pooled_predicted_wait_s()
+        self.admission.observe(wait)
+        retry_after = self.admission.should_shed(wait)
+        if retry_after is not None:
+            self.sheds += 1
+            self._count("app_router_sheds_total")
+            self._count("app_router_requests_total", outcome="shed")
+            raise ErrorTooManyRequests(
+                "fleet saturated (predicted wait "
+                f"{wait:.1f}s)", retry_after=retry_after,
+            )
+        fwd = {
+            k: v for k, v in req.headers.items() if k not in _STRIP_REQUEST
+        }
+        peer = (req.remote_addr or "").rsplit(":", 1)[0]
+        prior = req.headers.get("x-forwarded-for", "")
+        fwd["X-Forwarded-For"] = f"{prior}, {peer}" if prior else peer
+        if not fwd.get("x-gofr-client"):
+            # resolve the END client's fairness identity here — at the
+            # engine the peer address is this router for every request,
+            # which would collapse the FairLedger to one client
+            from ..handler import llm_request_kwargs
+
+            fwd["X-GoFr-Client"] = llm_request_kwargs(ctx)["client"]
+        session_id = req.headers.get("x-gofr-session", "")
+        sem = self._acquire_sem()
+        if sem is not None:
+            await sem.acquire()
+        handed_off = False
+        try:
+            with ctx.trace("router.proxy") as span:
+                fwd["traceparent"] = span.traceparent
+                resp = await self._dispatch(req, fwd, session_id, span)
+            if sem is not None and resp.stream is not None:
+                # the in-flight cap must bound STREAMED proxies too: the
+                # slot is held until the upstream body completes (or the
+                # client disconnects), released by the wrapping stream
+
+                async def _free():
+                    sem.release()
+
+                resp.stream = _GuardedStream(resp.stream, _free)
+                handed_off = True
+            return resp
+        finally:
+            if sem is not None and not handed_off:
+                sem.release()
+
+    async def _dispatch(self, req, fwd: dict, session_id: str, span) -> Response:
+        t0 = time.perf_counter()
+        exclude: set[str] = set()
+        last_error: BaseException | None = None
+        last_503: tuple | None = None  # (stream headers, body, backend)
+        while True:
+            backend, affinity = self.pick(session_id, exclude)
+            if session_id and not exclude:
+                self._count("app_router_affinity_total", result=affinity)
+            if backend is None:
+                if last_503 is not None:
+                    return self._surface(last_503, outcome="upstream_503")
+                self._count("app_router_requests_total", outcome="no_backend")
+                raise ErrorServiceUnavailable(
+                    "no live backend",
+                    retry_after=2 * self.fleet.poll_interval_s,
+                ) from last_error
+            span.set_attribute("backend", backend.address)
+            backend.outstanding += 1
+            dispatched = False
+            try:
+                stream = await backend.svc.astream(
+                    req.method, req.target, body=req.body, headers=fwd,
+                    timeout=self.upstream_timeout_s,
+                    # the target is whatever the end client asked for —
+                    # as a histogram label it must be a fixed series
+                    metric_path="proxy",
+                )
+            except CircuitOpenError as e:
+                last_error = e
+                reason = "breaker_open"
+            except (TimeoutError, asyncio.TimeoutError) as e:
+                # (both spellings: distinct types until 3.11 unified them)
+                # a response-header timeout is a SLOW backend, not a
+                # dead one — the request may still be executing there
+                # (astream aborts the socket, but cancellation is
+                # best-effort). Re-dispatching would run non-idempotent
+                # work twice, amplifying load exactly when the fleet is
+                # slowest: surface it instead of burning retry budget.
+                self._count(
+                    "app_router_requests_total", outcome="upstream_timeout"
+                )
+                raise ErrorServiceUnavailable(
+                    f"upstream timed out after {self.upstream_timeout_s:.0f}s",
+                    retry_after=2 * self.fleet.poll_interval_s,
+                ) from e
+            except Exception as e:  # noqa: BLE001 — transport failure
+                last_error = e
+                reason = "transport"
+            else:
+                status = stream.status_code
+                if status in (429, 503):
+                    if status == 503:
+                        # this backend is leaving (drain) or refusing;
+                        # honor its Retry-After as a LOCAL cooldown and
+                        # try the rest of the fleet — only when nobody
+                        # else can take the request does the 503 surface
+                        try:
+                            ra = float(stream.headers.get("retry-after", ""))
+                        except ValueError:
+                            ra = 0.0
+                        if ra > 0:
+                            backend.cooldown_until = max(
+                                backend.cooldown_until,
+                                self._now() + min(ra, 30.0),
+                            )
+                    body = await self._read_or_none(stream)
+                    if body is None:
+                        # upstream died mid-body: a transport failure,
+                        # not a priced response — fall through to fleet
+                        last_error = ConnectionError(
+                            f"upstream {status} body truncated"
+                        )
+                        reason = "transport"
+                    elif status == 429:
+                        # the backend priced its own backoff (overload
+                        # shed): re-dispatching a shed is how retry
+                        # storms start — surface it, Retry-After intact
+                        return self._surface(
+                            (stream.headers, body, backend, status),
+                            outcome="upstream_429",
+                        )
+                    else:
+                        last_503 = (stream.headers, body, backend, status)
+                        reason = "unavailable"
+                elif status >= 500:
+                    await stream.aclose()  # abort; don't read a 5xx body
+                    last_error = ErrorServiceUnavailable(
+                        f"upstream {status} from {backend.address}"
+                    )
+                    reason = "5xx"
+                else:
+                    dispatched = True
+                    self.proxied += 1
+                    if self.metrics is not None:
+                        self.metrics.record_histogram(
+                            "app_router_proxy_seconds",
+                            time.perf_counter() - t0,
+                        )
+                    self._count("app_router_requests_total", outcome="ok")
+                    return await self._respond(stream, backend)
+            finally:
+                if not dispatched:
+                    backend.outstanding = max(0, backend.outstanding - 1)
+            exclude.add(backend.address)
+            if not self.retry_budget.take():
+                # budget dry: surface the ORIGINAL failure — under
+                # overload a retry is new load aimed at the replicas
+                # least able to absorb it
+                if last_503 is not None:
+                    return self._surface(last_503, outcome="upstream_503")
+                self._count(
+                    "app_router_requests_total", outcome="retry_exhausted"
+                )
+                raise last_error  # type: ignore[misc]
+            self.retries += 1
+            self._count("app_router_retries_total", reason=reason)
+
+    @staticmethod
+    async def _read_or_none(stream) -> bytes | None:
+        """Read a small upstream body (429/503 envelopes), or None when
+        the upstream dies mid-read — the caller must treat that as a
+        transport failure and keep failing over, not 500 the client
+        while healthy survivors exist."""
+        try:
+            return await stream.aread()
+        except Exception:  # noqa: BLE001 — socket died under the read
+            try:
+                await stream.aclose()
+            except Exception:  # noqa: BLE001
+                pass
+            return None
+
+    def _surface(self, saved: tuple, *, outcome: str) -> Response:
+        headers, body, _backend, status = saved
+        self._count("app_router_requests_total", outcome=outcome)
+        out = [
+            (k.title(), v) for k, v in headers.items()
+            if k not in _STRIP_RESPONSE
+        ]
+        return Response(status, out, body)
+
+    async def _respond(self, stream, backend: Backend) -> Response:
+        out_headers = [
+            (k.title(), v) for k, v in stream.headers.items()
+            if k not in _STRIP_RESPONSE
+        ]
+
+        if not stream.streamed:
+            # length-delimited: buffer (it's a JSON envelope, not a
+            # token stream) so keep-alive framing stays simple
+            try:
+                body = await stream.aread()
+            finally:
+                backend.outstanding = max(0, backend.outstanding - 1)
+            return Response(stream.status_code, out_headers, body)
+
+        # chunk-by-chunk forwarding: a token is on the client's socket
+        # the moment the engine emits it. If the CLIENT disconnects,
+        # the server acloses this stream (http/server.py,
+        # nativeserver.py), the cleanup aborts the UPSTREAM socket, and
+        # the engine's own disconnect path cancels the generation
+        # (PR 9) — cancellation crosses the hop. _GuardedStream, not a
+        # generator finally: a disconnect BEFORE the first chunk closes
+        # the stream un-started, where a generator's finally never runs
+        # — the engine would decode the abandoned request to completion
+        # and `outstanding` would stay inflated until the next poll.
+        async def _teardown():
+            backend.outstanding = max(0, backend.outstanding - 1)
+            await stream.aclose()
+
+        return Response(
+            stream.status_code, out_headers, b"",
+            stream=_GuardedStream(stream.aiter_raw(), _teardown),
+        )
+
+
+def router_debug_handler(ctx):
+    """GET /.well-known/router — the live fleet view: per-backend
+    health/load/breaker state, ring membership, admission + autoscaler
+    state, retry budget. Read-only."""
+    fr = getattr(ctx.container, "front_router", None)
+    if fr is None:
+        return {"note": "front router not initialized"}
+    return fr.snapshot()
+
+
+def new_router_app(config=None, *, configs_dir: str = "./configs"):
+    """Build the front-router App: catch-all proxy routes over the
+    FrontRouter core plus the /.well-known/router debug view. Configure
+    with TPU_ROUTER_* (docs/advanced-guide/scale-out.md); run like any
+    app (``.run()`` / ``run_in_background()``).
+
+    The well-known routes keep their usual meaning for THIS process
+    (health/alive/drain are the router's own — a draining router stops
+    being routed to by ITS load balancer while proxied streams finish);
+    everything else is forwarded to the engine fleet."""
+    from ..app import App
+
+    app = App(config=config, configs_dir=configs_dir)
+    fr = FrontRouter(
+        app.config, logger=app.logger, metrics=app.container.metrics
+    )
+    app.container.front_router = fr  # container.close() tears it down
+    app.front_router = fr
+
+    async def proxy_handler(ctx):
+        return await fr.proxy(ctx)
+
+    proxy_timeout = app.config.get_float("TPU_ROUTER_PROXY_TIMEOUT_S", 300.0)
+    app.get("/.well-known/router", router_debug_handler)
+    # HEAD rides along so LB health probes / curl -I against proxied
+    # paths answer like direct engine access would; OPTIONS needs no
+    # route — the CORS middleware short-circuits every preflight
+    for method in ("GET", "HEAD", "POST", "PUT", "PATCH", "DELETE"):
+        app._add(method, "/{proxy_path...}", proxy_handler,
+                 timeout_s=proxy_timeout)
+    fr.start()
+    return app

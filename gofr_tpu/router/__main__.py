@@ -1,0 +1,20 @@
+"""``python -m gofr_tpu.router`` — run a front-router process from the
+environment (TPU_ROUTER_* knobs; docs/advanced-guide/scale-out.md).
+
+Minimal deployment::
+
+    TPU_ROUTER_BACKENDS=http://10.0.0.2:8000,http://10.0.0.3:8000 \\
+        HTTP_PORT=8080 python -m gofr_tpu.router
+
+Autoscaled local fleet::
+
+    TPU_ROUTER_ENGINE_CMD='python -m gofr_tpu.router.engine_stub \\
+        --port {port} --metrics-port {metrics_port}' \\
+        TPU_ROUTER_MIN_REPLICAS=2 TPU_ROUTER_MAX_REPLICAS=4 \\
+        python -m gofr_tpu.router
+"""
+
+from . import new_router_app
+
+if __name__ == "__main__":
+    new_router_app().run()

@@ -1,0 +1,343 @@
+"""Autoscaler: grow and shrink the engine-process fleet from the same
+signals the admission ladder sheds on.
+
+The router already computes fleet predicted queue wait (queued tokens /
+pooled throughput) to price 429s; the autoscaler closes the loop —
+sustained backlog launches another engine process, sustained idleness
+drains one. Both transitions are deliberately slow (hold + cooldown
+hysteresis): capacity changes cost warmup/compile on the way up and KV
+re-prefills on the way down, so the scaler acts on trends, not spikes.
+
+Scale-down is the PR 5 graceful drain across a process boundary:
+the victim leaves the ring first (new sessions re-home, rendezvous
+moves only its keys), its readiness flips to 503, in-flight streams run
+to completion on the old process, and only after the process exits (or
+goes unreachable past a grace window) is it reaped. Zero dropped
+streams, test-pinned (tests/test_router.py).
+
+Everything is injectable — clock, launcher, fleet — so tier-1 drives
+the whole state machine with fakes; scripts/smoke_scaleout.py and
+``bench.py scaleout`` run real subprocesses.
+"""
+
+from __future__ import annotations
+
+import os
+import shlex
+import socket
+import subprocess
+import sys
+import threading
+import time
+from typing import Callable
+
+__all__ = ["Autoscaler", "ProcessLauncher", "free_port"]
+
+
+def free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+class ProcessLauncher:
+    """Launch engine processes from a command template.
+
+    ``cmd`` is a shell-style template with ``{port}`` and
+    ``{metrics_port}`` placeholders, e.g. the stub fleet used by the
+    bench and smoke::
+
+        python -m gofr_tpu.router.engine_stub --port {port} --metrics-port {metrics_port}
+
+    (``TPU_ROUTER_ENGINE_CMD``; docs/advanced-guide/scale-out.md). The
+    subprocess inherits the environment plus anything in ``env``."""
+
+    def __init__(self, cmd: str, *, logger=None, env: dict | None = None):
+        self.cmd = cmd
+        self.logger = logger
+        self.env = env or {}
+
+    def launch(self) -> tuple[str, subprocess.Popen]:
+        port, metrics_port = free_port(), free_port()
+        argv = [
+            a.format(port=port, metrics_port=metrics_port)
+            for a in shlex.split(self.cmd)
+        ]
+        env = {**os.environ, **self.env}
+        proc = subprocess.Popen(  # noqa: S603 — operator-supplied template
+            argv, env=env,
+            stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT,
+        )
+        address = f"http://127.0.0.1:{port}"
+        if self.logger is not None:
+            self.logger.info(
+                f"autoscaler launched engine pid={proc.pid} at {address}"
+            )
+        return address, proc
+
+    def reap(self, proc, *, grace_s: float = 10.0) -> None:
+        if proc is None or proc.poll() is not None:
+            return
+        proc.terminate()
+        try:
+            proc.wait(timeout=grace_s)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait(timeout=grace_s)
+
+
+class Autoscaler:
+    """Predicted-wait-driven replica count controller. ``tick()`` runs
+    after every fleet poll; all state transitions live here so a faked
+    clock walks the machine deterministically."""
+
+    def __init__(
+        self,
+        fleet,
+        launcher,
+        *,
+        min_replicas: int = 1,
+        max_replicas: int = 4,
+        up_wait_s: float = 2.0,
+        down_wait_s: float = 0.25,
+        hold_s: float = 3.0,
+        cooldown_s: float = 10.0,
+        drain_grace_s: float = 60.0,
+        now_fn: Callable[[], float] = time.monotonic,
+        shed_count_fn: Callable[[], int] | None = None,
+        metrics=None,
+        logger=None,
+    ):
+        self.fleet = fleet
+        self.launcher = launcher
+        self.min_replicas = max(0, int(min_replicas))
+        self.max_replicas = max(self.min_replicas, int(max_replicas))
+        self.up_wait_s = float(up_wait_s)
+        self.down_wait_s = float(down_wait_s)
+        self.hold_s = max(0.0, float(hold_s))
+        self.cooldown_s = max(0.0, float(cooldown_s))
+        self.drain_grace_s = float(drain_grace_s)
+        self._now = now_fn
+        self._shed_count = shed_count_fn or (lambda: 0)
+        self.metrics = metrics
+        self.logger = logger
+        self._over_since: float | None = None
+        self._under_since: float | None = None
+        self._cooldown_until = 0.0
+        self._sheds_seen = 0
+        self._drain_started: dict[str, float] = {}  # address -> t
+        self.scale_ups = 0
+        self.scale_downs = 0
+        self._closed = False
+        # prefork guard: only the process that built the autoscaler may
+        # scale — a forked router worker's fleet view does not track the
+        # parent's managed processes (docs/advanced-guide/scale-out.md)
+        self._home_pid = os.getpid()
+
+    # -- helpers -----------------------------------------------------------
+    def _replicas(self) -> list:
+        """Backends that count against the min/max bounds: everything
+        known and not already on its way out."""
+        return [b for b in self.fleet.backends() if not b.draining]
+
+    def ensure_min(self) -> None:
+        while len(self._replicas()) < self.min_replicas and not self._closed:
+            self._scale_up(reason="min_replicas")
+
+    def _scale_up(self, reason: str) -> None:
+        address, proc = self.launcher.launch()
+        self.fleet.add(address, managed=True, proc=proc)
+        self.scale_ups += 1
+        self._cooldown_until = self._now() + self.cooldown_s
+        if self.metrics is not None:
+            self.metrics.increment_counter(
+                "app_router_autoscale_total", direction="up"
+            )
+        if self.logger is not None:
+            self.logger.info(f"autoscale up ({reason}): +{address}")
+
+    def _scale_down(self, backend) -> None:
+        # leave the ring BEFORE the drain POST: new requests and
+        # re-homed sessions must stop landing here first.
+        # drain_requested is the sticky intent the poll folds back into
+        # `draining` — a lost drain POST must not void the scale-down
+        backend.drain_requested = True
+        backend.draining = True
+        self.fleet._rebuild_ring()
+        self._drain_started[backend.address] = self._now()
+        self.scale_downs += 1
+        self._cooldown_until = self._now() + self.cooldown_s
+        if self.metrics is not None:
+            self.metrics.increment_counter(
+                "app_router_autoscale_total", direction="down"
+            )
+        if self.logger is not None:
+            self.logger.info(f"autoscale down: draining {backend.address}")
+        # the POST rides its own daemon thread: tick() runs on the
+        # router-fleet-poll thread, and a victim that stops answering
+        # right after selection would otherwise stall polling (ring,
+        # load state, further ticks) for the full 5 s timeout — the
+        # same wedge the concurrent probes exist to avoid. A lost POST
+        # is already covered: drain_requested is sticky and the grace
+        # reap bounds the wedge.
+        def _post(svc=backend.svc, addr=backend.address):
+            try:
+                svc.request(
+                    "POST", "/.well-known/debug/drain",
+                    timeout=5.0, _health_probe=True,
+                )
+            except Exception as e:  # noqa: BLE001 — an already-dead backend
+                if self.logger is not None:
+                    self.logger.warn(f"drain POST to {addr} failed: {e!r}")
+
+        threading.Thread(
+            target=_post, name="router-drain-post", daemon=True,
+        ).start()
+
+    def _reap_drained(self) -> None:
+        """Remove drained backends whose process has exited — or that
+        are still around past the grace window (the engine's own
+        GOFR_DRAIN_DEADLINE_S bounds how long in-flight work may run,
+        so a healthy drain always converges). Going unreachable does
+        NOT shortcut the grace: a draining engine busy finishing its
+        last long streams can miss polls (the fleet treats slow polls
+        as saturation, not death) — reaping it on that signal would
+        kill exactly the streams the drain exists to protect."""
+        now = self._now()
+        for b in self.fleet.backends():
+            if not b.draining or not b.managed:
+                continue
+            started = self._drain_started.get(b.address)
+            exited = b.proc is not None and b.proc.poll() is not None
+            timed_out = (
+                started is not None and now - started > self.drain_grace_s
+            )
+            if exited or timed_out:
+                if not exited and self.launcher is not None:
+                    # reap on EVERY removal path — a backend that went
+                    # unreachable mid-drain may still have a live
+                    # process, and removing it from the fleet would
+                    # orphan that process forever
+                    self.launcher.reap(b.proc)
+                self.fleet.remove(b.address)
+                self._drain_started.pop(b.address, None)
+                if self.logger is not None:
+                    self.logger.info(f"autoscaler reaped {b.address}")
+
+    def _reap_crashed(self) -> None:
+        """Collect managed engines that died WITHOUT being drained
+        (OOM-kill, segfault, operator kill -9). Left in place they are
+        corpses the fleet polls forever: they count toward the replica
+        bounds (blocking scale-up while serving nothing) and their
+        Popen is never wait()ed. ``proc.poll()`` both detects and reaps
+        the zombie; removal lets the min-replica floor relaunch."""
+        for b in self.fleet.backends():
+            if not b.managed or b.draining or b.proc is None:
+                continue
+            if b.proc.poll() is not None:
+                self.fleet.remove(b.address)
+                if self.logger is not None:
+                    self.logger.warn(
+                        f"autoscaler reaped crashed engine {b.address} "
+                        f"(exit {b.proc.returncode})"
+                    )
+
+    # -- the state machine -------------------------------------------------
+    def tick(self) -> None:
+        if self._closed or os.getpid() != self._home_pid:
+            return
+        self._reap_drained()
+        self._reap_crashed()
+        now = self._now()
+        wait = self.fleet.pooled_predicted_wait_s()
+        sheds = self._shed_count()
+        shed_delta = sheds - self._sheds_seen
+        self._sheds_seen = sheds
+        replicas = self._replicas()
+        n = len(replicas)
+        if self.metrics is not None:
+            self.metrics.set_gauge("app_router_replicas", float(n))
+        # the min bound is a floor enforced CONTINUOUSLY, not just at
+        # start(): a crash-reap above may have dropped the fleet below
+        # it with zero backlog signal (dead engines queue nothing).
+        # Cooldown still gates the relaunch so an engine that dies on
+        # boot becomes a rate-limited retry, not a fork bomb.
+        if n < self.min_replicas and now >= self._cooldown_until:
+            self._scale_up(reason="min_replicas")
+            return
+        # a router-level shed means demand already outran the fleet —
+        # that IS sustained backlog, no hold needed
+        pressure = (wait or 0.0) > self.up_wait_s
+        if pressure:
+            if self._over_since is None:
+                self._over_since = now
+        else:
+            self._over_since = None
+        held_up = (
+            self._over_since is not None
+            and now - self._over_since >= self.hold_s
+        )
+        if (held_up or shed_delta > 0) and n < self.max_replicas:
+            if now >= self._cooldown_until:
+                self._scale_up(
+                    reason="shed" if shed_delta > 0 else "predicted_wait"
+                )
+                self._over_since = None
+            return
+        # scale down only on sustained calm, and only a MANAGED backend
+        # (static members are the operator's, not ours to kill)
+        idle = wait is not None and wait < self.down_wait_s
+        if wait is None:  # no throughput estimate: idle iff nothing queued
+            idle = all(
+                b.load_tokens == 0 and b.outstanding == 0 for b in replicas
+            )
+        if idle and n > self.min_replicas:
+            if self._under_since is None:
+                self._under_since = now
+            if (
+                now - self._under_since >= self.hold_s
+                and now >= self._cooldown_until
+            ):
+                candidates = [
+                    b for b in replicas if b.managed and b.accepting(now)
+                ]
+                if candidates:
+                    victim = min(candidates, key=lambda b: b.effective_load())
+                    self._scale_down(victim)
+                    self._under_since = None
+        else:
+            self._under_since = None
+
+    def snapshot(self) -> dict:
+        return {
+            "min_replicas": self.min_replicas,
+            "max_replicas": self.max_replicas,
+            "replicas": len(self._replicas()),
+            "scale_ups": self.scale_ups,
+            "scale_downs": self.scale_downs,
+            "draining": sorted(self._drain_started),
+            "up_wait_s": self.up_wait_s,
+            "down_wait_s": self.down_wait_s,
+        }
+
+    def close(self, *, reap_managed: bool = True) -> None:
+        """Stop scaling; optionally terminate every managed process (the
+        router owns what it launched — bench/smoke teardown)."""
+        self._closed = True
+        if not reap_managed:
+            return
+        for b in self.fleet.backends():
+            if b.managed and b.proc is not None:
+                try:
+                    self.launcher.reap(b.proc, grace_s=5.0)
+                except Exception:  # noqa: BLE001 — teardown
+                    pass
+
+
+# re-exported for the engine-cmd default (bench/smoke build their own)
+DEFAULT_ENGINE_CMD = (
+    f"{sys.executable} -m gofr_tpu.router.engine_stub "
+    "--port {port} --metrics-port {metrics_port}"
+)

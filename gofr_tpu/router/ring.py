@@ -1,0 +1,79 @@
+"""Consistent hashing for session affinity (rendezvous / HRW).
+
+The front router pins every ``X-GoFr-Session`` conversation to one
+engine process so the session's KV blocks (docs/advanced-guide/
+kv-cache.md#sessions) stay on the replica that holds them. The mapping
+must be (a) stable — the same session id always lands on the same live
+backend, with no shared state between router replicas — and (b) minimal
+under membership churn: an autoscaler adding or draining one engine
+must move only the sessions that mathematically have to move.
+
+Rendezvous (highest-random-weight) hashing gives both properties with
+no virtual-node tuning: each key ranks every member by
+``H(member, key)`` and picks the max. Removing a member moves exactly
+that member's keys (everyone else's argmax is unchanged); adding one
+moves ~``1/(n+1)`` of the keyspace. The full ranking doubles as the
+failover order — ``owners()`` yields members best-first, so "owner is
+draining" falls through deterministically instead of rehashing.
+
+O(n) per lookup over a fleet of engine processes (n is small, single
+digits to low hundreds); a ketama ring's O(log vnodes) only wins at
+cardinalities a single front router never sees.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Iterable, Iterator
+
+__all__ = ["HashRing"]
+
+
+def _score(member: str, key: str) -> int:
+    # blake2b: stable across processes/runs (hash() is salted), cheap,
+    # and 8 bytes of digest is plenty for ranking a small fleet
+    return int.from_bytes(
+        hashlib.blake2b(
+            key.encode() + b"\x00" + member.encode(), digest_size=8
+        ).digest(),
+        "big",
+    )
+
+
+class HashRing:
+    """Rendezvous-hash membership set. Not thread-safe by itself — the
+    fleet view swaps whole instances on membership change (an atomic
+    reference swap), so readers never see a half-updated ring."""
+
+    def __init__(self, members: Iterable[str] = ()):
+        self._members: tuple[str, ...] = tuple(dict.fromkeys(members))
+
+    @property
+    def members(self) -> tuple[str, ...]:
+        return self._members
+
+    def __len__(self) -> int:
+        return len(self._members)
+
+    def owner(self, key: str) -> str | None:
+        """The member owning `key`, or None on an empty ring."""
+        if not self._members:
+            return None
+        return max(self._members, key=lambda m: _score(m, key))
+
+    def owners(self, key: str) -> Iterator[str]:
+        """All members ranked best-first for `key` — the deterministic
+        fallthrough order when the owner is draining/dead."""
+        return iter(
+            sorted(self._members, key=lambda m: _score(m, key), reverse=True)
+        )
+
+    def with_member(self, member: str) -> "HashRing":
+        if member in self._members:
+            return self
+        return HashRing((*self._members, member))
+
+    def without_member(self, member: str) -> "HashRing":
+        if member not in self._members:
+            return self
+        return HashRing(m for m in self._members if m != member)

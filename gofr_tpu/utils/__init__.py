@@ -48,6 +48,17 @@ def enable_compilation_cache(directory: str | None = None, logger=None) -> None:
         # (the admission scatters compile fast but still cost a cold start)
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+        # jax initializes its cache object on the FIRST compile and never
+        # re-reads the config: if anything compiled before this call (any
+        # jax work ahead of engine init), the dir update alone is a silent
+        # no-op and every compile stays uncached. Reset so the next
+        # compile rebuilds the cache against the configured dir.
+        try:
+            from jax._src.compilation_cache import reset_cache
+
+            reset_cache()
+        except Exception:  # noqa: BLE001 — older jax: first-compile init
+            pass
         _CACHE_ENABLED = True
         if logger is not None:
             logger.debug(f"XLA persistent compilation cache at {directory}")

@@ -242,6 +242,48 @@ def test_summary_line_carries_sharded():
     assert "sharded" not in bench._summary_line(_serving_result())
 
 
+def test_summary_line_carries_scaleout():
+    """BENCH_r16+: the scale-out point (router tier over engine
+    PROCESSES) rides the summary as a compact block — per-count QPS,
+    scaling ratios vs 1 process, router-added p50 overhead, client
+    count, steady-window error total."""
+    r = {
+        "metric": "scaleout_qps", "value": 905.3,
+        "unit": "req/s (4 engine processes, 8-tok completions)",
+        "vs_baseline": 0.85,
+        "detail": {
+            "scaleout": {
+                "clients": 10000, "window_s": 8.0,
+                "points": [
+                    {"qps": 267.06, "completed": 2136, "errors": 0,
+                     "ramp_errors": 0, "window_s": 8.0, "procs": 1,
+                     "pool": {"hit": 2309.0, "dial": 1231.0}},
+                    {"qps": 540.52, "completed": 4324, "errors": 0,
+                     "ramp_errors": 0, "window_s": 8.0, "procs": 2,
+                     "pool": {"hit": 5796.0, "dial": 1100.0}},
+                    {"qps": 905.26, "completed": 7242, "errors": 0,
+                     "ramp_errors": 0, "window_s": 8.0, "procs": 4,
+                     "pool": {"hit": 9909.0, "dial": 1264.0}},
+                ],
+                "qps_scaling": {"x2": 2.02, "x4": 3.39},
+                "router_overhead_p50_ms": 1.232,
+                "direct_p50_ms": 1.04, "routed_p50_ms": 2.27,
+                "host_cores": 24,
+            },
+        },
+    }
+    s = bench._summary_line(r)
+    assert s["scaleout"] == {
+        "qps_1p": 267.06, "qps_2p": 540.52, "qps_4p": 905.26,
+        "x2": 2.02, "x4": 3.39,
+        "router_overhead_p50_ms": 1.232,
+        "clients": 10000, "errors": 0,
+    }
+    assert len(json.dumps(s)) < 1800
+    # absent block (non-scaleout runs) must not leak a key
+    assert "scaleout" not in bench._summary_line(_serving_result())
+
+
 def test_summary_line_carries_rollout():
     """BENCH_r13+: the live weight-rollout point rides the summary as a
     compact block (terminal state, error count, time-to-fully-shifted,

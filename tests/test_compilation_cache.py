@@ -1,0 +1,45 @@
+"""enable_compilation_cache must take effect even when jax has already
+compiled something in the process.
+
+jax initializes its persistent-cache object on the FIRST compile and
+ignores later `jax_compilation_cache_dir` updates — so an app that does
+any jax work before engine init (tests, notebooks, warmup probes) would
+silently lose the cache for the whole process, paying full XLA compiles
+on every restart. The helper resets the cache object after configuring;
+this pins that the reset actually lands entries on disk. Runs in a
+subprocess: the bug is per-process state that the suite's own conftest
+cache config would mask.
+"""
+
+import os
+import subprocess
+import sys
+
+
+def test_enable_after_prior_compile_writes_entries(tmp_path):
+    cache_dir = str(tmp_path / "xla")
+    prog = """
+import os
+import jax, jax.numpy as jnp
+jax.config.update("jax_platforms", "cpu")
+# something compiles BEFORE the cache is configured (the bug trigger)
+jax.jit(lambda x: x + 1)(jnp.ones(4)).block_until_ready()
+from gofr_tpu.utils import enable_compilation_cache
+enable_compilation_cache(directory=os.environ["CACHE_DIR"])
+jax.jit(lambda x: (x @ x.T).mean())(jnp.ones((32, 32))).block_until_ready()
+print(len(os.listdir(os.environ["CACHE_DIR"])))
+"""
+    env = {
+        **os.environ, "CACHE_DIR": cache_dir, "JAX_PLATFORMS": "cpu",
+        # a pre-set dir would make the helper respect it and skip the reset
+        "GOFR_XLA_CACHE_DIR": "",
+    }
+    out = subprocess.run(
+        [sys.executable, "-c", prog], env=env, capture_output=True,
+        text=True, timeout=120, cwd=os.path.dirname(os.path.dirname(__file__)),
+    )
+    assert out.returncode == 0, out.stderr
+    assert int(out.stdout.strip().splitlines()[-1]) > 0, (
+        "no cache entries written: enable_compilation_cache after a prior "
+        f"compile is a silent no-op again\n{out.stderr}"
+    )

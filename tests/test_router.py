@@ -1,0 +1,1089 @@
+"""Front-router tier (gofr_tpu/router/): consistent-hash session
+affinity, fleet view, circuit-breaker failover, streamed proxying with
+disconnect propagation, Retry-After honoring, and the autoscaler state
+machine under fake clocks (docs/advanced-guide/scale-out.md)."""
+
+import asyncio
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from gofr_tpu.app import App
+from gofr_tpu.config import new_mock_config
+from gofr_tpu.http.errors import ErrorServiceUnavailable, ErrorTooManyRequests
+from gofr_tpu.http.responder import StreamingResponse
+from gofr_tpu.router import FrontRouter, new_router_app
+from gofr_tpu.router.autoscaler import Autoscaler
+from gofr_tpu.router.fleet import FleetView
+from gofr_tpu.router.ring import HashRing
+
+
+# ---------------------------------------------------------------------------
+# hash ring
+# ---------------------------------------------------------------------------
+
+def test_ring_owner_deterministic_and_balanced():
+    ring = HashRing([f"b{i}" for i in range(4)])
+    keys = [f"session-{i}" for i in range(2000)]
+    owners = [ring.owner(k) for k in keys]
+    assert owners == [ring.owner(k) for k in keys]  # stable
+    counts = {m: owners.count(m) for m in ring.members}
+    for m, n in counts.items():
+        assert 0.5 * 500 < n < 1.5 * 500, (m, counts)  # roughly balanced
+
+
+def test_ring_removal_moves_only_the_removed_members_keys():
+    ring = HashRing(["a", "b", "c", "d"])
+    keys = [f"k{i}" for i in range(1000)]
+    before = {k: ring.owner(k) for k in keys}
+    smaller = ring.without_member("b")
+    moved = [k for k in keys if smaller.owner(k) != before[k]]
+    assert set(moved) == {k for k in keys if before[k] == "b"}
+
+
+def test_ring_addition_moves_bounded_fraction():
+    ring = HashRing(["a", "b", "c", "d"])
+    keys = [f"k{i}" for i in range(1000)]
+    before = {k: ring.owner(k) for k in keys}
+    bigger = ring.with_member("e")
+    moved = sum(1 for k in keys if bigger.owner(k) != before[k])
+    # rendezvous moves ~1/(n+1) = 20%; assert a generous bound
+    assert moved / len(keys) < 0.30
+    # and every moved key moved TO the new member
+    assert all(
+        bigger.owner(k) == "e" for k in keys if bigger.owner(k) != before[k]
+    )
+
+
+def test_ring_owners_ranking_is_the_fallthrough_order():
+    ring = HashRing(["a", "b", "c"])
+    ranked = list(ring.owners("some-session"))
+    assert ranked[0] == ring.owner("some-session")
+    assert sorted(ranked) == ["a", "b", "c"]
+    # dropping the owner promotes exactly the second-ranked member
+    assert ring.without_member(ranked[0]).owner("some-session") == ranked[1]
+
+
+# ---------------------------------------------------------------------------
+# fleet view + routing policy (fake backends, no sockets)
+# ---------------------------------------------------------------------------
+
+class _FakeService:
+    """Stands in for HTTPService in FleetView/autoscaler unit tests."""
+
+    def __init__(self, address):
+        self.address = address
+        self.circuit = None
+        self.serving = {"load_tokens": 0, "throughput_tok_s": None,
+                        "predicted_wait_s": None, "draining": False}
+        self.requests = []
+        self.fail = False
+
+    def request(self, method, path, **kw):
+        self.requests.append((method, path))
+        if self.fail:
+            raise ConnectionError("down")
+        serving = self.serving
+
+        class R:
+            status_code = 200
+
+            @staticmethod
+            def json():
+                return {"data": {"serving": serving}}
+
+        return R()
+
+    def pool_stats(self):
+        return {"idle": 0, "hits": 0, "dials": 0}
+
+    def close(self):
+        pass
+
+
+def _fake_fleet(n=2, **kw):
+    fleet = FleetView(service_factory=_FakeService, poll_interval_s=0.05, **kw)
+    for i in range(n):
+        fleet.add(f"http://b{i}")
+    return fleet
+
+
+def test_fleet_poll_reads_serving_block_and_builds_ring():
+    fleet = _fake_fleet(2)
+    fleet.get("http://b0").svc.serving.update(
+        load_tokens=128, throughput_tok_s=64.0
+    )
+    fleet.poll_once()
+    assert sorted(fleet.ring.members) == ["http://b0", "http://b1"]
+    b0 = fleet.get("http://b0")
+    assert b0.alive and b0.accepting()
+    assert b0.load_tokens == 128
+    assert fleet.pooled_predicted_wait_s() == pytest.approx(2.0)
+
+
+def test_fleet_draining_backend_leaves_ring_and_its_sessions_rehome():
+    fleet = _fake_fleet(3)
+    fleet.poll_once()
+    epoch = fleet.ring_epoch()
+    keys = [f"s{i}" for i in range(300)]
+    before = {k: fleet.ring.owner(k) for k in keys}
+    victim = fleet.ring.owner("s0")
+    fleet.get(victim).svc.serving["draining"] = True  # drain began
+    fleet.poll_once()
+    assert fleet.ring_epoch() == epoch + 1
+    assert victim not in fleet.ring.members
+    moved = [k for k in keys if fleet.ring.owner(k) != before[k]]
+    assert set(moved) == {k for k in keys if before[k] == victim}
+
+
+def test_fleet_dead_backend_marked_down_after_consecutive_failures():
+    fleet = _fake_fleet(2)
+    fleet.poll_once()
+    fleet.get("http://b1").svc.fail = True
+    fleet.poll_once()
+    b1 = fleet.get("http://b1")
+    # ONE slow/failed poll must not flap a serving backend out of the
+    # ring (a saturated engine answers its poll late, not never)
+    assert b1.alive and b1.accepting()
+    fleet.poll_once()
+    assert not b1.alive and not b1.accepting()
+    assert fleet.ring.members == ("http://b0",)
+    # recovery: one good poll brings it straight back
+    b1.svc.fail = False
+    fleet.poll_once()
+    assert b1.alive and fleet.ring.members == ("http://b0", "http://b1")
+
+
+def _front_router(cfg_map=None, n_backends=2):
+    cfg = new_mock_config({
+        "TPU_ROUTER_POLL_INTERVAL_S": "30", **(cfg_map or {})
+    })
+    fr = FrontRouter(cfg, service_factory=_FakeService)
+    for i in range(n_backends):
+        fr.fleet.add(f"http://b{i}")
+    fr.fleet.poll_once()
+    return fr
+
+
+def test_pick_prefers_ring_owner_then_falls_through():
+    fr = _front_router()
+    owner = fr.fleet.ring.owner("sess-42")
+    b, result = fr.pick("sess-42", set())
+    assert b.address == owner and result == "hit"
+    # owner draining -> deterministic fallthrough to the next-ranked
+    fr.fleet.get(owner).draining = True
+    b2, result2 = fr.pick("sess-42", set())
+    assert b2.address != owner and result2 == "fallthrough"
+    # no session routes least-loaded by queued tokens
+    fr.fleet.get(owner).draining = False
+    fr.fleet.get("http://b0").load_tokens = 500
+    fr.fleet.get("http://b1").load_tokens = 5
+    b3, result3 = fr.pick("", set())
+    assert b3.address == "http://b1" and result3 == "none"
+
+
+def test_pick_charges_outstanding_between_polls():
+    fr = _front_router()
+    fr.fleet.get("http://b0").load_tokens = 0
+    fr.fleet.get("http://b1").load_tokens = 0
+    fr.fleet.get("http://b0").outstanding = 10  # dispatched, not yet polled
+    b, _ = fr.pick("", set())
+    assert b.address == "http://b1"
+
+
+# ---------------------------------------------------------------------------
+# autoscaler (fake clock, fake launcher, fake processes)
+# ---------------------------------------------------------------------------
+
+class _FakeProc:
+    def __init__(self):
+        self.exited = False
+        self.terminated = False
+
+    def poll(self):
+        return 0 if self.exited else None
+
+
+class _FakeLauncher:
+    def __init__(self):
+        self.launched = []
+        self.reaped = []
+
+    def launch(self):
+        proc = _FakeProc()
+        addr = f"http://scaled{len(self.launched)}"
+        self.launched.append((addr, proc))
+        return addr, proc
+
+    def reap(self, proc, **kw):
+        proc.terminated = True
+        self.reaped.append(proc)
+
+
+class _Clock:
+    def __init__(self):
+        self.t = 1000.0
+
+    def __call__(self):
+        return self.t
+
+
+def _scaler(fleet, clock, launcher=None, **kw):
+    kw.setdefault("min_replicas", 1)
+    kw.setdefault("max_replicas", 3)
+    kw.setdefault("up_wait_s", 2.0)
+    kw.setdefault("down_wait_s", 0.25)
+    kw.setdefault("hold_s", 3.0)
+    kw.setdefault("cooldown_s", 5.0)
+    return Autoscaler(
+        fleet, launcher or _FakeLauncher(), now_fn=clock, **kw
+    )
+
+
+def _pressure(fleet, wait_s):
+    """Make the pooled predicted wait read `wait_s` on every backend."""
+    for b in fleet.backends():
+        b.alive = True
+        b.load_tokens = int(100 * wait_s)
+        b.throughput_tok_s = 100.0
+
+
+def test_autoscaler_scales_up_on_sustained_backlog_only():
+    clock = _Clock()
+    fleet = _fake_fleet(1, now_fn=clock)
+    fleet.poll_once()
+    launcher = _FakeLauncher()
+    sc = _scaler(fleet, clock, launcher)
+    _pressure(fleet, 10.0)
+    sc.tick()  # starts the hold window
+    assert launcher.launched == []  # a spike must not scale
+    clock.t += 1.0
+    sc.tick()
+    assert launcher.launched == []
+    clock.t += 2.5  # hold (3 s) elapsed
+    sc.tick()
+    assert len(launcher.launched) == 1
+    # cooldown: pressure still high, but no immediate second launch
+    clock.t += 3.1
+    sc.tick()
+    clock.t += 3.1  # hold satisfied again but cooldown (5 s) not elapsed
+    assert len(launcher.launched) == 1
+    clock.t += 5.0
+    sc.tick()
+    clock.t += 3.1
+    sc.tick()
+    assert len(launcher.launched) == 2
+
+
+def test_autoscaler_shed_signal_scales_up_without_hold():
+    clock = _Clock()
+    fleet = _fake_fleet(1, now_fn=clock)
+    fleet.poll_once()
+    launcher = _FakeLauncher()
+    sheds = {"n": 0}
+    sc = _scaler(fleet, clock, launcher, shed_count_fn=lambda: sheds["n"])
+    sc.tick()
+    assert launcher.launched == []
+    sheds["n"] = 3  # the router shed: demand already outran the fleet
+    sc.tick()
+    assert len(launcher.launched) == 1
+
+
+def test_autoscaler_respects_max_and_min_bounds():
+    clock = _Clock()
+    fleet = _fake_fleet(1, now_fn=clock)
+    fleet.poll_once()
+    launcher = _FakeLauncher()
+    sc = _scaler(fleet, clock, launcher, max_replicas=2, cooldown_s=0.0,
+                 hold_s=0.0)
+    _pressure(fleet, 10.0)
+    for _ in range(5):
+        sc.tick()
+        fleet.poll_once()
+        _pressure(fleet, 10.0)
+        clock.t += 1.0
+    assert len(launcher.launched) == 1  # 1 static + 1 launched = max 2
+    # idle: scale down, but never below min (static b0 is not managed)
+    _pressure(fleet, 0.0)
+    for b in fleet.backends():
+        b.load_tokens = 0
+        b.throughput_tok_s = 100.0
+    for _ in range(5):
+        sc.tick()
+        clock.t += 1.0
+    # one managed backend drained; the static backend survives at min=1
+    draining = [b for b in fleet.backends() if b.draining]
+    assert len(draining) == 1 and draining[0].managed
+
+
+def test_autoscaler_drain_is_graceful_zero_dropped_streams():
+    """The drained backend keeps its in-flight stream: it is removed
+    from the ring immediately but only REAPED once its process exits
+    (the engine's own drain finishes streams first)."""
+    clock = _Clock()
+    fleet = _fake_fleet(1, now_fn=clock)
+    fleet.poll_once()
+    launcher = _FakeLauncher()
+    sc = _scaler(fleet, clock, launcher, min_replicas=0, hold_s=0.0,
+                 cooldown_s=0.0, drain_grace_s=60.0)
+    # launch one managed backend, then go idle
+    sheds = [0]
+    addr, proc = launcher.launch()
+    fleet.add(addr, managed=True, proc=proc)
+    b = fleet.get(addr)
+    b.alive = True
+    b.throughput_tok_s = 100.0
+    fleet._rebuild_ring()
+    assert addr in fleet.ring.members
+    sc.tick()  # idle -> drains the managed backend
+    assert b.draining
+    assert addr not in fleet.ring.members  # new sessions re-home NOW
+    # the POST rides a daemon thread (tick must not block on a wedged
+    # victim) — wait for it to land
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline and not any(
+        p.endswith("/drain") for (_m, p) in b.svc.requests
+    ):
+        time.sleep(0.01)
+    assert any(
+        p.endswith("/drain") for (_m, p) in b.svc.requests
+    ), "drain POST not sent"
+    # stream still running (process alive): must NOT be reaped
+    clock.t += 10.0
+    sc.tick()
+    assert not proc.terminated and fleet.get(addr) is not None
+    # stream done; engine app exits on its own
+    proc.exited = True
+    sc.tick()
+    assert fleet.get(addr) is None  # removed only after a clean exit
+    assert sheds == [0]
+
+
+def test_failed_drain_post_does_not_void_scale_down():
+    """The drain POST can be lost (5 s timeout against a saturated
+    engine). The scale-down must survive: the local drain intent is
+    sticky, so the next poll — which reads draining=False from the
+    backend's own summary — must not put the victim back in the ring
+    and strand the _drain_started entry; the grace reap bounds it."""
+    clock = _Clock()
+    fleet = _fake_fleet(2, now_fn=clock)
+    fleet.poll_once()
+    for b in fleet.backends():
+        b.managed = True
+        b.proc = _FakeProc()
+        b.load_tokens = 0
+        b.throughput_tok_s = 100.0
+        orig = b.svc.request
+
+        def failing(method, path, _orig=orig, **kw):
+            if path.endswith("/drain"):
+                raise TimeoutError("drain POST lost")
+            return _orig(method, path, **kw)
+
+        b.svc.request = failing
+    launcher = _FakeLauncher()
+    sc = _scaler(fleet, clock, launcher, min_replicas=1, cooldown_s=0.0,
+                 hold_s=0.0, drain_grace_s=30.0)
+    sc.tick()  # idle fleet above min: drains one victim (POST is lost)
+    draining = [b for b in fleet.backends() if b.draining]
+    assert len(draining) == 1
+    victim = draining[0]
+    fleet.poll_once()  # backend still reports draining=False
+    assert victim.draining, "lost drain POST voided the scale-down"
+    assert victim.address not in fleet.ring.members
+    clock.t += 31.0  # grace elapses: the wedge is bounded
+    sc.tick()
+    assert fleet.get(victim.address) is None
+    assert victim.proc.terminated
+
+
+def test_autoscaler_replaces_crashed_engine_and_reaps_corpse():
+    """A managed engine that dies WITHOUT a drain (OOM-kill, segfault)
+    must not sit in the fleet as a corpse: it would count toward the
+    replica bounds while serving nothing, and min_replicas would never
+    re-launch. The crash-reap removes it and the floor replaces it."""
+    clock = _Clock()
+    fleet = _fake_fleet(0, now_fn=clock)
+    launcher = _FakeLauncher()
+    sc = _scaler(fleet, clock, launcher, min_replicas=1, cooldown_s=0.0)
+    sc.ensure_min()
+    fleet.poll_once()
+    assert len(launcher.launched) == 1
+    addr, proc = launcher.launched[0]
+    proc.exited = True  # crashed, never draining
+    sc.tick()
+    assert fleet.get(addr) is None, "corpse left in the fleet"
+    assert len(launcher.launched) == 2, "min floor did not replace it"
+    replacement = fleet.get(launcher.launched[1][0])
+    assert replacement is not None and replacement.managed
+
+
+def test_autoscaler_min_floor_relaunch_respects_cooldown():
+    """An engine that dies on boot becomes a rate-limited retry, not a
+    fork bomb: the floor relaunches at most once per cooldown window."""
+    clock = _Clock()
+    fleet = _fake_fleet(0, now_fn=clock)
+    launcher = _FakeLauncher()
+    sc = _scaler(fleet, clock, launcher, min_replicas=1, cooldown_s=5.0)
+    sc.ensure_min()
+    assert len(launcher.launched) == 1
+    for _ in range(4):  # crash-loop inside one cooldown window
+        launcher.launched[-1][1].exited = True
+        sc.tick()
+        clock.t += 1.0
+    # 1 initial + at most 1 relaunch per elapsed 5 s cooldown
+    assert len(launcher.launched) <= 2
+
+
+def test_unreachable_mid_drain_waits_out_the_grace():
+    """A draining engine busy finishing its last long streams can miss
+    fleet polls and get marked down — that is saturation, not death,
+    and reaping on it would kill exactly the streams the drain exists
+    to protect. Only process exit or the grace window reaps."""
+    clock = _Clock()
+    fleet = _fake_fleet(0, now_fn=clock)
+    launcher = _FakeLauncher()
+    sc = _scaler(fleet, clock, launcher, min_replicas=0, hold_s=0.0,
+                 cooldown_s=0.0, drain_grace_s=30.0)
+    addr, proc = launcher.launch()
+    fleet.add(addr, managed=True, proc=proc)
+    b = fleet.get(addr)
+    b.alive, b.throughput_tok_s = True, 100.0
+    sc.tick()
+    assert b.draining
+    b.alive = False  # missed polls while finishing in-flight streams
+    clock.t += 5.0
+    sc.tick()
+    assert not proc.terminated and fleet.get(addr) is not None, (
+        "unreachable-mid-drain was reaped before the grace window"
+    )
+    clock.t += 26.0  # grace elapses: the wedge is bounded as before
+    sc.tick()
+    assert proc.terminated and fleet.get(addr) is None
+
+
+def test_autoscaler_reaps_wedged_drain_after_grace():
+    clock = _Clock()
+    fleet = _fake_fleet(0, now_fn=clock)
+    launcher = _FakeLauncher()
+    sc = _scaler(fleet, clock, launcher, min_replicas=0, hold_s=0.0,
+                 cooldown_s=0.0, drain_grace_s=30.0)
+    addr, proc = launcher.launch()
+    fleet.add(addr, managed=True, proc=proc)
+    b = fleet.get(addr)
+    b.alive, b.throughput_tok_s = True, 100.0
+    sc.tick()
+    assert b.draining
+    clock.t += 31.0
+    sc.tick()
+    assert proc.terminated and fleet.get(addr) is None
+
+
+# ---------------------------------------------------------------------------
+# real-socket proxy behavior
+# ---------------------------------------------------------------------------
+
+def _backend_app(name, handlers=None):
+    app = App(config=new_mock_config({
+        "APP_NAME": name, "HTTP_PORT": "0", "METRICS_PORT": "0",
+        "LOG_LEVEL": "ERROR", "REQUEST_TIMEOUT": "30",
+    }))
+    state = {"requests": 0}
+
+    def who(ctx):
+        state["requests"] += 1
+        return {
+            "name": name,
+            "headers": {
+                k: v for k, v in ctx.request.headers.items()
+                if k.startswith("x-") or k == "traceparent"
+            },
+        }
+
+    app.post("/who", who)
+    app.get("/who", who)
+    for path, h in (handlers or {}).items():
+        app.post(path, h)
+    app.state = state
+    app.run_in_background()
+    return app
+
+
+def _router_for(backends, extra_cfg=None):
+    app = new_router_app(config=new_mock_config({
+        "APP_NAME": "router", "HTTP_PORT": "0", "METRICS_PORT": "0",
+        "LOG_LEVEL": "ERROR", "REQUEST_TIMEOUT": "30",
+        "TPU_ROUTER_BACKENDS": ",".join(
+            f"http://127.0.0.1:{b.http_server.port}" for b in backends
+        ),
+        "TPU_ROUTER_POLL_INTERVAL_S": "0.1",
+        "TPU_ROUTER_BREAKER_INTERVAL_S": "0.2",
+        **(extra_cfg or {}),
+    }))
+    app.run_in_background()
+    return app
+
+
+def _request(app, path, payload=None, headers=None, method=None, timeout=15):
+    data = json.dumps(payload).encode() if payload is not None else None
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{app.http_server.port}{path}", data=data,
+        headers={"Content-Type": "application/json", **(headers or {})},
+        method=method or ("POST" if data is not None else "GET"),
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return resp.status, dict(resp.headers), resp.read()
+
+
+def _wait_accepting(router_app, n, timeout=10):
+    fr = router_app.front_router
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if len(fr.fleet.accepting()) == n:
+            return
+        time.sleep(0.03)
+    raise AssertionError(
+        f"fleet never reached {n} accepting backends: "
+        f"{[b.snapshot() for b in fr.fleet.backends()]}"
+    )
+
+
+@pytest.fixture
+def duo():
+    b1 = _backend_app("b1")
+    b2 = _backend_app("b2")
+    router = _router_for([b1, b2])
+    try:
+        _wait_accepting(router, 2)
+        yield router, b1, b2
+    finally:
+        router.shutdown()
+        b1.shutdown()
+        b2.shutdown()
+        time.sleep(0.1)
+
+
+def test_proxy_forwards_identity_and_trace_headers(duo):
+    router, b1, b2 = duo
+    tp = "00-" + "a" * 32 + "-" + "b" * 16 + "-01"
+    _st, _h, body = _request(router, "/who", {}, {
+        "traceparent": tp, "X-GoFr-Priority": "batch",
+        "X-GoFr-Session": "conv-1", "X-GoFr-Client": "tenant-7",
+    })
+    seen = json.loads(body)["data"]["headers"]
+    assert seen["x-gofr-priority"] == "batch"
+    assert seen["x-gofr-session"] == "conv-1"
+    assert seen["x-gofr-client"] == "tenant-7"  # end client, not the router
+    assert seen["x-forwarded-for"].startswith("127.0.0.1")
+    # traceparent is re-stamped to the router.proxy span: SAME trace id,
+    # a NEW span id (the backend's spans parent under the hop)
+    assert seen["traceparent"].startswith("00-" + "a" * 32 + "-")
+    assert "b" * 16 not in seen["traceparent"]
+
+
+def test_proxy_synthesizes_client_identity_when_absent(duo):
+    router, *_ = duo
+    _st, _h, body = _request(router, "/who", {})
+    seen = json.loads(body)["data"]["headers"]
+    assert seen["x-gofr-client"]  # FairLedger sees the end client
+
+
+def test_session_affinity_pins_and_spreads(duo):
+    router, b1, b2 = duo
+    hit = {}
+    for sid in range(12):
+        names = {
+            json.loads(
+                _request(router, "/who", {}, {"X-GoFr-Session": f"s{sid}"})[2]
+            )["data"]["name"]
+            for _ in range(5)
+        }
+        assert len(names) == 1, f"session s{sid} split across {names}"
+        hit[f"s{sid}"] = names.pop()
+    assert set(hit.values()) == {"b1", "b2"}  # sessions spread over both
+
+
+def test_streamed_proxy_byte_identity_and_pool_reuse(duo):
+    router, b1, b2 = duo
+
+    async def stream(ctx):
+        async def chunks():
+            for i in range(8):
+                yield f"chunk-{i}|".encode()
+                await asyncio.sleep(0.005)
+
+        return StreamingResponse(chunks(), content_type="text/plain")
+
+    # register on a fresh backend (routes are frozen after serve)
+    b3 = _backend_app("b3", handlers={"/chunks": stream})
+    router3 = _router_for([b3])
+    try:
+        _wait_accepting(router3, 1)
+        _st, _h, direct = _request(b3, "/chunks", {})
+        for _ in range(3):
+            _st, headers, via = _request(router3, "/chunks", {})
+            assert via == direct
+        assert headers["Content-Type"] == "text/plain"
+        stats = router3.front_router.fleet.get(
+            f"http://127.0.0.1:{b3.http_server.port}"
+        ).svc.pool_stats()
+        assert stats["hits"] > 0, f"streaming path never reused: {stats}"
+    finally:
+        router3.shutdown()
+        b3.shutdown()
+
+
+def test_client_disconnect_propagates_across_the_hop():
+    closed = threading.Event()
+
+    async def endless(ctx):
+        async def chunks():
+            try:
+                while True:
+                    yield b"tok\n"
+                    await asyncio.sleep(0.02)
+            finally:
+                closed.set()  # the backend generator was cancelled
+
+        return StreamingResponse(chunks(), content_type="text/plain")
+
+    b = _backend_app("bs", handlers={"/endless": endless})
+    router = _router_for([b])
+    try:
+        _wait_accepting(router, 1)
+        import socket
+
+        body = b"{}"
+        s = socket.create_connection(
+            ("127.0.0.1", router.http_server.port), timeout=10
+        )
+        s.sendall(
+            b"POST /endless HTTP/1.1\r\nHost: x\r\n"
+            b"Content-Type: application/json\r\n"
+            b"Content-Length: %d\r\n\r\n%s" % (len(body), body)
+        )
+        assert s.recv(4096)  # headers + first chunks flowing
+        time.sleep(0.1)
+        s.close()  # client walks away mid-stream
+        assert closed.wait(timeout=10), (
+            "backend stream was not cancelled after client disconnect"
+        )
+    finally:
+        router.shutdown()
+        b.shutdown()
+
+
+def test_max_inflight_cap_covers_streams_and_releases_slots():
+    """TPU_ROUTER_MAX_INFLIGHT bounds STREAMED proxies too: the slot is
+    held until the body completes, released even when the client
+    disconnects mid-stream (and disconnect still cancels upstream)."""
+    closed = threading.Event()
+
+    async def short(ctx):
+        async def chunks():
+            for _ in range(3):
+                yield b"x" * 8
+                await asyncio.sleep(0.01)
+
+        return StreamingResponse(chunks(), content_type="text/plain")
+
+    async def endless(ctx):
+        async def chunks():
+            try:
+                while True:
+                    yield b"tok\n"
+                    await asyncio.sleep(0.02)
+            finally:
+                closed.set()
+
+        return StreamingResponse(chunks(), content_type="text/plain")
+
+    b = _backend_app("bcap", handlers={"/short": short, "/endless": endless})
+    router = _router_for([b], extra_cfg={"TPU_ROUTER_MAX_INFLIGHT": "2"})
+    try:
+        _wait_accepting(router, 1)
+        # leaked slots would wedge the 3rd+ request behind the cap of 2
+        for _ in range(6):
+            _st, _h, body = _request(router, "/short", {})
+            assert body == b"x" * 24
+        # disconnect mid-stream: slot released AND upstream cancelled
+        import socket
+
+        payload = b"{}"
+        s = socket.create_connection(
+            ("127.0.0.1", router.http_server.port), timeout=10
+        )
+        s.sendall(
+            b"POST /endless HTTP/1.1\r\nHost: x\r\n"
+            b"Content-Type: application/json\r\n"
+            b"Content-Length: %d\r\n\r\n%s" % (len(payload), payload)
+        )
+        assert s.recv(1024)
+        s.close()
+        assert closed.wait(timeout=10), "disconnect did not cancel upstream"
+        for _ in range(3):  # the cap still has both slots
+            _st, _h, body = _request(router, "/short", {})
+            assert body == b"x" * 24
+    finally:
+        router.shutdown()
+        b.shutdown()
+
+
+def test_guarded_stream_cleanup_runs_even_when_never_started():
+    """The proxy parks real teardown in its body stream — upstream
+    socket abort + outstanding decrement, and the in-flight-cap slot.
+    A client that vanishes before the server writes headers closes the
+    stream UN-STARTED, where an async generator's finally never runs
+    (the leak: engine decodes an abandoned request to completion,
+    permits ratchet to zero). The wrapper's cleanup must fire anyway —
+    pinned here with a REAL asyncgen inner whose finally provably does
+    NOT run, so only the wrapper stands between disconnect and leak."""
+    from gofr_tpu.router import _GuardedStream
+
+    cleaned = []
+    inner_finally = []
+
+    async def inner():
+        try:
+            yield b"x"
+        finally:
+            inner_finally.append(1)
+
+    async def cleanup():
+        cleaned.append(1)
+
+    gs = _GuardedStream(inner(), cleanup)
+    asyncio.run(gs.aclose())  # never started
+    assert inner_finally == [], "asyncgen finally ran un-started??"
+    assert cleaned == [1], "cleanup skipped for an un-started stream"
+    asyncio.run(gs.aclose())  # idempotent: one slot, one release
+    assert cleaned == [1]
+
+
+def test_guarded_stream_cleanup_runs_on_exhaustion():
+    from gofr_tpu.router import _GuardedStream
+
+    cleaned = []
+
+    async def three():
+        for _ in range(3):
+            yield b"c"
+
+    async def cleanup():
+        cleaned.append(1)
+
+    async def run():
+        gs = _GuardedStream(three(), cleanup)
+        return [c async for c in gs]
+
+    assert asyncio.run(run()) == [b"c"] * 3
+    assert cleaned == [1]
+
+
+def test_proxy_metric_path_label_is_bounded(duo):
+    """The proxied target is client-controlled: recording it as an
+    app_http_service_response label would mint a new series per unique
+    URL+query (unbounded registry growth any scanner can drive). The
+    router observes the hop under a fixed label instead."""
+    router, b1, b2 = duo
+    for q in ("alpha", "beta", "gamma"):
+        _request(router, f"/who?scan={q}", {})
+    text = router.front_router.metrics.render_prometheus()
+    assert 'path="proxy"' in text
+    assert "scan=" not in text
+
+
+def test_backend_429_retry_after_is_surfaced_not_retried():
+    def shed(ctx):
+        raise ErrorTooManyRequests("engine saturated", retry_after=7.0)
+
+    b1 = _backend_app("b1", handlers={"/gen": shed})
+    b2 = _backend_app("b2", handlers={"/gen": shed})
+    router = _router_for([b1, b2])
+    try:
+        _wait_accepting(router, 2)
+        before = b1.state["requests"] + b2.state["requests"]
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _request(router, "/gen", {})
+        assert ei.value.code == 429
+        assert ei.value.headers["Retry-After"] == "7"
+        # the backend priced its own backoff: no second dispatch burned
+        assert b1.state["requests"] + b2.state["requests"] == before
+        assert router.front_router.retries == 0
+    finally:
+        router.shutdown()
+        b1.shutdown()
+        b2.shutdown()
+
+
+def test_upstream_timeout_surfaces_without_redispatch():
+    # a slow backend is not a dead one: the request may still be running
+    # there, so a cross-backend retry would execute it twice — the router
+    # must surface the timeout instead of burning retry budget
+    hits = {"n": 0}
+
+    def slow(ctx):
+        hits["n"] += 1
+        time.sleep(3.0)
+        return {"name": "late"}
+
+    b1 = _backend_app("b1", handlers={"/gen": slow})
+    b2 = _backend_app("b2", handlers={"/gen": slow})
+    router = _router_for(
+        [b1, b2], extra_cfg={"TPU_ROUTER_UPSTREAM_TIMEOUT_S": "1.0"},
+    )
+    try:
+        _wait_accepting(router, 2)
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _request(router, "/gen", {})
+        assert ei.value.code == 503
+        assert b"timed out" in ei.value.read()
+        assert hits["n"] == 1  # exactly one dispatch — no double execution
+        assert router.front_router.retries == 0
+    finally:
+        router.shutdown()
+        b1.shutdown()
+        b2.shutdown()
+
+
+def test_backend_5xx_redispatches_to_survivor():
+    def boom(ctx):
+        raise RuntimeError("device exploded")  # -> 500 envelope
+
+    def ok(ctx):
+        return {"name": "ok"}
+
+    b1 = _backend_app("b1", handlers={"/gen": boom})
+    b2 = _backend_app("b2", handlers={"/gen": ok})
+    router = _router_for([b1, b2])
+    try:
+        _wait_accepting(router, 2)
+        # whichever backend is hit first, the answer is the healthy one
+        for _ in range(4):
+            _st, _h, body = _request(router, "/gen", {})
+            assert json.loads(body)["data"]["name"] == "ok"
+    finally:
+        router.shutdown()
+        b1.shutdown()
+        b2.shutdown()
+
+
+def test_backend_503_falls_through_then_surfaces_retry_after():
+    def draining(ctx):
+        raise ErrorServiceUnavailable("draining", retry_after=5.0)
+
+    def ok(ctx):
+        return {"name": "ok"}
+
+    b1 = _backend_app("b1", handlers={"/gen": draining})
+    b2 = _backend_app("b2", handlers={"/gen": ok})
+    router = _router_for([b1, b2])
+    try:
+        _wait_accepting(router, 2)
+        for _ in range(4):  # a draining backend never surfaces while a
+            _st, _h, body = _request(router, "/gen", {})  # survivor accepts
+            assert json.loads(body)["data"]["name"] == "ok"
+    finally:
+        router.shutdown()
+        b1.shutdown()
+        b2.shutdown()
+    # all backends 503 -> surface the ORIGINAL Retry-After
+    b3 = _backend_app("b3", handlers={"/gen": draining})
+    router2 = _router_for([b3])
+    try:
+        _wait_accepting(router2, 1)
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _request(router2, "/gen", {})
+        assert ei.value.code == 503
+        assert ei.value.headers["Retry-After"] == "5"
+    finally:
+        router2.shutdown()
+        b3.shutdown()
+
+
+def test_killed_backend_breaker_opens_and_traffic_converges():
+    b1 = _backend_app("b1")
+    b2 = _backend_app("b2")
+    router = _router_for([b1, b2])
+    try:
+        _wait_accepting(router, 2)
+        b1.shutdown()  # backend dies without deregistering
+        time.sleep(0.2)
+        # every request keeps answering 200 off the survivor
+        for _ in range(8):
+            _st, _h, body = _request(router, "/who", {})
+            assert json.loads(body)["data"]["name"] == "b2"
+        fr = router.front_router
+        deadline = time.monotonic() + 5
+        addr1 = f"http://127.0.0.1:{b1.http_server.port}"
+        while time.monotonic() < deadline:
+            if not fr.fleet.get(addr1).accepting():
+                break
+            time.sleep(0.05)
+        assert not fr.fleet.get(addr1).accepting()
+        # the fleet view converged: the ring is the survivor alone
+        _wait_accepting(router, 1)
+        assert fr.fleet.ring.members == (
+            f"http://127.0.0.1:{b2.http_server.port}",
+        )
+    finally:
+        router.shutdown()
+        b2.shutdown()
+
+
+def test_router_fleet_admission_sheds_with_priced_retry_after():
+    b1 = _backend_app("b1")
+    router = _router_for([b1], extra_cfg={
+        "TPU_ROUTER_SHED_WAIT_S": "1.0",
+        # freeze the poll so the fabricated backlog below isn't overwritten
+        "TPU_ROUTER_POLL_INTERVAL_S": "60",
+    })
+    try:
+        _wait_accepting(router, 1)
+        b = router.front_router.fleet.backends()[0]
+        b.load_tokens, b.throughput_tok_s = 10_000, 100.0  # wait = 100 s
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _request(router, "/who", {})
+        assert ei.value.code == 429
+        # Retry-After = excess over the threshold at pooled throughput
+        assert 90 <= float(ei.value.headers["Retry-After"]) <= 100
+        assert router.front_router.sheds == 1
+        b.load_tokens = 0  # backlog drained -> admission reopens
+        _st, _h, _body = _request(router, "/who", {})
+        assert _st in (200, 201)
+    finally:
+        router.shutdown()
+        b1.shutdown()
+
+
+def test_router_debug_route_and_serving_summary_shape():
+    b1 = _backend_app("b1")
+    router = _router_for([b1])
+    try:
+        _wait_accepting(router, 1)
+        _st, _h, body = _request(router, "/.well-known/router")
+        snap = json.loads(body)["data"]
+        assert snap["fleet"]["ring"] == [
+            f"http://127.0.0.1:{b1.http_server.port}"
+        ]
+        assert snap["fleet"]["backends"][0]["accepting"] is True
+        assert "retry_budget_remaining" in snap
+        # engine-less backend: the serving summary still reports the
+        # process drain flag and zero load (every App is routable)
+        _st, _h, body = _request(
+            b1, "/.well-known/debug/engine?serving=1"
+        )
+        serving = json.loads(body)["data"]["serving"]
+        assert serving["draining"] is False
+        assert serving["load_tokens"] == 0
+    finally:
+        router.shutdown()
+        b1.shutdown()
+
+
+def test_router_over_real_engines_affinity_and_serving_block():
+    """Two real tiny-model engine apps behind the router: bodies are
+    byte-identical to direct access, a session's second turn lands on
+    the same backend, and the fleet view reads the engines' serving
+    summaries (load/throughput) off the wire."""
+    import jax
+
+    from gofr_tpu.handler import llm_request_kwargs
+    from gofr_tpu.models import TransformerConfig, init_params
+
+    cfg = TransformerConfig.tiny()
+    params = init_params(jax.random.PRNGKey(0), cfg)
+
+    def engine_app(name):
+        app = App(config=new_mock_config({
+            "APP_NAME": name, "HTTP_PORT": "0", "METRICS_PORT": "0",
+            "LOG_LEVEL": "ERROR", "TPU_TELEMETRY_INTERVAL_S": "0",
+            "REQUEST_TIMEOUT": "60",
+        }))
+        app.container.tpu().register_llm(
+            "tiny", cfg, params, slots=2, max_seq_len=64,
+            prefill_buckets=(8,), warmup=False, session_mb=4,
+        )
+
+        def gen(ctx):
+            body = ctx.bind()
+            out = ctx.tpu().llm("tiny").generate(
+                list(body["tokens"]),
+                max_new_tokens=int(body.get("max_new_tokens", 6)),
+                **llm_request_kwargs(ctx),
+            )
+            return {"tokens": out, "backend": name}
+
+        app.post("/generate", gen)
+        app.run_in_background()
+        return app
+
+    e1 = engine_app("e1")
+    e2 = engine_app("e2")
+    router = _router_for([e1, e2])
+    try:
+        _wait_accepting(router, 2)
+        prompt = {"tokens": list(range(1, 9)), "max_new_tokens": 6}
+        _st, _h, direct = _request(e1, "/generate", prompt, timeout=60)
+        _st, _h, via = _request(router, "/generate", prompt, timeout=60)
+        assert (
+            json.loads(via)["data"]["tokens"]
+            == json.loads(direct)["data"]["tokens"]
+        )
+        # session affinity: every turn of one conversation, same backend
+        turns = [
+            json.loads(_request(
+                router, "/generate", prompt,
+                {"X-GoFr-Session": "conv-A"}, timeout=60,
+            )[2])["data"]["backend"]
+            for _ in range(4)
+        ]
+        assert len(set(turns)) == 1, turns
+        # the poll picked up the engines' serving blocks
+        fr = router.front_router
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if all(
+                b.throughput_tok_s for b in fr.fleet.backends()
+                if b.address.endswith(str(e1.http_server.port))
+            ):
+                break
+            time.sleep(0.1)
+        b1 = fr.fleet.get(f"http://127.0.0.1:{e1.http_server.port}")
+        assert b1.throughput_tok_s and b1.throughput_tok_s > 0
+        assert isinstance(b1.load_tokens, int)
+    finally:
+        router.shutdown()
+        e1.shutdown()
+        e2.shutdown()
+
+
+def test_serving_summary_pools_engines():
+    from gofr_tpu.handler import _serving_summary
+
+    class Eng:
+        def __init__(self, load, tput):
+            self._l, self._t = load, tput
+
+        def load_tokens(self):
+            return self._l
+
+        def throughput_tok_s(self):
+            return self._t
+
+        def predicted_wait_s(self):
+            return self._l / self._t if self._t else None
+
+    class C:
+        draining = False
+
+    out = _serving_summary(C(), {"a": Eng(100, 50.0), "b": Eng(50, 25.0)})
+    assert out["load_tokens"] == 150
+    assert out["throughput_tok_s"] == pytest.approx(75.0)
+    assert out["predicted_wait_s"] == pytest.approx(2.0)
+    assert out["models"]["a"]["predicted_wait_s"] == pytest.approx(2.0)
+    assert out["draining"] is False
