@@ -161,6 +161,7 @@ def build_engine(app):
     # _topology_kw; docs/advanced-guide/sharded-serving.md). Unset with
     # >1 devices keeps one engine TP across the whole slice.
     kw = _topology_kw(cfg)
+    build_engine.cfg = cfg  # build_app reads vocab for the byte fallback
     app.container.tpu().register_llm(
         "gemma", cfg, params,
         slots=int(os.environ.get("LLM_SLOTS", "4")),
@@ -267,6 +268,21 @@ def engine_stats(ctx):
     return ctx.tpu().llm("gemma").stats()
 
 
+def _serving_tokenizer():
+    """The configured tokenizer, else the dependency-free byte-level
+    fallback when the model vocabulary admits it (>= 258 ids) — what
+    lets the OpenAI edge and the batch tier serve TEXT against the
+    randomly-initialized dev/CI presets with zero assets."""
+    if TOKENIZER is not None:
+        return TOKENIZER
+    cfg = getattr(build_engine, "cfg", None)
+    if cfg is not None and cfg.vocab_size >= 258:
+        from gofr_tpu.models.tokenizer import ByteTokenizer
+
+        return ByteTokenizer(cfg.vocab_size)
+    return None
+
+
 def build_app():
     app = gofr_tpu.new()
     build_engine(app)
@@ -279,6 +295,27 @@ def build_app():
     # GET /.well-known/debug/engine.
     app.post("/generate", generate)
     app.get("/stats", engine_stats)
+    # OpenAI-compatible edge (docs/advanced-guide/batch-inference.md +
+    # structured-decoding.md): stock OpenAI clients/load tools speak to
+    # /v1/chat/completions (SSE streaming, json_schema response_format),
+    # /v1/embeddings and /v1/models unmodified — directly or through the
+    # front-router tier.
+    from gofr_tpu.openai_compat import register_openai_routes
+
+    register_openai_routes(app, model="gemma", tokenizer=_serving_tokenizer())
+    # Offline batch tier (opt-in): LLM_BATCH_TOPIC + PUBSUB_BACKEND
+    # drain JSON generation jobs from pub/sub into the engine's batch
+    # priority class, results to <topic>.results or per-job webhooks,
+    # POST /v1/batches to submit over HTTP.
+    topic = os.environ.get("LLM_BATCH_TOPIC", "")
+    if topic and app.container.pubsub is not None:
+        from gofr_tpu.batch import attach_batch_worker
+
+        attach_batch_worker(
+            app, topic, model="gemma",
+            tokenizer=_serving_tokenizer(),
+            concurrency=int(os.environ.get("LLM_BATCH_CONCURRENCY", "4")),
+        )
     return app
 
 

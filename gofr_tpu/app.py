@@ -72,6 +72,7 @@ class App:
         self._grpc_registered = False
 
         self._subscriptions: dict[str, Callable] = {}
+        self._bg_factories: list[Callable] = []  # add_background_task
         self._cron = None
         self._static_dirs: list[tuple[str, str]] = []
         self._route_registered = False
@@ -204,6 +205,15 @@ class App:
             return
         self._subscriptions[topic] = handler
 
+    # ---- background tasks (the batch tier's drain loop rides this) ----
+    def add_background_task(self, coro_factory: Callable) -> None:
+        """Schedule ``coro_factory()`` as a long-lived task on the app
+        loop at serve() time (cancelled at shutdown, like subscriber
+        loops). The factory is called on the serving loop — pass the
+        coroutine FUNCTION, not a coroutine object, so a restart of
+        serve() gets a fresh coroutine."""
+        self._bg_factories.append(coro_factory)
+
     # ---- cron (gofr.go:414) ----
     def add_cron_job(self, schedule: str, job_name: str, job: Callable) -> None:
         from .cron import Cron
@@ -318,6 +328,9 @@ class App:
 
         for topic, handler in self._subscriptions.items():
             self._bg_tasks.append(asyncio.ensure_future(self._run_subscriber(topic, handler)))
+
+        for factory in self._bg_factories:
+            self._bg_tasks.append(asyncio.ensure_future(factory()))
 
         if self._cron is not None:
             self._bg_tasks.append(asyncio.ensure_future(self._cron.run()))

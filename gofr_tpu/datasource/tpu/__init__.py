@@ -278,6 +278,13 @@ class TPURuntime:
         # on) — docs/advanced-guide/resilience.md
         self.default_llm_step_watchdog = get("TPU_LLM_STEP_WATCHDOG_S", "")
         self.default_llm_numeric_check = get("TPU_LLM_NUMERIC_CHECK", "")
+        # grammar-constrained decoding knobs (gofr_tpu.structured; "" =
+        # engine defaults, which read the same names as process env
+        # vars) — docs/advanced-guide/structured-decoding.md
+        self.default_llm_constrained = get("TPU_LLM_CONSTRAINED", "")
+        self.default_llm_constrained_grammars = get(
+            "TPU_LLM_CONSTRAINED_GRAMMARS", ""
+        )
         # sharded / disaggregated serving knobs (docs/advanced-guide/
         # sharded-serving.md): TPU_LLM_TP runs each replica
         # tensor-parallel over a submesh of that many chips;
@@ -527,6 +534,15 @@ class TPURuntime:
         if self.default_llm_numeric_check != "":
             engine_kw.setdefault(
                 "numeric_check", self.default_llm_numeric_check != "0"
+            )
+        if self.default_llm_constrained != "":
+            engine_kw.setdefault(
+                "constrained", self.default_llm_constrained != "0"
+            )
+        if self.default_llm_constrained_grammars != "":
+            engine_kw.setdefault(
+                "constrained_grammars",
+                int(self.default_llm_constrained_grammars),
             )
         # paged KV pool / session-tier knobs (docs/advanced-guide/kv-cache.md)
         if self.default_llm_kv_paged != "":

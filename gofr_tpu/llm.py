@@ -204,12 +204,30 @@ def _register_phase_metrics(metrics) -> None:
         # docs/advanced-guide/speculative-decoding.md)
         for name, desc in (
             ("app_llm_spec_proposed_total",
-             "llm speculative draft tokens proposed (n-gram drafter)"),
+             "llm speculative draft tokens proposed (n-gram drafter; "
+             "constrained=0|1 splits grammar-masked lanes)"),
             ("app_llm_spec_accepted_total",
-             "llm speculative draft tokens accepted by verification"),
+             "llm speculative draft tokens accepted by verification "
+             "(constrained=0|1 splits grammar-masked lanes)"),
+            ("app_llm_constrained_requests_total",
+             "llm grammar-constrained generation requests accepted "
+             "(gofr_tpu.structured)"),
         ):
             if not metrics.has(name):
                 metrics.new_counter(name, desc)
+        if not metrics.has("app_llm_constrained_mask_seconds"):
+            metrics.new_histogram(
+                "app_llm_constrained_mask_seconds",
+                "llm grammar mask preparation wall s per constrained "
+                "submit (dedup hit or table pad + device ship)",
+                TPU_BUCKETS,
+            )
+        if not metrics.has("app_llm_constrained_grammars"):
+            metrics.new_gauge(
+                "app_llm_constrained_grammars",
+                "llm resident compiled grammars in the engine's device "
+                "transition table (zeroed at engine close)",
+            )
         if not metrics.has("app_llm_spec_tokens_per_step"):
             metrics.new_histogram(
                 "app_llm_spec_tokens_per_step",
@@ -362,6 +380,15 @@ class GenRequest:
     # conversation — block-shares the whole history instead of
     # re-prefilling it. Empty = sessionless (blocks free at retire).
     session_id: str = ""
+    # Grammar-constrained decoding (gofr_tpu.structured;
+    # docs/advanced-guide/structured-decoding.md): a compiled
+    # TokenGrammar. Every sampled token is masked to what the grammar's
+    # current DFA state admits — the output is valid by construction —
+    # and the per-slot state advances INSIDE the fused device programs,
+    # so constrained and unconstrained requests share one program.
+    # Requires the chunked scheduler; eos_token is taken from the
+    # grammar when unset. None = unconstrained (zero new device work).
+    grammar: Any = None
     id: int = field(default_factory=itertools.count().__next__)
 
     def __post_init__(self):
@@ -411,6 +438,14 @@ class GenRequest:
         self._session_published = False  # end-of-turn radix publish done
         self._prefill_t0: float | None = None  # first chunk dispatch time
         self._load_acct = 0  # outstanding token estimate (router weighting)
+        # -- grammar-constrained decoding (engine-maintained) --
+        # _g_id: this engine's resident-grammar table slot (set at
+        # submit; -1 while unconstrained). _g_state: HOST mirror of the
+        # DFA state after every emitted token — feeds the drafter's
+        # grammar filter and re-seeds the device state when a
+        # continuation (preemption/failover) re-admits mid-output.
+        self._g_id = -1
+        self._g_state = 0
         # -- speculative decoding (gofr_tpu.spec; engine-maintained) --
         # acceptance-rate EMA driving the adaptive draft length, and the
         # plain-pass streak that paces the backed-off re-probe. Starts
@@ -539,6 +574,8 @@ class LLMEngine:
         brownout_hold_s: float | None = None,
         step_watchdog_s: float | None = None,
         numeric_check: bool | None = None,
+        constrained: bool | None = None,
+        constrained_grammars: int | None = None,
         fault_injector=None,
         logger=None,
         metrics=None,
@@ -899,23 +936,64 @@ class LLMEngine:
 
         _numeric_check = self.numeric_check
 
-        def _sample(logits, temps, key):
+        def _sample_raw(logits, temps, key):
             """Greedy for temp==0; temperature sampling restricted to the
             top-k logits otherwise. Full-vocab categorical would generate
             batch x vocab Gumbel draws per step (millions of threefry
             rounds for a 256k vocab) and dominates decode time; top-k keeps
-            the RNG work at batch x 64. With the numerical watchdog on,
-            a row whose logits went NaN/Inf samples the -1 sentinel
-            instead (finite_guard) — the collector converts it to a
-            replica death before anything is emitted."""
+            the RNG work at batch x 64."""
             greedy = jnp.argmax(logits, axis=-1)
             topv, topi = jax.lax.approx_max_k(logits, topk)
             local = jax.random.categorical(
                 key, topv / jnp.maximum(temps, 1e-4)[:, None], axis=-1
             )
             sampled = jnp.take_along_axis(topi, local[:, None], axis=1)[:, 0]
-            out = jnp.where(temps > 0.0, sampled, greedy).astype(jnp.int32)
+            return jnp.where(temps > 0.0, sampled, greedy).astype(jnp.int32)
+
+        def _sample(logits, temps, key):
+            """_sample_raw plus the numerical watchdog: a row whose logits
+            went NaN/Inf samples the -1 sentinel instead (finite_guard) —
+            the collector converts it to a replica death before anything
+            is emitted."""
+            out = _sample_raw(logits, temps, key)
             return finite_guard(logits, out) if _numeric_check else out
+
+        # -- grammar-constrained sampling (gofr_tpu.structured;
+        # docs/advanced-guide/structured-decoding.md) ---------------------
+        # gtab [G, Smax, V] int32 is the resident-grammar transition
+        # table (entry < 0 = token not admitted in that state); gid [B]
+        # selects each lane's grammar (-1 = unconstrained) and gstate [B]
+        # its current DFA state. Masking uses a large-negative bias, not
+        # -inf (an all-masked padding row must stay NaN-free), and the
+        # watchdog guard runs on the RAW logits — a grammar mask is not a
+        # numerical fault. Unconstrained lanes take their logits
+        # UNTOUCHED (a jnp.where select, not a +0 bias), which is what
+        # pins mixed-batch token-identity with the unconstrained programs.
+        _G_NEG = jnp.float32(-1e30)
+
+        def _g_rows(gtab, gid, gstate):
+            G, Smax = gtab.shape[0], gtab.shape[1]
+            rows = gtab[
+                jnp.clip(gid, 0, G - 1), jnp.clip(gstate, 0, Smax - 1)
+            ]  # [B, V] next state per token, or < 0
+            on = (gid >= 0) & (gstate >= 0) & (gstate < Smax)
+            return rows, on
+
+        def _g_mask(logits, rows, on):
+            return jnp.where(on[:, None] & (rows < 0), _G_NEG, logits)
+
+        def _g_sample(logits, temps, key, gtab, gid, gstate):
+            """One masked sample + DFA advance for per-lane grammar
+            states: the stateful sampler the constrained program family
+            threads through decode chunks (models.transformer
+            sample_state seam), unified steps, and verify positions."""
+            rows, on = _g_rows(gtab, gid, gstate)
+            out = _sample_raw(_g_mask(logits, rows, on), temps, key)
+            out = finite_guard(logits, out) if _numeric_check else out
+            nxt = jnp.take_along_axis(
+                rows, jnp.clip(out, 0)[:, None], axis=1
+            )[:, 0]
+            return out, jnp.where(on, nxt, gstate)
 
         # last-token logits ride the prefill programs whenever ANY prefix
         # index can serve exact hits from them: the contiguous PrefixCache
@@ -1195,6 +1273,197 @@ class LLMEngine:
                 metrics=metrics, donate_argnums=(1, 2),
             )
 
+        # -- constrained program family (gofr_tpu.structured) -------------
+        # Parallel variants of the chunk/step/verify programs that carry
+        # the grammar machinery: gtab (the resident-grammar transition
+        # table, read-only, retraced when its padded shape grows), gids
+        # (per-slot grammar selector, shipped per dispatch — it only
+        # changes at admission) and gstate (per-slot DFA state,
+        # device-persistent and donated exactly like the chain tail, so
+        # pipelined dispatches chain states without a host round trip).
+        # FACTORIES only — nothing compiles until the first constrained
+        # request admits (a constrained-free engine builds zero new
+        # programs); the paged block below overrides them with the
+        # pool-layout variants.
+        #
+        # MIRROR CONTRACT: each variant copies its plain factory's body
+        # (same gather/scatter, pack/meta unpack, finish bookkeeping)
+        # plus the grammar threading — the same deliberate duplication
+        # the dense/paged pairs already carry, chosen over one factory
+        # branching on every argument list and return tuple. A change to
+        # step packing or scatter semantics in a plain factory MUST be
+        # mirrored here (the cross-layout equality tests in
+        # tests/test_structured.py are the tripwire).
+
+        def _make_chunk_op_c(K: int):
+            def _chunk_c(params, tokens, cache, active, temps, gstate,
+                         gids, rng, gtab):
+                sampler = (
+                    lambda lg, tp, k, st: _g_sample(lg, tp, k, gtab, gids, st)
+                )
+                toks, last, cache, rng, gstate = chunk_fn(
+                    params, cfg, tokens, cache, active, temps, rng,
+                    n_steps=K, sample_fn=sampler, ring=self.kv.ring,
+                    overlap=self._tp_gather, sample_state=gstate,
+                )
+                return toks, last, cache, gstate, rng
+
+            return instrument_jit(
+                f"llm.decode_chunk{K}g", _chunk_c, model=self.label,
+                metrics=metrics, donate_argnums=(2, 5),
+            )
+
+        def _make_step_op_c(shape: int):
+            K = decode_chunk
+
+            def _step_c(params, cache, tail, active, temps, gstate,
+                        pack, meta, gids, rng, gtab):
+                """_step plus grammar threading. meta [4, nb] int32:
+                slot | finish | grammar id | start DFA state — a row
+                whose prompt completes this step samples its FIRST token
+                masked by its start state (0 fresh; the host mirror's
+                state for a preemption/failover continuation) and seeds
+                the slot's device state; the fused decode chunk then
+                advances every lane's state token-by-token."""
+                tokens = pack[:, :shape]
+                cursors = pack[:, shape]
+                n_new = pack[:, shape + 1]
+                req_temps = jax.lax.bitcast_convert_type(
+                    pack[:, shape + 2], jnp.float32
+                )
+                slot_idx, finish = meta[0], meta[1]
+                gid_row, gstart = meta[2], meta[3]
+                sub = cache._replace(
+                    k=jnp.take(cache.k, slot_idx, axis=1, mode="clip"),
+                    v=jnp.take(cache.v, slot_idx, axis=1, mode="clip"),
+                    length=cursors,
+                )
+                logits, sub = prefill_append(
+                    params, cfg, tokens, sub, cursors, n_new,
+                    ring=self.kv.ring,
+                )
+                cache = cache._replace(
+                    k=cache.k.at[:, slot_idx].set(sub.k, mode="drop"),
+                    v=cache.v.at[:, slot_idx].set(sub.v, mode="drop"),
+                    length=cache.length.at[slot_idx].set(
+                        cursors + n_new, mode="drop"
+                    ),
+                )
+                rng, sub_rng = jax.random.split(rng)
+                rows_g, on_r = _g_rows(gtab, gid_row, gstart)
+                on_r = on_r & (finish == 1)
+                first = _sample_raw(
+                    _g_mask(logits, rows_g, on_r), req_temps, sub_rng
+                )
+                first = finite_guard(logits, first) if _numeric_check else first
+                st1 = jnp.take_along_axis(
+                    rows_g, jnp.clip(first, 0)[:, None], axis=1
+                )[:, 0]
+                fin_slot = jnp.where(finish == 1, slot_idx, _slots_oob)
+                mid_slot = jnp.where(finish == 1, _slots_oob, slot_idx)
+                active = active.at[mid_slot].set(False, mode="drop")
+                tail = tail.at[fin_slot].set(first, mode="drop")
+                active = active.at[fin_slot].set(True, mode="drop")
+                temps = temps.at[fin_slot].set(req_temps, mode="drop")
+                gstate = gstate.at[fin_slot].set(
+                    jnp.where(on_r, st1, 0), mode="drop"
+                )
+                kept = logits if keep_logits else None
+                sampler = (
+                    lambda lg, tp, k, st: _g_sample(lg, tp, k, gtab, gids, st)
+                )
+                toks, last, cache, rng, gstate = chunk_fn(
+                    params, cfg, tail, cache, active, temps, rng,
+                    n_steps=K, sample_fn=sampler, ring=self.kv.ring,
+                    overlap=self._tp_gather, sample_state=gstate,
+                )
+                return (
+                    first, kept, toks, last, cache, active, temps, gstate, rng
+                )
+
+            return instrument_jit(
+                f"llm.step_p{shape}_d{K}g", _step_c, model=self.label,
+                metrics=metrics, donate_argnums=(1, 2, 3, 4, 5),
+            )
+
+        def _make_verify_op_c():
+            from .models.transformer import verify_chunk as verify_fn_c
+
+            Kd = self.spec_draft
+            Wv = Kd + 1
+
+            def _verify_c(params, cache, tail, temps, gstate, pack, gids,
+                          rng, gtab):
+                """Verify with per-position grammar masks: position j's
+                context is tail + draft[:j], so its mask derives from the
+                state reached by advancing the slot state through the
+                DRAFT tokens (known at trace time — a tiny unrolled
+                chain). An inadmissible draft token sends the chain state
+                dead, but the masked sample at its own position is
+                guaranteed to disagree with it, so acceptance always
+                stops before a dead state can matter; the post-accept
+                state advances from the accepted prefix's state by the
+                bonus token."""
+                drafts = pack[:, :Kd]
+                n_draft = pack[:, Kd]
+                sel = pack[:, Kd + 1] == 1
+                n_in = jnp.where(sel, n_draft + 1, 0)
+                toks = jnp.concatenate([tail[:, None], drafts], axis=1)
+                logits, new_cache = verify_fn_c(
+                    params, cfg, toks, cache, cache.length, n_in,
+                    ring=self.kv.ring,
+                )
+                rng, sub = jax.random.split(rng)
+                keys = jax.random.split(sub, Wv)
+                s = gstate
+                states = [s]
+                ys_list = []
+                for j in range(Wv):
+                    rows, on = _g_rows(gtab, gids, s)
+                    yj = _sample_raw(
+                        _g_mask(logits[:, j], rows, on), temps, keys[j]
+                    )
+                    yj = (
+                        finite_guard(logits[:, j], yj)
+                        if _numeric_check else yj
+                    )
+                    ys_list.append(yj)
+                    if j < Kd:
+                        nxt = jnp.take_along_axis(
+                            rows, jnp.clip(drafts[:, j], 0)[:, None], axis=1
+                        )[:, 0]
+                        s = jnp.where(on, nxt, s)
+                        states.append(s)
+                ys = jnp.stack(ys_list, axis=1)  # [S, W] int32
+                agree = (ys[:, :Kd] == drafts) & (
+                    jnp.arange(Kd, dtype=jnp.int32)[None, :]
+                    < n_draft[:, None]
+                )
+                acc = jnp.cumprod(agree.astype(jnp.int32), axis=1).sum(axis=1)
+                bonus = jnp.take_along_axis(ys, acc[:, None], axis=1)[:, 0]
+                st_stack = jnp.stack(states, axis=1)  # [S, Wv]
+                st_acc = jnp.take_along_axis(
+                    st_stack, acc[:, None], axis=1
+                )[:, 0]
+                rows_a, on_a = _g_rows(gtab, gids, st_acc)
+                nxt_a = jnp.take_along_axis(
+                    rows_a, jnp.clip(bonus, 0)[:, None], axis=1
+                )[:, 0]
+                gstate = jnp.where(sel & on_a, nxt_a, gstate)
+                new_len = jnp.where(sel, cache.length + acc + 1, cache.length)
+                cache = new_cache._replace(length=new_len)
+                tail = jnp.where(sel, bonus, tail)
+                return ys, acc, cache, tail, gstate, rng
+
+            return instrument_jit(
+                f"llm.step_v{Wv}g", _verify_c, model=self.label,
+                metrics=metrics, donate_argnums=(1, 2, 4),
+            )
+
+        self._mk_chunk_c = _make_chunk_op_c
+        self._mk_step_c = _make_step_op_c
+        self._mk_verify_c = _make_verify_op_c
+
         # -- paged-pool program family (kvcache.paged; docs/advanced-guide/
         # kv-cache.md). Same scheduler contracts as the contiguous family
         # above, but the slot KV lives in ONE block pool read/written
@@ -1472,6 +1741,248 @@ class LLMEngine:
                     metrics=metrics,
                     donate_argnums=((1, 2, 4) if _int8 else (1, 4)),
                 )
+
+            # constrained variants over the pool layout (same grammar
+            # machinery as the dense factories above; lazily compiled)
+            def _make_paged_chunk_op_c(K: int):
+                def _chunk_c(params, tail, cache, scales, tables, live,
+                             active, temps, gstate, gids, rng, gtab):
+                    eff = jnp.logical_and(active, live)
+                    sampler = (
+                        lambda lg, tp, k, st:
+                        _g_sample(lg, tp, k, gtab, gids, st)
+                    )
+                    if _use_kernel:
+                        toks, last, cache, sc_out, rng, gstate = (
+                            decode_chunk_paged(
+                                params, cfg, tail, cache,
+                                (scales if _int8 else None),
+                                tables, eff, temps, rng,
+                                n_steps=K, sample_fn=sampler, block=Bp,
+                                overlap=self._tp_gather, sample_state=gstate,
+                            )
+                        )
+                        return toks, last, cache, (
+                            sc_out if _int8 else scales
+                        ), gstate, rng
+                    dense = _gather_view(cache, scales, tables, cache.length)
+                    toks, last, nd, rng, gstate = chunk_fn(
+                        params, cfg, tail, dense, eff, temps, rng,
+                        n_steps=K, sample_fn=sampler, ring=0,
+                        overlap=self._tp_gather, sample_state=gstate,
+                    )
+                    pos = cache.length[:, None] + jnp.arange(
+                        K, dtype=jnp.int32
+                    )[None, :]
+                    valid = eff[:, None] & (pos < _cap)
+                    cache, scales = _pool_scatter(
+                        cache, scales, tables,
+                        _rows_at(nd.k, pos), _rows_at(nd.v, pos), pos, valid,
+                    )
+                    return (
+                        toks, last, cache._replace(length=nd.length),
+                        scales, gstate, rng,
+                    )
+
+                return instrument_jit(
+                    f"llm.decode_chunk{K}g", _chunk_c, model=self.label,
+                    metrics=metrics,
+                    donate_argnums=((2, 3, 8) if _int8 else (2, 8)),
+                )
+
+            def _make_paged_step_op_c(shape: int):
+                K = decode_chunk
+
+                def _step_c(params, cache, scales, tables, live, tail,
+                            active, temps, gstate, pack, meta, gids, rng,
+                            gtab):
+                    tokens = pack[:, :shape]
+                    cursors = pack[:, shape]
+                    n_new = pack[:, shape + 1]
+                    req_temps = jax.lax.bitcast_convert_type(
+                        pack[:, shape + 2], jnp.float32
+                    )
+                    slot_idx, finish = meta[0], meta[1]
+                    gid_row, gstart = meta[2], meta[3]
+                    tsub = jnp.take(
+                        tables, jnp.clip(slot_idx, 0, slots - 1), axis=0
+                    )
+                    sub = _gather_view(cache, scales, tsub, cursors)
+                    logits, sub2 = prefill_append(
+                        params, cfg, tokens, sub, cursors, n_new, ring=0,
+                    )
+                    c = shape
+                    pos_a = cursors[:, None] + jnp.arange(
+                        c, dtype=jnp.int32
+                    )[None, :]
+                    valid_a = (
+                        jnp.arange(c, dtype=jnp.int32)[None, :]
+                        < n_new[:, None]
+                    ) & (pos_a < _cap)
+                    cache, scales = _pool_scatter(
+                        cache, scales, tsub,
+                        _rows_at(sub2.k, pos_a), _rows_at(sub2.v, pos_a),
+                        pos_a, valid_a,
+                    )
+                    length = cache.length.at[slot_idx].set(
+                        cursors + n_new, mode="drop"
+                    )
+                    cache = cache._replace(length=length)
+                    rng, sub_rng = jax.random.split(rng)
+                    rows_g, on_r = _g_rows(gtab, gid_row, gstart)
+                    on_r = on_r & (finish == 1)
+                    first = _sample_raw(
+                        _g_mask(logits, rows_g, on_r), req_temps, sub_rng
+                    )
+                    first = (
+                        finite_guard(logits, first)
+                        if _numeric_check else first
+                    )
+                    st1 = jnp.take_along_axis(
+                        rows_g, jnp.clip(first, 0)[:, None], axis=1
+                    )[:, 0]
+                    fin_slot = jnp.where(finish == 1, slot_idx, _slots_oob)
+                    mid_slot = jnp.where(finish == 1, _slots_oob, slot_idx)
+                    active = active.at[mid_slot].set(False, mode="drop")
+                    tail = tail.at[fin_slot].set(first, mode="drop")
+                    active = active.at[fin_slot].set(True, mode="drop")
+                    temps = temps.at[fin_slot].set(req_temps, mode="drop")
+                    gstate = gstate.at[fin_slot].set(
+                        jnp.where(on_r, st1, 0), mode="drop"
+                    )
+                    kept = logits if keep_logits else None
+                    eff = jnp.logical_and(active, live)
+                    sampler = (
+                        lambda lg, tp, k, st:
+                        _g_sample(lg, tp, k, gtab, gids, st)
+                    )
+                    if _use_kernel:
+                        toks, last, cache, sc, rng, gstate = (
+                            decode_chunk_paged(
+                                params, cfg, tail, cache,
+                                (scales if _int8 else None),
+                                tables, eff, temps, rng,
+                                n_steps=K, sample_fn=sampler, block=Bp,
+                                overlap=self._tp_gather, sample_state=gstate,
+                            )
+                        )
+                        scales = sc if _int8 else scales
+                    else:
+                        dense = _gather_view(
+                            cache, scales, tables, cache.length
+                        )
+                        toks, last, nd, rng, gstate = chunk_fn(
+                            params, cfg, tail, dense, eff, temps, rng,
+                            n_steps=K, sample_fn=sampler, ring=0,
+                            overlap=self._tp_gather, sample_state=gstate,
+                        )
+                        pos = cache.length[:, None] + jnp.arange(
+                            K, dtype=jnp.int32
+                        )[None, :]
+                        valid = eff[:, None] & (pos < _cap)
+                        cache, scales = _pool_scatter(
+                            cache, scales, tables,
+                            _rows_at(nd.k, pos), _rows_at(nd.v, pos),
+                            pos, valid,
+                        )
+                        cache = cache._replace(length=nd.length)
+                    return (
+                        first, kept, toks, last, cache, scales, active,
+                        temps, gstate, rng,
+                    )
+
+                return instrument_jit(
+                    f"llm.step_p{shape}_d{K}g", _step_c, model=self.label,
+                    metrics=metrics,
+                    donate_argnums=(
+                        (1, 2, 6, 7, 8) if _int8 else (1, 6, 7, 8)
+                    ),
+                )
+
+            def _make_paged_verify_op_c():
+                from .models.transformer import verify_chunk as verify_fn_c
+
+                Kd = self.spec_draft
+                Wv = Kd + 1
+
+                def _verify_c(params, cache, scales, tables, tail, temps,
+                              gstate, pack, gids, rng, gtab):
+                    drafts = pack[:, :Kd]
+                    n_draft = pack[:, Kd]
+                    sel = pack[:, Kd + 1] == 1
+                    n_in = jnp.where(sel, n_draft + 1, 0)
+                    toks = jnp.concatenate([tail[:, None], drafts], axis=1)
+                    dense = _gather_view(cache, scales, tables, cache.length)
+                    logits, nd = verify_fn_c(
+                        params, cfg, toks, dense, cache.length, n_in, ring=0,
+                    )
+                    pos = cache.length[:, None] + jnp.arange(
+                        Wv, dtype=jnp.int32
+                    )[None, :]
+                    valid = (
+                        jnp.arange(Wv, dtype=jnp.int32)[None, :]
+                        < n_in[:, None]
+                    ) & (pos < _cap)
+                    cache, scales = _pool_scatter(
+                        cache, scales, tables,
+                        _rows_at(nd.k, pos), _rows_at(nd.v, pos), pos, valid,
+                    )
+                    rng, sub = jax.random.split(rng)
+                    keys = jax.random.split(sub, Wv)
+                    s = gstate
+                    states = [s]
+                    ys_list = []
+                    for j in range(Wv):
+                        rows, on = _g_rows(gtab, gids, s)
+                        yj = _sample_raw(
+                            _g_mask(logits[:, j], rows, on), temps, keys[j]
+                        )
+                        yj = (
+                            finite_guard(logits[:, j], yj)
+                            if _numeric_check else yj
+                        )
+                        ys_list.append(yj)
+                        if j < Kd:
+                            nxt = jnp.take_along_axis(
+                                rows, jnp.clip(drafts[:, j], 0)[:, None],
+                                axis=1,
+                            )[:, 0]
+                            s = jnp.where(on, nxt, s)
+                            states.append(s)
+                    ys = jnp.stack(ys_list, axis=1)
+                    agree = (ys[:, :Kd] == drafts) & (
+                        jnp.arange(Kd, dtype=jnp.int32)[None, :]
+                        < n_draft[:, None]
+                    )
+                    acc = jnp.cumprod(
+                        agree.astype(jnp.int32), axis=1
+                    ).sum(axis=1)
+                    bonus = jnp.take_along_axis(ys, acc[:, None], axis=1)[:, 0]
+                    st_stack = jnp.stack(states, axis=1)
+                    st_acc = jnp.take_along_axis(
+                        st_stack, acc[:, None], axis=1
+                    )[:, 0]
+                    rows_a, on_a = _g_rows(gtab, gids, st_acc)
+                    nxt_a = jnp.take_along_axis(
+                        rows_a, jnp.clip(bonus, 0)[:, None], axis=1
+                    )[:, 0]
+                    gstate = jnp.where(sel & on_a, nxt_a, gstate)
+                    new_len = jnp.where(
+                        sel, cache.length + acc + 1, cache.length
+                    )
+                    cache = cache._replace(length=new_len)
+                    tail = jnp.where(sel, bonus, tail)
+                    return ys, acc, cache, scales, tail, gstate, rng
+
+                return instrument_jit(
+                    f"llm.step_v{Wv}g", _verify_c, model=self.label,
+                    metrics=metrics,
+                    donate_argnums=((1, 2, 4, 6) if _int8 else (1, 4, 6)),
+                )
+
+            self._mk_chunk_c = _make_paged_chunk_op_c
+            self._mk_step_c = _make_paged_step_op_c
+            self._mk_verify_c = _make_paged_verify_op_c
         self._rng = jax.random.PRNGKey(0)
 
         if self.kv.paged:
@@ -1533,9 +2044,42 @@ class LLMEngine:
         self._tail = jnp.zeros((slots,), jnp.int32)
         self._active = jnp.zeros((slots,), bool)
         self._temps = jnp.zeros((slots,), jnp.float32)
+        # -- grammar-constrained decoding (gofr_tpu.structured;
+        # docs/advanced-guide/structured-decoding.md) ---------------------
+        # Per-slot DFA state lives on device like the chain tail (the
+        # fused chunk advances it token-by-token, and pipelined
+        # dispatches must chain it without a host fetch); the resident
+        # grammar table and the per-slot grammar ids are host-owned.
+        # Chunked scheduler only: the wave path samples first tokens in
+        # programs the mask does not ride.
+        if constrained is None:
+            constrained = _os.environ.get("TPU_LLM_CONSTRAINED", "1") != "0"
+        self.constrained = bool(constrained) and self.chunked
+        if constrained_grammars is None:
+            constrained_grammars = int(
+                _os.environ.get("TPU_LLM_CONSTRAINED_GRAMMARS", "8") or 8
+            )
+        self._g_cap = max(1, int(constrained_grammars))
+        self._grammars: list[Any] = []  # resident TokenGrammars (index=gid)
+        self._g_refs: list[int] = []  # live requests holding each gid
+        self._gr_dev = None  # padded [G, Smax, V] device transition table
+        self._gstate = jnp.zeros((slots,), jnp.int32)
+        self.constrained_requests = 0  # lifetime constrained submissions
+        self.spec_proposed_c = 0  # spec drafts proposed for constrained lanes
+        self.spec_accepted_c = 0  # spec drafts accepted for constrained lanes
+        self._chunk_ops_c: dict[int, Any] = {}  # built on first use
+        self._step_ops_c: dict[int, Any] = {}
+        self._verify_op_c = None
         if device is not None:
-            self._tail, self._active, self._temps, self._rng = jax.device_put(
-                (self._tail, self._active, self._temps, self._rng), device
+            (
+                self._tail, self._active, self._temps, self._gstate,
+                self._rng,
+            ) = jax.device_put(
+                (
+                    self._tail, self._active, self._temps, self._gstate,
+                    self._rng,
+                ),
+                device,
             )
         self._admit_q: queue.Queue[GenRequest | None] = queue.Queue()
         self._waiting: list[GenRequest] = []  # drained queue, scheduler-only
@@ -1641,6 +2185,26 @@ class LLMEngine:
                     f"{self.kv.pool.n_blocks} (raise kv_pool_blocks / "
                     "TPU_LLM_KV_POOL_BLOCKS)"
                 )
+        # -- grammar-constrained decoding (gofr_tpu.structured;
+        # docs/advanced-guide/structured-decoding.md) ---------------------
+        if req.grammar is not None:
+            if not self.constrained:
+                raise ValueError(
+                    "grammar-constrained decoding requires the chunked "
+                    "scheduler (step_token_budget > 0) and "
+                    "TPU_LLM_CONSTRAINED=1"
+                )
+            g = req.grammar
+            if req.eos_token < 0:
+                # the grammar's completion transition IS the eos: without
+                # it the stream would run past the closed value into
+                # dead-state garbage
+                req.eos_token = g.eos_id
+            elif req.eos_token != g.eos_id:
+                raise ValueError(
+                    f"request eos_token {req.eos_token} != grammar eos "
+                    f"{g.eos_id} (the grammar closes the stream)"
+                )
         # -- overload control (docs/advanced-guide/overload.md) -----------
         # Anything except the literal "batch" is interactive: the edges
         # forward the X-GoFr-Priority header verbatim, and a typo must
@@ -1687,6 +2251,24 @@ class LLMEngine:
                 raise EngineOverloaded(
                     f"admission queue full ({depth} >= {self.max_queue})",
                     retry_after=wait_s if wait_s else 1.0,
+                )
+        if req.grammar is not None:
+            # register AFTER every shed/reject path: a rejected submit
+            # must not leak a resident-grammar reference. Registration
+            # wall (dedup hit or table compile+ship) is the mask-prep
+            # cost the app_llm_constrained_mask_seconds series tracks.
+            t0g = time.perf_counter()
+            with self._lock:
+                req._g_id = self._register_grammar(req.grammar)
+                self._g_refs[req._g_id] += 1
+            self.constrained_requests += 1
+            if self.metrics is not None:
+                self.metrics.increment_counter(
+                    "app_llm_constrained_requests_total", model=self.label
+                )
+                self.metrics.record_histogram(
+                    "app_llm_constrained_mask_seconds",
+                    time.perf_counter() - t0g, model=self.label,
                 )
         now = time.perf_counter()
         req.submitted_at = now
@@ -1798,6 +2380,8 @@ class LLMEngine:
                 "prefilling": len(self._prefilling),
                 # speculative decoding (gofr_tpu.spec)
                 "spec": self._spec_summary(),
+                # grammar-constrained decoding (gofr_tpu.structured)
+                "constrained": self._constrained_summary(),
                 "load_tokens": self.load_tokens(),
                 "rejected": self.rejected,
                 "shed": self.shed,
@@ -1930,6 +2514,7 @@ class LLMEngine:
             "chunk_shapes": list(self.chunk_shapes),
             "prefilling": len(self._prefilling),
             "spec": self._spec_summary(),
+            "constrained": self._constrained_summary(),
             "slot_table": slot_table,
             "inflight": inflight,
             "waiting_total": waiting_total,
@@ -1949,7 +2534,13 @@ class LLMEngine:
 
     def _spec_summary(self) -> dict:
         """Speculative-decoding telemetry block for stats()/debug_state:
-        cheap counter reads, no lock requirements (GIL-atomic ints)."""
+        cheap counter reads, no lock requirements (GIL-atomic ints).
+        The constrained split is what the structured-decoding bench
+        point reads — acceptance on grammar-masked text should meet or
+        beat the unconstrained rate (the drafter's proposals are
+        pre-filtered by the same DFA)."""
+        prop_u = self.spec_proposed - self.spec_proposed_c
+        acc_u = self.spec_accepted - self.spec_accepted_c
         return {
             "enabled": self.speculative,
             "draft": self.spec_draft,
@@ -1961,7 +2552,144 @@ class LLMEngine:
                 round(self.spec_accepted / self.spec_proposed, 3)
                 if self.spec_proposed else None
             ),
+            "constrained": {
+                "proposed": self.spec_proposed_c,
+                "accepted": self.spec_accepted_c,
+                "accept_rate": (
+                    round(self.spec_accepted_c / self.spec_proposed_c, 3)
+                    if self.spec_proposed_c else None
+                ),
+            },
+            "unconstrained": {
+                "proposed": prop_u,
+                "accepted": acc_u,
+                "accept_rate": (
+                    round(acc_u / prop_u, 3) if prop_u else None
+                ),
+            },
         }
+
+    # -- grammar-constrained decoding (gofr_tpu.structured) ---------------
+
+    def _constrained_summary(self) -> dict:
+        """Telemetry block for stats()/debug_state (lock held by caller
+        or freshness unimportant — counter reads are GIL-atomic)."""
+        return {
+            "enabled": self.constrained,
+            "requests": self.constrained_requests,
+            "grammars_resident": sum(
+                1 for g in self._grammars if g is not None
+            ),
+            "grammar_cap": self._g_cap,
+            "states": [
+                g.n_states if g is not None else 0 for g in self._grammars
+            ],
+        }
+
+    def _register_grammar(self, g) -> int:
+        """Resident-grammar table slot for one TokenGrammar (call with
+        the engine lock held). Repeat schemas dedup by grammar key; a
+        full table evicts a zero-ref entry, and a table whose every slot
+        holds live requests sheds the submit (429 — capacity, not a
+        client bug)."""
+        vocab = getattr(g, "vocab_size", None)
+        if vocab != self.cfg.vocab_size:
+            raise ValueError(
+                f"grammar compiled for vocab {vocab}, model vocab is "
+                f"{self.cfg.vocab_size} — compile against this model's "
+                "tokenizer"
+            )
+        for i, og in enumerate(self._grammars):
+            if og is not None and og.key == g.key:
+                return i
+        gid = None
+        if len(self._grammars) < self._g_cap:
+            self._grammars.append(None)
+            self._g_refs.append(0)
+            gid = len(self._grammars) - 1
+        else:
+            for i, og in enumerate(self._grammars):
+                if self._g_refs[i] == 0:
+                    gid = i
+                    break
+        if gid is None:
+            raise EngineOverloaded(
+                f"all {self._g_cap} resident grammar slots hold live "
+                "requests (raise TPU_LLM_CONSTRAINED_GRAMMARS)",
+                retry_after=1.0,
+            )
+        self._grammars[gid] = g
+        self._g_refs[gid] = 0
+        self._rebuild_grammar_table()
+        return gid
+
+    def _rebuild_grammar_table(self) -> None:
+        """Re-pad + re-ship the resident grammar table. Padded to
+        power-of-two grammar count and state count so the constrained
+        program family retraces O(log) times over an engine's life, not
+        per registration; padding rows/states admit nothing (-1), which
+        reads as 'dead' and is never reachable for a live lane."""
+        jnp = self._jnp
+        live = [g for g in self._grammars if g is not None]
+        if not live:
+            self._gr_dev = None
+            if self.metrics is not None:
+                self.metrics.set_gauge(
+                    "app_llm_constrained_grammars", 0.0, model=self.label
+                )
+            return
+        G = len(self._grammars)
+        gp = 1 << max(0, G - 1).bit_length()
+        smax = max(g.n_states for g in live)
+        sp = max(32, 1 << max(0, smax - 1).bit_length())
+        tab = np.full((gp, sp, self.cfg.vocab_size), -1, np.int32)
+        for i, g in enumerate(self._grammars):
+            if g is not None:
+                tab[i, : g.n_states, :] = g.table
+        arr = jnp.asarray(tab)
+        if self.device is not None:
+            arr = self._jax.device_put(arr, self.device)
+        self._gr_dev = arr
+        if self.metrics is not None:
+            self.metrics.set_gauge(
+                "app_llm_constrained_grammars", float(len(live)),
+                model=self.label,
+            )
+
+    def _grammar_live(self) -> bool:
+        """Any resident request constrained? (lock held). True routes
+        EVERY device dispatch through the constrained program family —
+        the per-slot gid mask keeps unconstrained lanes token-identical,
+        and one family per dispatch keeps the DFA state chain coherent."""
+        return any(
+            r is not None and r.grammar is not None for r in self._slot_req
+        ) or any(r.grammar is not None for r in self._prefilling)
+
+    def _gids_np(self) -> np.ndarray:
+        """Per-slot grammar selector for one dispatch (lock held):
+        -1 = unconstrained lane (logits untouched)."""
+        gids = np.full((self.slots,), -1, np.int32)
+        for i, r in enumerate(self._slot_req):
+            if r is not None and r.grammar is not None and r._g_id >= 0:
+                gids[i] = r._g_id
+        return gids
+
+    def _ensure_c_ops(self) -> None:
+        """Build (and on first dispatch, compile) the constrained program
+        family. Lazy by design: engines that never see a grammar build
+        nothing, and the first constrained request pays the compile the
+        way the monolithic prefill family already does in chunked mode."""
+        if self._chunk_ops_c:
+            return
+        self._chunk_ops_c = {
+            k: self._mk_chunk_c(k) for k in self._chunk_ops
+        }
+        if self.chunked:
+            self._step_ops_c = {
+                s: self._mk_step_c(s) for s in self._step_ops
+            }
+        if self._verify_op is not None:
+            self._verify_op_c = self._mk_verify_c()
 
     def load(self) -> int:
         """Cheap routing signal for the replica router: occupants plus
@@ -2193,6 +2921,7 @@ class LLMEngine:
             "app_llm_brownout_state",
             "app_llm_fairness_debt",
             "app_llm_spec_accept_rate",
+            "app_llm_constrained_grammars",
         ):
             self.metrics.set_gauge(name, 0.0, model=self.label)
         # a closed engine must not keep exporting its version row (the
@@ -3600,9 +4329,14 @@ class LLMEngine:
             for r in pulled:
                 if r.session_id:
                     self._session_prepare(r.session_id)
+                # constrained requests force a radix MISS: an exact hit
+                # admits through _hit_first, a program the grammar mask
+                # does not ride — re-prefilling trades latency for the
+                # validity guarantee (partial seeds would be fine, but
+                # one rule is auditable)
                 plan = (
                     self.kv.lookup_seed(r.prompt_tokens)
-                    if self.kv.share else None
+                    if self.kv.share and r.grammar is None else None
                 )
                 r._kv_plan = plan
                 if not self.kv.admit_reserve(
@@ -3630,6 +4364,9 @@ class LLMEngine:
         elif self.kv.prefix is not None:
             rest = []
             for r in pulled:
+                if r.grammar is not None:
+                    rest.append(r)  # constrained: full prefill (see above)
+                    continue
                 # mid-prompt seeding is a dense-layout move: a rolling
                 # entry's ring rows are laid out for ITS final length and
                 # cannot serve a shorter prefix — the cache skips the
@@ -3920,6 +4657,11 @@ class LLMEngine:
         # flush the outstanding-work residue (cancel/shed/eos leave some)
         self._load_tokens -= r._load_acct
         r._load_acct = 0
+        if 0 <= r._g_id < len(self._g_refs):
+            # release the resident-grammar reference (the table slot
+            # becomes evictable once no live request holds it)
+            self._g_refs[r._g_id] = max(0, self._g_refs[r._g_id] - 1)
+            r._g_id = -1
         total = None if r.submitted_at is None else now - r.submitted_at
         queue_wait = (
             None if r.admitted_at is None or r.submitted_at is None
@@ -4044,6 +4786,14 @@ class LLMEngine:
             r.out.put(toks)
             r.emitted += len(toks)
             r.history.extend(toks)  # failover continuation seed
+            if r.grammar is not None:
+                # host DFA mirror (drafter filter + continuation re-seed)
+                st = r._g_state
+                for t in toks:
+                    if st < 0:
+                        break
+                    st = r.grammar.advance(st, t)
+                r._g_state = st
             self._load_credit(r, len(toks))
             if self.ledger is not None:
                 self.ledger.charge(r.client, len(toks))
@@ -4099,6 +4849,14 @@ class LLMEngine:
             )
             self._fault("device_step")
             t0 = time.perf_counter()
+            # constrained family when ANY resident request carries a
+            # grammar: per-slot gids mask only their own lanes, so
+            # unconstrained neighbors stay token-identical, and the
+            # device DFA state chain stays coherent across dispatches
+            use_g = self.constrained and self._grammar_live()
+            if use_g:
+                self._ensure_c_ops()
+                gids = self._jnp.asarray(self._gids_np())
             if self.kv.paged:
                 # allocate blocks ahead of the chunk's cursor advance and
                 # build the host liveness mask. Two exclusions: stale
@@ -4123,19 +4881,39 @@ class LLMEngine:
                     self.kv.ensure(i, self._kv_hi[i])
                 td = self._tables_device()
                 with self._hb_dispatch.beat("dispatch:chunk"):
-                    toks, last, self.cache, self._kv_scales, self._rng = (
-                        self._chunk_ops[k](
+                    if use_g:
+                        (
+                            toks, last, self.cache, self._kv_scales,
+                            self._gstate, self._rng,
+                        ) = self._chunk_ops_c[k](
                             self.params, self._tail, self.cache,
                             self._kv_scales, td, self._jnp.asarray(live),
-                            self._active, self._temps, self._rng,
+                            self._active, self._temps, self._gstate,
+                            gids, self._rng, self._gr_dev,
                         )
-                    )
+                    else:
+                        toks, last, self.cache, self._kv_scales, self._rng = (
+                            self._chunk_ops[k](
+                                self.params, self._tail, self.cache,
+                                self._kv_scales, td, self._jnp.asarray(live),
+                                self._active, self._temps, self._rng,
+                            )
+                        )
             else:
                 with self._hb_dispatch.beat("dispatch:chunk"):
-                    toks, last, self.cache, self._rng = self._chunk_ops[k](
-                        self.params, self._tail, self.cache,
-                        self._active, self._temps, self._rng,
-                    )
+                    if use_g:
+                        toks, last, self.cache, self._gstate, self._rng = (
+                            self._chunk_ops_c[k](
+                                self.params, self._tail, self.cache,
+                                self._active, self._temps, self._gstate,
+                                gids, self._rng, self._gr_dev,
+                            )
+                        )
+                    else:
+                        toks, last, self.cache, self._rng = self._chunk_ops[k](
+                            self.params, self._tail, self.cache,
+                            self._active, self._temps, self._rng,
+                        )
             self._tail = last
             self._start_fetch(toks)
             self._inflight.append(("chunk", toks, snapshot, k, t0))
@@ -4220,8 +4998,11 @@ class LLMEngine:
             now = time.perf_counter()
             nb = self._wave_width(len(rows))
             pack = np.zeros((nb, shape + 3), np.int32)
-            meta = np.zeros((2, nb), np.int32)
+            # meta rows 2/3 (grammar id, start DFA state) ride only the
+            # constrained program family; the plain op takes meta[:2]
+            meta = np.zeros((4, nb), np.int32)
             meta[0, :] = self.slots  # pad lanes: inert (scatters dropped)
+            meta[2, :] = -1  # pad/unconstrained lanes: no grammar
             finishes: list[tuple[int, int, GenRequest]] = []
             prefill_tokens = 0
             spans: list[tuple[int, int]] = []  # (cursor, n) for MFU
@@ -4234,6 +5015,14 @@ class LLMEngine:
                 meta[0, j] = r.slot
                 done = pos + n >= len(r.prompt_tokens)
                 meta[1, j] = 1 if done else 0
+                if r.grammar is not None and r._g_id >= 0 and r._g_state >= 0:
+                    # first-token mask + device-state seed for the row's
+                    # slot: fresh requests start at the DFA start state,
+                    # continuations at the host mirror's state (a dead
+                    # mirror — cannot happen while masking holds — keeps
+                    # the lane unconstrained rather than wrong-state)
+                    meta[2, j] = r._g_id
+                    meta[3, j] = r._g_state
                 if r._prefill_t0 is None:
                     r._prefill_t0 = now
                 r.prefill_pos = pos + n
@@ -4257,7 +5046,16 @@ class LLMEngine:
                 if done:
                     r.prefill_done = True
                     finishes.append((j, r.slot, r))
-            op = self._step_ops[shape]
+            use_g = self.constrained and (
+                self._grammar_live()
+                or any(m >= 0 for m in meta[2, : len(rows)])
+            )
+            if use_g:
+                self._ensure_c_ops()
+                op = self._step_ops_c[shape]
+                gids = self._jnp.asarray(self._gids_np())
+            else:
+                op = self._step_ops[shape]
             t0 = time.perf_counter()
             if self.kv.paged:
                 steps_cov = self._inflight_steps()
@@ -4282,20 +5080,41 @@ class LLMEngine:
                         self.kv.ensure(i, self._kv_hi[i])
                 td = self._tables_device()
                 with self._hb_dispatch.beat("dispatch:step"):
-                    (first_dev, logits_dev, toks_dev, last, cache,
-                     self._kv_scales, active, temps, rng) = op(
-                        self.params, self.cache, self._kv_scales, td,
-                        jnp.asarray(live), self._tail, self._active,
-                        self._temps, jnp.asarray(pack), jnp.asarray(meta),
-                        self._rng,
-                    )
+                    if use_g:
+                        (first_dev, logits_dev, toks_dev, last, cache,
+                         self._kv_scales, active, temps, self._gstate,
+                         rng) = op(
+                            self.params, self.cache, self._kv_scales, td,
+                            jnp.asarray(live), self._tail, self._active,
+                            self._temps, self._gstate, jnp.asarray(pack),
+                            jnp.asarray(meta), gids, self._rng,
+                            self._gr_dev,
+                        )
+                    else:
+                        (first_dev, logits_dev, toks_dev, last, cache,
+                         self._kv_scales, active, temps, rng) = op(
+                            self.params, self.cache, self._kv_scales, td,
+                            jnp.asarray(live), self._tail, self._active,
+                            self._temps, jnp.asarray(pack),
+                            jnp.asarray(meta[:2]), self._rng,
+                        )
             else:
                 with self._hb_dispatch.beat("dispatch:step"):
-                    first_dev, logits_dev, toks_dev, last, cache, active, temps, rng = op(
-                        self.params, self.cache, self._tail, self._active,
-                        self._temps, jnp.asarray(pack), jnp.asarray(meta),
-                        self._rng,
-                    )
+                    if use_g:
+                        (first_dev, logits_dev, toks_dev, last, cache,
+                         active, temps, self._gstate, rng) = op(
+                            self.params, self.cache, self._tail,
+                            self._active, self._temps, self._gstate,
+                            jnp.asarray(pack), jnp.asarray(meta), gids,
+                            self._rng, self._gr_dev,
+                        )
+                    else:
+                        (first_dev, logits_dev, toks_dev, last, cache,
+                         active, temps, rng) = op(
+                            self.params, self.cache, self._tail,
+                            self._active, self._temps, jnp.asarray(pack),
+                            jnp.asarray(meta[:2]), self._rng,
+                        )
             self._tail = last
             self.cache, self._active, self._temps, self._rng = (
                 cache, active, temps, rng,
@@ -4393,6 +5212,56 @@ class LLMEngine:
         stream = r.prompt_tokens + r.history + r._spec_pending
         d_full = self.drafter.draft(stream, k + 1)
         d = d_full[:k]
+        if r.grammar is not None:
+            # grammar-aware drafting (docs/advanced-guide/
+            # structured-decoding.md), two moves on the host DFA mirror
+            # advanced over the optimistic pending spans:
+            # 1. FILTER — an inadmissible proposal is GUARANTEED
+            #    rejection (the verify's masked sample cannot equal it),
+            #    so cut the draft at the first token the DFA refuses;
+            # 2. FAST-FORWARD — wherever the grammar admits EXACTLY ONE
+            #    token (fixed property names, structural punctuation,
+            #    literal tails), that token is a guaranteed-accept draft
+            #    position: extend the draft through forced runs even
+            #    when the n-gram drafter proposed nothing. This is what
+            #    lifts constrained acceptance above the unconstrained
+            #    baseline on schema-shaped output.
+            st = r._g_state
+            for t in r._spec_pending:
+                if st < 0:
+                    break
+                st = r.grammar.advance(st, t)
+            g_bonus: list[int] = []
+            if st < 0:
+                d = []
+            else:
+                d = r.grammar.filter_draft(st, d)
+                s = st
+                for t in d:
+                    s = r.grammar.advance(s, t)
+                while len(d) < k and s >= 0:
+                    forced = np.flatnonzero(r.grammar.allowed(s))
+                    if len(forced) != 1:
+                        break
+                    t = int(forced[0])
+                    d.append(t)
+                    s = r.grammar.advance(s, t)
+                if d and s >= 0:
+                    # grammar-forced BONUS aim: when the state after the
+                    # draft admits exactly one token, the verify's bonus
+                    # sample IS that token — a certain prediction keeps
+                    # the optimistic pending stream (hence the next
+                    # pipelined verify's drafts) on target
+                    forced = np.flatnonzero(r.grammar.allowed(s))
+                    if len(forced) == 1:
+                        g_bonus = [int(forced[0])]
+            if not d:
+                r._spec_plain += 1
+                return [], [stream[-1] if stream else 0]
+            bonus = g_bonus or (
+                (d_full[k : k + 1] if len(d) == k else d[-1:]) or d[-1:]
+            )
+            return d, d + bonus
         if not d:
             r._spec_plain += 1
             return [], [stream[-1] if stream else 0]
@@ -4490,6 +5359,15 @@ class LLMEngine:
                 r._spec_inflight += 1
                 if not n_draft[slot]:
                     self.spec_plain += 1
+            # constrained split: acceptance on grammar-masked text is the
+            # structured-decoding bench signal (drafts were pre-filtered
+            # by the DFA in _spec_drafts, so acceptance should not drop)
+            gset = {slot for slot, r in sel if r.grammar is not None}
+            proposed_c = sum(n_draft[s] for s in gset)
+            use_g = self.constrained and self._grammar_live()
+            if use_g:
+                self._ensure_c_ops()
+                gids_dev = jnp.asarray(self._gids_np())
             t0 = time.perf_counter()
             if self.kv.paged:
                 # blocks for the verify's transient rows: [length,
@@ -4505,19 +5383,38 @@ class LLMEngine:
                     self.kv.ensure(slot, self._kv_hi[slot])
                 td = self._tables_device()
                 with self._hb_dispatch.beat("dispatch:verify"):
-                    ys, acc, cache, self._kv_scales, tail, self._rng = (
-                        self._verify_op(
+                    if use_g:
+                        (ys, acc, cache, self._kv_scales, tail,
+                         self._gstate, self._rng) = self._verify_op_c(
                             self.params, self.cache, self._kv_scales, td,
-                            self._tail, self._temps, jnp.asarray(pack),
-                            self._rng,
+                            self._tail, self._temps, self._gstate,
+                            jnp.asarray(pack), gids_dev, self._rng,
+                            self._gr_dev,
                         )
-                    )
+                    else:
+                        ys, acc, cache, self._kv_scales, tail, self._rng = (
+                            self._verify_op(
+                                self.params, self.cache, self._kv_scales,
+                                td, self._tail, self._temps,
+                                jnp.asarray(pack), self._rng,
+                            )
+                        )
             else:
                 with self._hb_dispatch.beat("dispatch:verify"):
-                    ys, acc, cache, tail, self._rng = self._verify_op(
-                        self.params, self.cache, self._tail, self._temps,
-                        jnp.asarray(pack), self._rng,
-                    )
+                    if use_g:
+                        ys, acc, cache, tail, self._gstate, self._rng = (
+                            self._verify_op_c(
+                                self.params, self.cache, self._tail,
+                                self._temps, self._gstate,
+                                jnp.asarray(pack), gids_dev, self._rng,
+                                self._gr_dev,
+                            )
+                        )
+                    else:
+                        ys, acc, cache, tail, self._rng = self._verify_op(
+                            self.params, self.cache, self._tail,
+                            self._temps, jnp.asarray(pack), self._rng,
+                        )
             self.cache, self._tail = cache, tail
             self._start_fetch(ys)
             self._start_fetch(acc)
@@ -4525,17 +5422,25 @@ class LLMEngine:
             info = {
                 "t0": t0, "W": W, "proposed": proposed,
                 "n_draft": n_draft, "cursors": cursors, "pred": pred,
+                "gset": gset,
             }
             self._inflight.append(("verify", ys, acc, sel, info))
             self.spec_steps += 1
             self.spec_proposed += proposed
+            self.spec_proposed_c += proposed_c
             self._stat_steps += 1
             self._stat_step_tokens += step_tokens
             if self.metrics is not None:
-                if proposed:
+                if proposed - proposed_c:
                     self.metrics.increment_counter(
-                        "app_llm_spec_proposed_total", by=float(proposed),
-                        model=self.label,
+                        "app_llm_spec_proposed_total",
+                        by=float(proposed - proposed_c),
+                        model=self.label, constrained="0",
+                    )
+                if proposed_c:
+                    self.metrics.increment_counter(
+                        "app_llm_spec_proposed_total", by=float(proposed_c),
+                        model=self.label, constrained="1",
                     )
                 self.metrics.record_histogram(
                     "app_llm_step_tokens", float(step_tokens),
@@ -4815,14 +5720,19 @@ class LLMEngine:
         accepted_total = 0
         spans: list[tuple[int, int]] = []
         ctx_sum = 0
+        gset = info.get("gset") or set()
+        accepted_c = 0
         for slot, _r in sel:
             n = int(acc[slot]) + 1
             emitted_total += n
             accepted_total += int(acc[slot])
+            if slot in gset:
+                accepted_c += int(acc[slot])
             cur = info["cursors"].get(slot, 0)
             spans.append((cur, n))
             ctx_sum += min(cur, w) if w else cur
         self.spec_accepted += accepted_total
+        self.spec_accepted_c += accepted_c
         self._observe_tput(emitted_total, dt)
         self._phases["step"].observe(dt)
         # per-token cadence the accepted spans actually delivered
@@ -4839,10 +5749,16 @@ class LLMEngine:
             dt=dt,
         )
         if self.metrics is not None:
-            if accepted_total:
+            if accepted_total - accepted_c:
                 self.metrics.increment_counter(
                     "app_llm_spec_accepted_total",
-                    by=float(accepted_total), model=self.label,
+                    by=float(accepted_total - accepted_c),
+                    model=self.label, constrained="0",
+                )
+            if accepted_c:
+                self.metrics.increment_counter(
+                    "app_llm_spec_accepted_total",
+                    by=float(accepted_c), model=self.label, constrained="1",
                 )
             self.metrics.set_gauge(
                 "app_llm_spec_accept_rate",
@@ -5513,6 +6429,14 @@ class ReplicatedLLMEngine:
                 _os.environ.get("TPU_LLM_FLEET_MAX_QUEUE_TOKENS", "0") or 0
             )
         self.fleet_max_queue_tokens = max(0, int(fleet_max_queue_tokens))
+        # batch-class headroom factor: batch work sheds at this fraction
+        # of the fleet cap, so the LAST slice of fleet queue capacity is
+        # reserved for interactive traffic — shed the reservoir before
+        # the latency-sensitive class ever sees a 429
+        # (docs/advanced-guide/overload.md + batch-inference.md)
+        self.fleet_batch_factor = min(1.0, max(0.0, float(
+            _os.environ.get("TPU_LLM_FLEET_BATCH_FACTOR", "0.8") or 0.8
+        )))
         self.fleet_rejected = 0
         # Retry budget: router-side retries (failover re-dispatch,
         # replica death between pick and submit) draw from a token
@@ -5944,7 +6868,13 @@ class ReplicatedLLMEngine:
             queued = sum(
                 e.load_tokens() for e in self.engines if e.accepting()
             )
-            if queued >= self.fleet_max_queue_tokens:
+            # batch sheds FIRST: the throughput class hits a lowered cap
+            # (fleet_batch_factor) so the top slice of queue capacity
+            # stays reserved for interactive traffic under pressure
+            cap = self.fleet_max_queue_tokens
+            if req.priority == "batch":
+                cap = int(cap * self.fleet_batch_factor)
+            if queued >= cap:
                 self.fleet_rejected += 1
                 if self.metrics is not None:
                     # its own series, NOT app_llm_sheds_predicted_total:
@@ -5955,8 +6885,9 @@ class ReplicatedLLMEngine:
                         "app_llm_fleet_rejected_total", model=self.label
                     )
                 raise EngineOverloaded(
-                    f"fleet queue full ({queued} >= "
-                    f"{self.fleet_max_queue_tokens} queued tokens)",
+                    f"fleet queue full ({queued} >= {cap} queued tokens"
+                    + (" at batch-class headroom)" if cap
+                       < self.fleet_max_queue_tokens else ")"),
                     retry_after=self._fleet_retry_after(queued),
                 )
         # Error classification (docs/advanced-guide/overload.md):

@@ -501,6 +501,7 @@ def decode_chunk(
     unroll: int = 1,  # outer-scan unroll (XLA overlaps step boundaries)
     ring: int = 0,  # >0: cache is a rolling ring of this capacity (kvcache)
     overlap=None,  # TP collective-compute overlap (see _layer_scan)
+    sample_state=None,  # stateful sampler: carried pytree (see below)
 ) -> tuple[jnp.ndarray, jnp.ndarray, KVCache, jax.Array]:
     """n_steps fused decode steps — the serving engine's hot loop.
 
@@ -527,7 +528,16 @@ def decode_chunk(
     Requires cfg.sliding_window > 0 and ring >= sliding_window + n_steps
     so a merge can never overwrite a row still inside any later window.
 
-    Returns (tokens [n_steps, b], last [b], new cache, rng).
+    With ``sample_state`` (any pytree), the sampler is STATEFUL:
+    ``sample_fn(logits, temps, key, state) -> (tokens, state)`` and the
+    state threads through the chunk's scan — this is the seam
+    grammar-constrained decoding rides (gofr_tpu.structured: per-slot
+    DFA states advance with each sampled token INSIDE the fused chunk,
+    where the host cannot see intermediate tokens). The final state is
+    appended to the return tuple.
+
+    Returns (tokens [n_steps, b], last [b], new cache, rng)
+    [+ sample_state when one was passed].
     """
     L, b = cfg.n_layers, tokens.shape[0]
     hq, hkv, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
@@ -538,7 +548,7 @@ def decode_chunk(
     rng, sub = jax.random.split(rng)
     keys = jax.random.split(sub, K)
     def step(carry, inp):
-        tok, kb, vb = carry
+        tok, kb, vb, sstate = carry
         k_i, key = inp
         positions = (cache.length + k_i)[:, None]  # [b, 1]
         x = _embed_tokens(params, cfg, tok[:, None])
@@ -581,11 +591,16 @@ def decode_chunk(
             overlap=overlap,
         )
         logits = _unembed_last(params, cfg, x)
-        nt = sample_fn(logits, temps, key).astype(jnp.int32)
-        return (nt, kb, vb), nt
+        if sample_state is None:
+            nt = sample_fn(logits, temps, key).astype(jnp.int32)
+        else:
+            nt, sstate = sample_fn(logits, temps, key, sstate)
+            nt = nt.astype(jnp.int32)
+        return (nt, kb, vb, sstate), nt
 
-    (last, kb, vb), toks = jax.lax.scan(
-        step, (tokens, kb0, vb0), (jnp.arange(K, dtype=jnp.int32), keys),
+    (last, kb, vb, out_state), toks = jax.lax.scan(
+        step, (tokens, kb0, vb0, sample_state),
+        (jnp.arange(K, dtype=jnp.int32), keys),
         unroll=unroll,
     )
 
@@ -608,7 +623,8 @@ def decode_chunk(
         # lengths stay ABSOLUTE (positions/RoPE/window math need them);
         # the engine's submit() cap bounds them by max_seq_len
         new_len = jnp.where(active, cache.length + K, cache.length)
-        return toks, last, KVCache(k=new_k, v=new_v, length=new_len), rng
+        out = (toks, last, KVCache(k=new_k, v=new_v, length=new_len), rng)
+        return out if sample_state is None else out + (out_state,)
 
     # merge: one scatter per chunk. Inactive slots write garbage rows at a
     # clamped in-bounds start — harmless, their rows sit beyond the valid
@@ -621,7 +637,8 @@ def decode_chunk(
     new_k = merge(cache.k, kb, start)
     new_v = merge(cache.v, vb, start)
     new_len = jnp.where(active, jnp.minimum(cache.length + K, max_len), cache.length)
-    return toks, last, KVCache(k=new_k, v=new_v, length=new_len), rng
+    out = (toks, last, KVCache(k=new_k, v=new_v, length=new_len), rng)
+    return out if sample_state is None else out + (out_state,)
 
 
 def decode_chunk_paged(
@@ -641,6 +658,7 @@ def decode_chunk_paged(
     use_kernel: bool | None = None,
     interpret: bool = False,
     overlap=None,  # TP collective-compute overlap (see _layer_scan)
+    sample_state=None,  # stateful sampler (see decode_chunk)
 ) -> tuple[jnp.ndarray, jnp.ndarray, KVCache, jnp.ndarray | None, jax.Array]:
     """decode_chunk against a BLOCK-PAGED pool (gofr_tpu.kvcache.paged).
 
@@ -678,7 +696,7 @@ def decode_chunk_paged(
     vs_all = scales[1] if quant else None
 
     def step(carry, inp):
-        tok, kb, vb = carry
+        tok, kb, vb, sstate = carry
         k_i, key = inp
         positions = (pool.length + k_i)[:, None]  # [b, 1]
         x = _embed_tokens(params, cfg, tok[:, None])
@@ -729,11 +747,16 @@ def decode_chunk_paged(
             params["layers"], layer, x, rest, overlap=overlap
         )
         logits = _unembed_last(params, cfg, x)
-        nt = sample_fn(logits, temps, key).astype(jnp.int32)
-        return (nt, kb, vb), nt
+        if sample_state is None:
+            nt = sample_fn(logits, temps, key).astype(jnp.int32)
+        else:
+            nt, sstate = sample_fn(logits, temps, key, sstate)
+            nt = nt.astype(jnp.int32)
+        return (nt, kb, vb, sstate), nt
 
-    (last, kb, vb), toks = jax.lax.scan(
-        step, (tokens, kb0, vb0), (jnp.arange(K, dtype=jnp.int32), keys)
+    (last, kb, vb, out_state), toks = jax.lax.scan(
+        step, (tokens, kb0, vb0, sample_state),
+        (jnp.arange(K, dtype=jnp.int32), keys),
     )
 
     # merge: the chunk's K rows scatter through the table at positions
@@ -747,10 +770,11 @@ def decode_chunk_paged(
         scales=(scales if quant else None),
     )
     new_len = jnp.where(active, jnp.minimum(pool.length + K, cap), pool.length)
-    return (
+    out = (
         toks, last, KVCache(k=k2, v=v2, length=new_len),
         (sc2 if quant else scales), rng,
     )
+    return out if sample_state is None else out + (out_state,)
 
 
 def _append_forward(

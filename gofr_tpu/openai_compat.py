@@ -1,0 +1,418 @@
+"""OpenAI-compatible serving edge: /v1/chat/completions + /v1/embeddings.
+
+The serving stack's native surface is its own (GenRequest over
+HTTP/gRPC with X-GoFr-* headers). The rest of the world speaks the
+OpenAI wire format — client SDKs, eval harnesses, load tools, gateway
+routers. This module maps that dialect onto the registry/handle surface
+so an UNMODIFIED OpenAI client works against any registered model,
+directly or through the front-router tier (the router proxies /v1/* like
+any other route):
+
+- ``POST /v1/chat/completions`` — messages -> chat-templated prompt ->
+  engine stream; ``stream: true`` answers Server-Sent Events
+  (``data: {chunk}\\n\\n`` ... ``data: [DONE]\\n\\n``); ``response_format
+  {"type": "json_schema"}`` compiles the attached schema to a token
+  grammar (gofr_tpu.structured) so the answer is schema-valid BY
+  CONSTRUCTION, not by retry.
+- ``POST /v1/embeddings`` — mean-pooled model embedding rows,
+  L2-normalized; accepts a string, a list of strings, or token-id lists.
+- ``GET /v1/models`` — the registered model list.
+
+Identity mapping: the OpenAI ``user`` field and the native
+``X-GoFr-Client``/``X-GoFr-Priority``/``X-GoFr-Session`` headers both
+feed the fair-queuing/overload machinery (handler.llm_request_kwargs);
+429/503 responses carry Retry-After exactly like the native edge.
+
+Tokenization: pass a tokenizer (models.tokenizer.Tokenizer or anything
+with encode/decode/eos_id); without one the edge falls back to the
+dependency-free byte-level tokenizer when the model's vocab admits it
+(vocab_size >= 258), else text routes 400. Errors answer the OpenAI
+error envelope ``{"error": {"message", "type", "code"}}``.
+
+Knobs (docs/references/configs.md): ``GOFR_OPENAI_MODEL`` (served model
+name when the request omits/mismatches), ``GOFR_OPENAI_MAX_TOKENS``
+(default + cap for max_tokens), ``GOFR_OPENAI_STREAM_TIMEOUT_S``.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import uuid
+from typing import Any
+
+import numpy as np
+
+from .http.responder import Response, StreamingResponse, to_json_bytes
+
+__all__ = ["register_openai_routes", "chat_prompt"]
+
+
+def _openai_error(status: int, message: str, *, etype: str = "invalid_request_error",
+                  code: str | None = None, retry_after: float | None = None):
+    """An HTTPError whose body respond() serializes is GoFr's envelope;
+    OpenAI clients want their own — so the edge raises THIS, a Response
+    carrier the handlers return directly."""
+    headers = [("Content-Type", "application/json")]
+    if retry_after is not None and retry_after > 0:
+        import math
+
+        headers.append(("Retry-After", str(max(1, math.ceil(retry_after)))))
+    return Response(status, headers, to_json_bytes({
+        "error": {"message": message, "type": etype, "code": code},
+    }))
+
+
+def chat_prompt(messages: list[dict]) -> str:
+    """Minimal chat template: role-tagged turns plus the assistant
+    cue. Checkpoint-specific templates (Gemma/Llama control tokens)
+    belong to the operator's tokenizer assets; this neutral form keeps
+    the wire contract model-independent."""
+    parts = []
+    for m in messages:
+        role = str(m.get("role", "user"))
+        content = m.get("content", "")
+        if isinstance(content, list):  # OpenAI content-part arrays
+            content = "".join(
+                p.get("text", "") for p in content if isinstance(p, dict)
+            )
+        parts.append(f"<|{role}|>\n{content}\n")
+    parts.append("<|assistant|>\n")
+    return "".join(parts)
+
+
+def register_openai_routes(
+    app,
+    model: str = "",
+    *,
+    tokenizer: Any = None,
+    served_name: str | None = None,
+) -> None:
+    """Register the OpenAI-compatible routes on a gofr_tpu App serving
+    the registered LLM ``model`` (default: GOFR_OPENAI_MODEL, else the
+    single registered model)."""
+    cfg = app.config
+    default_model = model or cfg.get_or_default("GOFR_OPENAI_MODEL", "")
+    max_tokens_cap = int(cfg.get_or_default("GOFR_OPENAI_MAX_TOKENS", "512"))
+    stream_timeout = float(
+        cfg.get_or_default("GOFR_OPENAI_STREAM_TIMEOUT_S", "120")
+    )
+    # per-MODEL caches: the routes dispatch on the request's model field
+    # across every registered LLM, and vocabularies differ per model — a
+    # shared cache would compile grammars over the wrong vocab. An
+    # explicit `tokenizer=` applies to every model (single-model apps).
+    state: dict[str, Any] = {"tok": {}, "embed": None, "vocab": {}}
+
+    def _handle(ctx, name: str = ""):
+        rt = ctx.container.tpu()
+        llms = getattr(rt, "_llms", {})
+        want = name or default_model
+        if want and want in llms:
+            return want, llms[want]
+        if llms:
+            first = next(iter(llms))
+            return first, llms[first]
+        raise KeyError("no LLM registered")
+
+    def _tokenizer(name: str, handle):
+        if tokenizer is not None:
+            return tokenizer
+        cached = state["tok"].get(name)
+        if cached is not None:
+            return cached
+        vocab = handle.engine.cfg.vocab_size if hasattr(handle, "engine") else (
+            handle.cfg.vocab_size
+        )
+        if vocab >= 258:
+            from .models.tokenizer import ByteTokenizer
+
+            state["tok"][name] = ByteTokenizer(vocab)
+            return state["tok"][name]
+        return None
+
+    def _grammar(name: str, tok, response_format):
+        if not response_format:
+            return None
+        ftype = response_format.get("type")
+        if ftype in (None, "text"):
+            return None
+        if ftype != "json_schema":
+            raise _OpenAIReject(_openai_error(
+                400,
+                f"response_format type {ftype!r} unsupported; use "
+                "'json_schema' (a full free-form 'json_object' grammar "
+                "needs a pushdown automaton, not a DFA)",
+            ))
+        spec = response_format.get("json_schema") or {}
+        schema = spec.get("schema", spec if "properties" in spec else None)
+        if schema is None:
+            raise _OpenAIReject(_openai_error(
+                400, "response_format.json_schema.schema missing",
+            ))
+        if tok is None:
+            raise _OpenAIReject(_openai_error(
+                400, "json_schema needs a tokenizer on this deployment",
+            ))
+        from .structured import (
+            JsonSchemaError,
+            grammar_cache,
+            vocab_from_tokenizer,
+        )
+
+        if name not in state["vocab"]:
+            state["vocab"][name] = vocab_from_tokenizer(tok)
+        eos = getattr(tok, "eos_id", None)
+        if eos is None:
+            raise _OpenAIReject(_openai_error(
+                400, "tokenizer exposes no eos; cannot close a grammar",
+            ))
+        try:
+            return grammar_cache.get(schema, state["vocab"][name], int(eos))
+        except JsonSchemaError as e:
+            raise _OpenAIReject(_openai_error(400, str(e))) from e
+
+    class _OpenAIReject(Exception):
+        def __init__(self, resp: Response):
+            self.resp = resp
+
+    def _gen_kwargs(ctx, body) -> dict:
+        from .handler import llm_request_kwargs
+
+        kw = llm_request_kwargs(ctx)
+        user = body.get("user")
+        if user and not kw.get("client"):
+            kw["client"] = str(user)
+        return kw
+
+    def _submit(ctx, name, handle, body, tok):
+        from .llm import EngineDraining, EngineOverloaded, GenRequest
+
+        messages = body.get("messages")
+        if not isinstance(messages, list) or not messages:
+            raise _OpenAIReject(_openai_error(400, "messages must be a non-empty list"))
+        if body.get("n", 1) not in (1, None):
+            raise _OpenAIReject(_openai_error(400, "n > 1 unsupported"))
+        grammar = _grammar(name, tok, body.get("response_format"))
+        if tok is None:
+            raise _OpenAIReject(_openai_error(
+                400,
+                "no tokenizer on this deployment and the model vocabulary "
+                "is too small for the byte fallback; send token ids via "
+                "the native /generate surface",
+            ))
+        prompt = chat_prompt(messages)
+        toks = tok.encode(prompt)
+        max_new = int(
+            body.get("max_completion_tokens")
+            or body.get("max_tokens")
+            or min(64, max_tokens_cap)
+        )
+        max_new = max(1, min(max_new, max_tokens_cap))
+        eos = grammar.eos_id if grammar is not None else (
+            tok.eos_id if tok.eos_id is not None else -1
+        )
+        req = GenRequest(
+            toks,
+            max_new_tokens=max_new,
+            temperature=float(body.get("temperature") or 0.0),
+            eos_token=eos,
+            grammar=grammar,
+            **_gen_kwargs(ctx, body),
+        )
+        try:
+            return handle.submit(req), len(toks)
+        except (EngineOverloaded, EngineDraining) as e:
+            status = 429 if isinstance(e, EngineOverloaded) else 503
+            raise _OpenAIReject(_openai_error(
+                status, str(e), etype="rate_limit_error" if status == 429
+                else "service_unavailable",
+                retry_after=getattr(e, "retry_after", None),
+            )) from e
+        except ValueError as e:
+            raise _OpenAIReject(_openai_error(400, str(e))) from e
+
+    def _finish(reason: str | None) -> str:
+        return "stop" if reason == "eos" else (
+            "length" if reason in ("length", None) else str(reason)
+        )
+
+    async def chat_completions(ctx):
+        body = ctx.bind()
+        if not isinstance(body, dict):
+            return _openai_error(400, "body must be a JSON object")
+        try:
+            name, handle = _handle(ctx, str(body.get("model") or ""))
+        except KeyError as e:
+            return _openai_error(404, str(e), etype="not_found_error")
+        tok = _tokenizer(name, handle)
+        try:
+            req, n_prompt = _submit(ctx, name, handle, body, tok)
+        except _OpenAIReject as e:
+            return e.resp
+        cid = f"chatcmpl-{uuid.uuid4().hex[:16]}"
+        created = int(time.time())
+        base = {"id": cid, "created": created, "model": name}
+        eos_id = req.eos_token
+
+        if body.get("stream"):
+            async def sse():
+                emitted: list[int] = []
+                prev = ""
+                head = {
+                    **base,
+                    "object": "chat.completion.chunk",
+                    "choices": [{
+                        "index": 0,
+                        "delta": {"role": "assistant", "content": ""},
+                        "finish_reason": None,
+                    }],
+                }
+                yield b"data: " + to_json_bytes(head) + b"\n\n"
+                # a vanished client GeneratorExits the async-for; astream's
+                # disconnect hook cancels the engine request and the exit
+                # propagates — only a normally-finished stream reaches the
+                # terminal chunk below
+                async for t in req.astream(timeout=stream_timeout):
+                    if t == eos_id:
+                        continue
+                    emitted.append(t)
+                    text = tok.decode(emitted)
+                    delta, prev = text[len(prev):], text
+                    if not delta:
+                        continue  # partial multi-byte sequence
+                    chunk = {
+                        **base,
+                        "object": "chat.completion.chunk",
+                        "choices": [{
+                            "index": 0,
+                            "delta": {"content": delta},
+                            "finish_reason": None,
+                        }],
+                    }
+                    yield b"data: " + to_json_bytes(chunk) + b"\n\n"
+                tail = {
+                    **base,
+                    "object": "chat.completion.chunk",
+                    "choices": [{
+                        "index": 0,
+                        "delta": {},
+                        "finish_reason": _finish(req.finish_reason),
+                    }],
+                }
+                yield b"data: " + to_json_bytes(tail) + b"\n\n"
+                yield b"data: [DONE]\n\n"
+
+            return StreamingResponse(sse(), content_type="text/event-stream")
+
+        import asyncio
+
+        loop = asyncio.get_running_loop()
+        out = await loop.run_in_executor(
+            None, lambda: req.tokens(timeout=stream_timeout)
+        )
+        content_ids = [t for t in out if t != eos_id]
+        payload = {
+            **base,
+            "object": "chat.completion",
+            "choices": [{
+                "index": 0,
+                "message": {
+                    "role": "assistant",
+                    "content": tok.decode(content_ids),
+                },
+                "finish_reason": _finish(req.finish_reason),
+            }],
+            "usage": {
+                "prompt_tokens": n_prompt,
+                "completion_tokens": len(out),
+                "total_tokens": n_prompt + len(out),
+            },
+        }
+        return Response(
+            200, [("Content-Type", "application/json")], to_json_bytes(payload)
+        )
+
+    def embeddings(ctx):
+        body = ctx.bind()
+        if not isinstance(body, dict):
+            return _openai_error(400, "body must be a JSON object")
+        try:
+            name, handle = _handle(ctx, str(body.get("model") or ""))
+        except KeyError as e:
+            return _openai_error(404, str(e), etype="not_found_error")
+        raw = body.get("input")
+        if raw is None:
+            return _openai_error(400, "input missing")
+        items = raw if isinstance(raw, list) else [raw]
+        if items and isinstance(items[0], int):
+            items = [items]  # single token-id list
+        tok = _tokenizer(name, handle)
+        if state["embed"] is None or state["embed"][0] != name:
+            # the model's own embedding matrix, fetched ONCE to host:
+            # mean-pooled input embeddings are the classic cheap text
+            # representation and reuse the served weights verbatim
+            eng = getattr(handle, "engine", handle)
+            emb = np.asarray(eng.params["embed"], dtype=np.float32)
+            state["embed"] = (name, emb)
+        emb = state["embed"][1]
+        data = []
+        total_toks = 0
+        for i, item in enumerate(items):
+            if isinstance(item, str):
+                if tok is None:
+                    return _openai_error(
+                        400, "text input needs a tokenizer on this deployment"
+                    )
+                ids = tok.encode(item, add_bos=False) if hasattr(
+                    tok, "encode"
+                ) else []
+            elif isinstance(item, list) and all(
+                isinstance(t, int) for t in item
+            ):
+                ids = item
+            else:
+                return _openai_error(400, f"input[{i}] must be text or token ids")
+            ids = [t for t in ids if 0 <= t < emb.shape[0]] or [0]
+            total_toks += len(ids)
+            v = emb[ids].mean(axis=0)
+            norm = float(np.linalg.norm(v))
+            if norm > 1e-12:
+                v = v / norm
+            data.append({
+                "object": "embedding",
+                "index": i,
+                "embedding": [float(x) for x in v],
+            })
+        return Response(200, [("Content-Type", "application/json")], to_json_bytes({
+            "object": "list",
+            "data": data,
+            "model": name,
+            "usage": {"prompt_tokens": total_toks, "total_tokens": total_toks},
+        }))
+
+    def list_models(ctx):
+        rt = ctx.container.tpu()
+        llms = getattr(rt, "_llms", {})
+        return Response(200, [("Content-Type", "application/json")], to_json_bytes({
+            "object": "list",
+            "data": [
+                {
+                    "id": served_name or name,
+                    "object": "model",
+                    "created": 0,
+                    "owned_by": "gofr_tpu",
+                }
+                for name in llms
+            ],
+        }))
+
+    # chat completions get their own timeout budget: a non-streaming
+    # generation legitimately runs past the API-SLO REQUEST_TIMEOUT (the
+    # profile/rollout route precedent), and a streaming handler only
+    # needs it to OBTAIN the generator
+    app._add(
+        "POST", "/v1/chat/completions", chat_completions,
+        timeout_s=max(stream_timeout, app.request_timeout),
+    )
+    app.post("/v1/embeddings", embeddings)
+    app.get("/v1/models", list_models)

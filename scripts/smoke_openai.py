@@ -1,0 +1,179 @@
+#!/usr/bin/env python
+"""CI smoke: the OpenAI-compatible edge over raw HTTP, direct AND
+through the front-router tier.
+
+Boots the grpc-gemma example app (tiny preset, CPU backend — text served
+through the built-in byte-level tokenizer) plus a front-router process
+in front of it, then speaks the RAW OpenAI wire format (no SDK) against
+BOTH base URLs:
+
+- POST /v1/chat/completions non-streaming: spec-shaped body (object,
+  choices[0].message, usage arithmetic),
+- POST /v1/chat/completions stream=true: Content-Type text/event-stream,
+  well-formed `data:` chunks, terminal finish_reason + [DONE],
+- response_format {"type": "json_schema"}: the content parses as JSON
+  AND validates against the requested schema (by-construction guarantee
+  end-to-end through the wire),
+- POST /v1/embeddings + GET /v1/models shapes,
+- 400 with an OpenAI error envelope for a bad schema.
+
+Usage: JAX_PLATFORMS=cpu python scripts/smoke_openai.py
+Exit codes: 0 clean, non-zero assertion failure (message on stderr).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import urllib.error
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "examples", "grpc-gemma"))
+
+os.environ.setdefault("GEMMA_PRESET", "tiny")
+os.environ.setdefault("LOG_LEVEL", "ERROR")
+os.environ.setdefault("TRACE_EXPORTER", "none")
+os.environ.setdefault("TPU_TELEMETRY_INTERVAL_S", "0")
+os.environ.setdefault("HTTP_PORT", "0")
+os.environ.setdefault("METRICS_PORT", "0")
+os.environ.setdefault("GRPC_PORT", "0")
+
+SCHEMA = {
+    "type": "object",
+    "properties": {
+        "city": {"type": "string", "maxLength": 8},
+        "population": {"type": "integer"},
+    },
+}
+
+
+def _post(base: str, path: str, body: dict, timeout: float = 120.0):
+    req = urllib.request.Request(
+        f"{base}{path}", data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"}, method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+def _validate(obj, schema) -> None:
+    """Minimal hand-rolled validation (jsonschema when present)."""
+    try:
+        import jsonschema
+    except ImportError:
+        assert isinstance(obj, dict)
+        for k, v in obj.items():
+            want = schema["properties"][k]["type"]
+            assert {"string": str, "integer": int}[want] is type(v)
+        return
+    jsonschema.validate(obj, schema)
+
+
+def _drive(base: str, label: str) -> None:
+    # 1. non-streaming chat
+    status, out = _post(base, "/v1/chat/completions", {
+        "model": "gemma",
+        "messages": [{"role": "user", "content": "hello"}],
+        "max_tokens": 8,
+    })
+    assert status == 200 and out["object"] == "chat.completion", out
+    msg = out["choices"][0]["message"]
+    assert msg["role"] == "assistant" and isinstance(msg["content"], str)
+    u = out["usage"]
+    assert u["total_tokens"] == u["prompt_tokens"] + u["completion_tokens"]
+
+    # 2. SSE streaming
+    req = urllib.request.Request(
+        f"{base}/v1/chat/completions",
+        data=json.dumps({
+            "messages": [{"role": "user", "content": "hi"}],
+            "max_tokens": 6, "stream": True,
+        }).encode(),
+        headers={"Content-Type": "application/json"}, method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=120) as resp:
+        ct = resp.headers.get("Content-Type", "")
+        assert ct.startswith("text/event-stream"), ct
+        raw = resp.read().decode()
+    events = [
+        ln[len("data: "):] for ln in raw.split("\n") if ln.startswith("data: ")
+    ]
+    assert events and events[-1] == "[DONE]", events[-3:]
+    chunks = [json.loads(e) for e in events[:-1]]
+    assert all(c["object"] == "chat.completion.chunk" for c in chunks)
+    assert chunks[-1]["choices"][0]["finish_reason"] in ("stop", "length")
+
+    # 3. schema-constrained response validates
+    status, out = _post(base, "/v1/chat/completions", {
+        "messages": [{"role": "user", "content": "Name a city"}],
+        "max_tokens": 220,
+        "response_format": {
+            "type": "json_schema",
+            "json_schema": {"name": "city", "schema": SCHEMA},
+        },
+    })
+    assert status == 200, out
+    content = out["choices"][0]["message"]["content"]
+    _validate(json.loads(content), SCHEMA)
+    assert out["choices"][0]["finish_reason"] == "stop", out["choices"][0]
+
+    # 4. embeddings + models
+    status, emb = _post(base, "/v1/embeddings", {"input": ["hello", "hi"]})
+    assert status == 200 and emb["object"] == "list" and len(emb["data"]) == 2
+    with urllib.request.urlopen(f"{base}/v1/models", timeout=30) as resp:
+        models = json.loads(resp.read())
+    assert any(m["id"] == "gemma" for m in models["data"]), models
+
+    # 5. bad schema -> 400 with the OpenAI error envelope
+    try:
+        _post(base, "/v1/chat/completions", {
+            "messages": [{"role": "user", "content": "x"}],
+            "response_format": {
+                "type": "json_schema",
+                "json_schema": {"schema": {"type": "wat"}},
+            },
+        })
+        raise AssertionError("bad schema did not 400")
+    except urllib.error.HTTPError as e:
+        assert e.code == 400, e.code
+        body = json.loads(e.read())
+        assert body["error"]["type"] == "invalid_request_error", body
+    print(f"  {label}: chat + SSE + json_schema + embeddings + models OK")
+
+
+def main() -> int:
+    from main import build_app  # examples/grpc-gemma
+
+    from gofr_tpu.config import new_mock_config
+    from gofr_tpu.router import new_router_app
+
+    app = build_app()
+    app_thread = app.run_in_background()
+    direct = f"http://127.0.0.1:{app.http_server.port}"
+    router = new_router_app(config=new_mock_config({
+        "APP_NAME": "openai-smoke-router", "HTTP_PORT": "0",
+        "METRICS_PORT": "0", "LOG_LEVEL": "ERROR",
+        "TPU_ROUTER_BACKENDS": direct,
+        "TPU_ROUTER_POLL_INTERVAL_S": "0.2",
+        "TPU_ROUTER_PROXY_TIMEOUT_S": "180",
+    }))
+    router_thread = router.run_in_background()
+    try:
+        _drive(direct, "direct")
+        # the router proxies /v1/* like any route: an unmodified OpenAI
+        # client pointed at the router tier sees the same contract
+        _drive(f"http://127.0.0.1:{router.http_server.port}", "via router")
+        print("smoke_openai OK")
+        return 0
+    finally:
+        router.shutdown()
+        router_thread.join(timeout=15)
+        app.shutdown()
+        app_thread.join(timeout=15)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

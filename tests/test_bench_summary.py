@@ -177,6 +177,34 @@ def test_summary_line_carries_speculative():
     assert "speculative" not in bench._summary_line(_serving_result())
 
 
+def test_summary_line_carries_structured():
+    """The structured-decoding point rides the summary as a compact
+    block: mask overhead (unconstrained/constrained tok/s ratio), the
+    schema-validity fraction (must be 1.0 by construction), and the
+    speculative acceptance delta on grammar-masked JSON."""
+    r = _serving_result()
+    r["detail"]["structured"] = {
+        "requests": 64, "new_tokens": 120, "grammar_states": 180,
+        "unconstrained_tok_s": 21000.0, "constrained_tok_s": 20100.0,
+        "mask_overhead": 1.045, "valid_frac": 1.0,
+        "spec": {
+            "constrained_tok_s": 26000.0,
+            "constrained_accept_rate": 0.71,
+            "unconstrained_accept_rate": 0.05,
+            "accept_delta": 0.66, "valid_frac": 1.0,
+        },
+    }
+    s = bench._summary_line(r)
+    assert s["structured"] == {
+        "mask_overhead": 1.045, "constrained_tok_s": 20100.0,
+        "valid_frac": 1.0, "spec_accept_delta": 0.66,
+        "spec_accept_constrained": 0.71,
+    }
+    assert len(json.dumps(s)) < 1500
+    # absent block (--no-structured / CPU runs) must not leak a key
+    assert "structured" not in bench._summary_line(_serving_result())
+
+
 def test_summary_line_carries_sessions():
     """BENCH_r14+: the paged-pool sessions point rides the summary as a
     compact block (paged/int8 vs contiguous decode ratios, HBM bytes per

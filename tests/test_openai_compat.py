@@ -1,0 +1,177 @@
+"""OpenAI-compatible edge (gofr_tpu.openai_compat): an UNMODIFIED
+OpenAI-dialect client — raw wire format over real sockets — must get
+spec-shaped answers from /v1/chat/completions (including SSE streaming
+and json_schema response_format), /v1/embeddings, and /v1/models.
+
+scripts/smoke_openai.py drives the same wire format against the
+grpc-gemma example (and through the front router) in CI."""
+
+import json
+import urllib.error
+import urllib.request
+
+import jax
+import pytest
+
+import gofr_tpu
+from gofr_tpu.config import new_mock_config
+from gofr_tpu.models import TransformerConfig, init_params
+from gofr_tpu.openai_compat import chat_prompt, register_openai_routes
+
+CFG = TransformerConfig.tiny(vocab_size=300)  # >= 258: byte-tokenizable
+
+SCHEMA = {
+    "type": "object",
+    "properties": {
+        "city": {"type": "string", "maxLength": 6},
+        "pop": {"type": "integer"},
+    },
+}
+
+
+@pytest.fixture(scope="module")
+def served():
+    cfg = new_mock_config({
+        "HTTP_PORT": "0", "METRICS_PORT": "0", "TRACE_EXPORTER": "none",
+        "REQUEST_TIMEOUT": "5",
+    })
+    app = gofr_tpu.new(config=cfg)
+    params = init_params(jax.random.PRNGKey(0), CFG)
+    app.container.tpu().register_llm(
+        "tiny", CFG, params, slots=4, max_seq_len=256, warmup=False,
+    )
+    register_openai_routes(app, model="tiny")
+    thread = app.run_in_background()
+    base = f"http://127.0.0.1:{app.http_server.port}"
+    yield app, base
+    app.shutdown()
+    thread.join(timeout=10)
+
+
+def _post(base: str, path: str, body: dict, timeout: float = 120.0):
+    req = urllib.request.Request(
+        f"{base}{path}", data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"}, method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+class TestChatCompletions:
+    def test_non_stream_shape(self, served):
+        _app, base = served
+        status, out = _post(base, "/v1/chat/completions", {
+            "model": "tiny",
+            "messages": [{"role": "user", "content": "hello"}],
+            "max_tokens": 8,
+        })
+        assert status == 200
+        assert out["object"] == "chat.completion"
+        assert out["model"] == "tiny"
+        choice = out["choices"][0]
+        assert choice["message"]["role"] == "assistant"
+        assert choice["finish_reason"] in ("stop", "length")
+        usage = out["usage"]
+        assert usage["total_tokens"] == (
+            usage["prompt_tokens"] + usage["completion_tokens"]
+        )
+        assert usage["completion_tokens"] == 8
+
+    def test_sse_stream(self, served):
+        _app, base = served
+        req = urllib.request.Request(
+            f"{base}/v1/chat/completions",
+            data=json.dumps({
+                "messages": [{"role": "user", "content": "hi"}],
+                "max_tokens": 6, "stream": True,
+            }).encode(),
+            headers={"Content-Type": "application/json"}, method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=120) as resp:
+            assert resp.headers["Content-Type"].startswith("text/event-stream")
+            raw = resp.read().decode()
+        events = [
+            ln[len("data: "):] for ln in raw.split("\n")
+            if ln.startswith("data: ")
+        ]
+        assert events[-1] == "[DONE]"
+        chunks = [json.loads(e) for e in events[:-1]]
+        assert all(c["object"] == "chat.completion.chunk" for c in chunks)
+        assert chunks[0]["choices"][0]["delta"].get("role") == "assistant"
+        assert chunks[-1]["choices"][0]["finish_reason"] in ("stop", "length")
+        assert all(
+            c["choices"][0]["finish_reason"] is None for c in chunks[:-1]
+        )
+
+    def test_json_schema_response_validates(self, served):
+        _app, base = served
+        status, out = _post(base, "/v1/chat/completions", {
+            "messages": [{"role": "user", "content": "a city"}],
+            "max_tokens": 200,
+            "response_format": {
+                "type": "json_schema",
+                "json_schema": {"name": "city", "schema": SCHEMA},
+            },
+        })
+        assert status == 200
+        content = out["choices"][0]["message"]["content"]
+        import jsonschema
+
+        jsonschema.validate(json.loads(content), SCHEMA)
+        assert out["choices"][0]["finish_reason"] == "stop"  # grammar eos
+
+    def test_bad_schema_400_openai_envelope(self, served):
+        _app, base = served
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(base, "/v1/chat/completions", {
+                "messages": [{"role": "user", "content": "x"}],
+                "response_format": {
+                    "type": "json_schema",
+                    "json_schema": {"schema": {"type": "wat"}},
+                },
+            })
+        assert ei.value.code == 400
+        body = json.loads(ei.value.read())
+        assert body["error"]["type"] == "invalid_request_error"
+        assert "wat" in body["error"]["message"]
+
+    def test_missing_messages_400(self, served):
+        _app, base = served
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(base, "/v1/chat/completions", {"messages": []})
+        assert ei.value.code == 400
+
+    def test_chat_prompt_template(self):
+        p = chat_prompt([
+            {"role": "system", "content": "be brief"},
+            {"role": "user", "content": [{"type": "text", "text": "hi"}]},
+        ])
+        assert "<|system|>\nbe brief\n" in p
+        assert p.endswith("<|assistant|>\n")
+        assert "<|user|>\nhi\n" in p
+
+
+class TestEmbeddingsAndModels:
+    def test_embeddings_text_and_ids(self, served):
+        _app, base = served
+        status, out = _post(base, "/v1/embeddings", {
+            "input": ["hello", "world"],
+        })
+        assert status == 200 and out["object"] == "list"
+        assert [d["index"] for d in out["data"]] == [0, 1]
+        dim = len(out["data"][0]["embedding"])
+        assert dim == CFG.d_model
+        # unit-normalized
+        import math
+
+        n = math.sqrt(sum(x * x for x in out["data"][0]["embedding"]))
+        assert abs(n - 1.0) < 1e-3
+        status, out2 = _post(base, "/v1/embeddings", {"input": [1, 2, 3]})
+        assert status == 200 and len(out2["data"]) == 1
+
+    def test_models_list(self, served):
+        _app, base = served
+        with urllib.request.urlopen(f"{base}/v1/models", timeout=30) as resp:
+            out = json.loads(resp.read())
+        assert out["object"] == "list"
+        assert [m["id"] for m in out["data"]] == ["tiny"]

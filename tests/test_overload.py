@@ -813,3 +813,130 @@ class TestEdges:
             r.tokens(timeout=60)
         finally:
             eng.close()
+
+
+class TestBatchTier:
+    """The offline batch tier (gofr_tpu.batch) must ride the overload
+    ladder end-to-end: batch-class jobs brown out and preempt before
+    interactive traffic degrades, fleet admission sheds batch FIRST
+    (reserved interactive headroom), and an interactive flood can never
+    starve a batch job into a preemption loop (the per-request
+    preemption cap holds under the batch tier's submission path too)."""
+
+    def test_fleet_admission_sheds_batch_before_interactive(self, params,
+                                                            monkeypatch):
+        rep = _fleet(params, fleet_max_queue_tokens=40)
+        try:
+            for e in rep.engines:
+                monkeypatch.setattr(e, "_admit", lambda: False)
+            # load the fleet into the batch-headroom band:
+            # batch cap = 0.8 * 40 = 32 queued tokens
+            rep.submit(GenRequest(list(range(1, 15)), max_new_tokens=20))
+            with pytest.raises(EngineOverloaded) as ei:
+                rep.submit(GenRequest([1, 2, 3], max_new_tokens=4,
+                                      priority="batch"))
+            assert "batch-class headroom" in str(ei.value)
+            # the SAME load still admits interactive work: the top slice
+            # of fleet queue capacity is reserved for the latency class
+            r = rep.submit(GenRequest([1, 2, 3], max_new_tokens=4))
+            assert r.priority == "interactive"
+        finally:
+            rep.close()
+
+    def test_interactive_flood_never_starves_batch(self, params):
+        """Regression (preemption loop): a continuous interactive flood
+        preempts a batch request's slot at most _PREEMPT_CAP times —
+        after the cap it KEEPS its slot and finishes token-identically
+        to an uncontended run, instead of thrashing forever."""
+        eng = _engine(params, slots=1)
+        try:
+            want = eng.generate([5, 6, 7], max_new_tokens=24,
+                                priority="batch")
+        finally:
+            eng.close()
+        eng = _engine(params, slots=1)
+        try:
+            batch_req = eng.submit(GenRequest([5, 6, 7], max_new_tokens=24,
+                                              priority="batch"))
+            _wait(lambda: batch_req.emitted > 0, 30, "batch under way")
+            stop = threading.Event()
+            errors: list[Exception] = []
+
+            def flood():
+                while not stop.is_set() and batch_req.finish_reason is None:
+                    try:
+                        eng.generate([1, 2], max_new_tokens=2)
+                    except Exception as e:  # noqa: BLE001
+                        errors.append(e)
+                        return
+
+            t = threading.Thread(target=flood, daemon=True)
+            t.start()
+            try:
+                got = batch_req.tokens(timeout=120)
+            finally:
+                stop.set()
+                t.join(timeout=30)
+            assert not errors
+            assert got == want  # token-identical despite preemptions
+            assert batch_req.preempted <= LLMEngine._PREEMPT_CAP
+        finally:
+            eng.close()
+
+    def test_batch_worker_job_survives_interactive_flood(self, params):
+        """End-to-end: a pub/sub batch job drained by the worker
+        completes exactly once while an interactive flood hammers the
+        same engine — the ladder (preemption cap + brownout-able class)
+        protects the job, the ack-after-publish contract keeps it
+        exactly-once."""
+        import asyncio
+        import json as _json
+        from types import SimpleNamespace
+
+        from gofr_tpu.batch import BatchWorker
+        from gofr_tpu.datasource.pubsub import MemoryPubSub
+
+        eng = _engine(params, slots=2)
+        ps = MemoryPubSub()
+
+        class _C(SimpleNamespace):
+            def __init__(self, pubsub, handle):
+                super().__init__(pubsub=pubsub, logger=None,
+                                 metrics_manager=None, _h=handle)
+
+            def tpu(self):
+                return SimpleNamespace(llm=lambda n: self._h)
+
+        w = BatchWorker(_C(ps, eng), "jobs", model="m", poll_timeout=0.1)
+        loop = asyncio.new_event_loop()
+        t = threading.Thread(
+            target=lambda: (asyncio.set_event_loop(loop),
+                            loop.run_until_complete(w.run())),
+            daemon=True,
+        )
+        t.start()
+        stop = threading.Event()
+
+        def flood():
+            while not stop.is_set():
+                try:
+                    eng.generate([1, 2], max_new_tokens=2)
+                except Exception:  # noqa: BLE001 — shutdown race
+                    return
+
+        ft = threading.Thread(target=flood, daemon=True)
+        ft.start()
+        try:
+            ps.publish_sync("jobs", _json.dumps(
+                {"id": "fj", "tokens": [5, 6, 7], "max_new_tokens": 16}
+            ).encode())
+            _wait(lambda: w.jobs_ok == 1, 90, "batch job under flood")
+            q = ps._queues.get("jobs.results")
+            assert q is not None and len(q) == 1
+            assert _json.loads(q[0])["id"] == "fj"
+        finally:
+            stop.set()
+            ft.join(timeout=30)
+            w.close()
+            t.join(timeout=10)
+            eng.close()
