@@ -770,6 +770,16 @@ def bench_serving(args) -> dict:
             args, cfg, eng.params if quantize else params, quantize
         )
 
+    # multi-tenant operating point: 4 resident LoRA adapters decoded in
+    # ONE mixed batch vs the single-tenant baseline (batched low-rank
+    # deltas inside the same fused programs), adapter hot-load and
+    # publish-swap latency (gofr_tpu.lora;
+    # docs/advanced-guide/multi-tenancy.md)
+    if on_tpu and not args.no_multitenant:
+        detail["multitenant"] = _bench_multitenant(
+            args, cfg, eng.params if quantize else params, quantize
+        )
+
     # sessions operating point (BENCH_r14+): paged-vs-contiguous decode
     # tok/s (incl. the int8-KV variant), HBM bytes per idle multi-turn
     # session vs slot residency, and cold-resume-from-host latency vs
@@ -1687,6 +1697,92 @@ def _bench_structured(args, cfg, params, quantize: bool) -> dict:
     }
 
 
+def _bench_multitenant(args, cfg, params, quantize: bool) -> dict:
+    """Multi-tenant LoRA point (gofr_tpu.lora; docs/advanced-guide/
+    multi-tenancy.md): decode tokens/s with 4 resident adapters decoded
+    in ONE mixed batch (requests round-robin the tenants) vs the same
+    engine's single-tenant baseline — the batched-delta claim is that N
+    tenants ride the same fused programs for the cost of one rank-r
+    einsum pair, so the ratio should hold >= ~0.9x. Alongside: adapter
+    hot-load latency (host validate + device table stage, the time from
+    "tenant uploaded a fine-tune" to "next submit can name it") and the
+    publish-swap latency of repointing a live name at a staged v2."""
+    import jax
+
+    from gofr_tpu.llm import GenRequest, LLMEngine
+    from gofr_tpu.lora import init_adapter
+
+    n_adapters = 4
+    new_tokens = 64
+    n_req = 2 * args.batch
+    prompts = [
+        np.random.default_rng(4000 + i).integers(
+            1, cfg.vocab_size - 2, size=max(8, args.prefill_len // 4),
+        ).tolist()
+        for i in range(n_req)
+    ]
+    names = [f"tenant{i}" for i in range(n_adapters)]
+    adapters = [
+        init_adapter(jax.random.PRNGKey(50 + i), cfg, rank=8, scale=0.05)
+        for i in range(n_adapters + 1)  # +1: the v2 used by the swap
+    ]
+    eng = LLMEngine(
+        cfg, params, slots=min(args.batch, 32),
+        max_seq_len=args.prefill_len + new_tokens + 32,
+        decode_chunk=args.decode_chunk, admit_cap=args.admit_cap,
+        quantize=quantize, lora_slots=n_adapters + 2,
+    )
+
+    def run(tenants):
+        warm = [
+            eng.submit(GenRequest(
+                list(p), max_new_tokens=8,
+                adapter=tenants[i % len(tenants)] if tenants else "",
+            ))
+            for i, p in enumerate(prompts[:4])
+        ]
+        for r in warm:
+            r.tokens()
+        t0 = time.perf_counter()
+        reqs = [
+            eng.submit(GenRequest(
+                list(p), max_new_tokens=new_tokens,
+                adapter=tenants[i % len(tenants)] if tenants else "",
+            ))
+            for i, p in enumerate(prompts)
+        ]
+        total = sum(len(r.tokens(timeout=600)) for r in reqs)
+        return total / (time.perf_counter() - t0)
+
+    try:
+        single_tok_s = run([])
+        load_ms = []
+        for name, ad in zip(names, adapters):
+            t0 = time.perf_counter()
+            eng.load_adapter(name, ad)
+            load_ms.append((time.perf_counter() - t0) * 1e3)
+        multi_tok_s = run(names)
+        # hot swap while the pool is populated: stage tenant0's v2 under
+        # a staging name, then atomically repoint the live name at it
+        t0 = time.perf_counter()
+        eng.load_adapter("tenant0@next", adapters[-1], version="v2")
+        eng.publish_adapter("tenant0@next", "tenant0")
+        swap_ms = (time.perf_counter() - t0) * 1e3
+        snap = eng.adapters()
+    finally:
+        eng.close()
+    return {
+        "requests": n_req, "new_tokens": new_tokens,
+        "adapters": n_adapters, "rank": 8,
+        "single_tok_s": round(single_tok_s, 0),
+        "multi_tok_s": round(multi_tok_s, 0),
+        "ratio": round(multi_tok_s / max(single_tok_s, 1e-9), 3),
+        "hot_load_ms": round(sum(load_ms) / len(load_ms), 1),
+        "swap_ms": round(swap_ms, 1),
+        "swaps": snap.get("swaps"), "evictions": snap.get("evictions"),
+    }
+
+
 def _bench_interactive_slo(args, cfg, params, quantize: bool) -> dict:
     """Interactive-SLO point (BENCH_r08+): mixed 16/120-token prompts at a
     FIXED offered load, reporting the tail metrics the chunked-prefill
@@ -2535,6 +2631,9 @@ def main() -> None:
     ap.add_argument("--no-structured", action="store_true",
                     help="skip the structured-decoding point (constrained "
                          "vs unconstrained tokens/s + spec acceptance delta)")
+    ap.add_argument("--no-multitenant", action="store_true",
+                    help="skip the multi-tenant LoRA point (4-adapter "
+                         "mixed decode vs single-tenant + swap latency)")
     ap.add_argument("--no-interactive-slo", action="store_true",
                     help="skip the mixed-prompt interactive-SLO point")
     ap.add_argument("--no-degraded", action="store_true",
@@ -2702,6 +2801,16 @@ def _summary_line(result: dict) -> dict:
             "spec_accept_constrained": (st.get("spec") or {}).get(
                 "constrained_accept_rate"
             ),
+        }
+    if d.get("multitenant"):  # batched-LoRA multi-tenant point
+        mt = d["multitenant"]
+        s["multitenant"] = {
+            "adapters": mt.get("adapters"),
+            "single_tok_s": mt.get("single_tok_s"),
+            "multi_tok_s": mt.get("multi_tok_s"),
+            "ratio": mt.get("ratio"),
+            "hot_load_ms": mt.get("hot_load_ms"),
+            "swap_ms": mt.get("swap_ms"),
         }
     if d.get("interactive_slo"):  # BENCH_r08+: chunked-prefill tail view
         isl = d["interactive_slo"]

@@ -285,6 +285,11 @@ class TPURuntime:
         self.default_llm_constrained_grammars = get(
             "TPU_LLM_CONSTRAINED_GRAMMARS", ""
         )
+        # multi-tenant LoRA adapter serving knobs (gofr_tpu.lora; "" =
+        # engine defaults, which read the same names as process env
+        # vars) — docs/advanced-guide/multi-tenancy.md
+        self.default_llm_lora_slots = get("TPU_LLM_LORA_SLOTS", "")
+        self.default_llm_lora_rank = get("TPU_LLM_LORA_RANK_MAX", "")
         # sharded / disaggregated serving knobs (docs/advanced-guide/
         # sharded-serving.md): TPU_LLM_TP runs each replica
         # tensor-parallel over a submesh of that many chips;
@@ -506,7 +511,18 @@ class TPURuntime:
         routing, the numerical watchdog (TPU_LLM_NUMERIC_CHECK) turns
         NaN/Inf logits into a classified replica death, and a request in
         flight across TPU_LLM_POISON_DEATHS deaths is refused further
-        failover (docs/advanced-guide/resilience.md)."""
+        failover (docs/advanced-guide/resilience.md). Multi-tenant LoRA
+        adapter serving — N low-rank tenant deltas device-resident
+        beside ONE base model, applied inside the same fused programs,
+        hot-loaded/evicted via ModelHandle.register_adapter and selected
+        per request with GenRequest.adapter / X-GoFr-Adapter /
+        model=<adapter> on the OpenAI edge — is enabled with
+        TPU_LLM_LORA_SLOTS=N (max rank TPU_LLM_LORA_RANK_MAX;
+        docs/advanced-guide/multi-tenancy.md). A TransformerConfig with
+        n_experts > 0 serves a mixture-of-experts FFN through the same
+        engine; under TPU_LLM_TP the expert-batched weights shard on
+        their expert axis over each replica's submesh (expert
+        parallelism) when the degree divides the expert count."""
         from ...llm import LLMEngine, ReplicatedLLMEngine
         from ...resilience.rollout import ModelHandle
 
@@ -543,6 +559,14 @@ class TPURuntime:
             engine_kw.setdefault(
                 "constrained_grammars",
                 int(self.default_llm_constrained_grammars),
+            )
+        if self.default_llm_lora_slots != "":
+            engine_kw.setdefault(
+                "lora_slots", int(self.default_llm_lora_slots)
+            )
+        if self.default_llm_lora_rank != "":
+            engine_kw.setdefault(
+                "lora_rank", int(self.default_llm_lora_rank)
             )
         # paged KV pool / session-tier knobs (docs/advanced-guide/kv-cache.md)
         if self.default_llm_kv_paged != "":

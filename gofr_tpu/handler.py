@@ -149,6 +149,12 @@ def llm_request_kwargs(ctx: Context) -> dict:
         "priority": (hdr("X-GoFr-Priority") or "interactive").lower(),
         "client": client,
         "session_id": hdr("X-GoFr-Session"),
+        # Multi-tenant adapter selection (docs/advanced-guide/
+        # multi-tenancy.md): the LoRA adapter name this request runs
+        # under. Empty = the base model. Unknown names 404 at submit
+        # (llm.UnknownAdapterError) — the edge never silently falls back
+        # to base weights for a tenant that asked for its adapter.
+        "adapter": hdr("X-GoFr-Adapter"),
     }
 
 

@@ -228,6 +228,32 @@ def _register_phase_metrics(metrics) -> None:
                 "llm resident compiled grammars in the engine's device "
                 "transition table (zeroed at engine close)",
             )
+        # multi-tenant LoRA adapter serving (gofr_tpu.lora;
+        # docs/advanced-guide/multi-tenancy.md)
+        for name, desc in (
+            ("app_llm_adapter_requests_total",
+             "llm requests attributed to a LoRA adapter (adapter label "
+             "names the tenant)"),
+            ("app_llm_adapter_swaps_total",
+             "llm adapter hot-load publishes (staged gid repointed at a "
+             "serving name; old gid drains as a zombie)"),
+            ("app_llm_adapter_evictions_total",
+             "llm idle resident adapters LRU-evicted to make room for a "
+             "load (pool full)"),
+        ):
+            if not metrics.has(name):
+                metrics.new_counter(name, desc)
+        if not metrics.has("app_llm_adapters_resident"):
+            metrics.new_gauge(
+                "app_llm_adapters_resident",
+                "llm named LoRA adapters resident in the engine's device "
+                "tables (zeroed at engine close)",
+            )
+        if not metrics.has("app_llm_moe_experts"):
+            metrics.new_gauge(
+                "app_llm_moe_experts",
+                "llm experts per MoE layer of the served model (0 = dense)",
+            )
         if not metrics.has("app_llm_spec_tokens_per_step"):
             metrics.new_histogram(
                 "app_llm_spec_tokens_per_step",
@@ -311,6 +337,27 @@ class EngineDraining(RuntimeError):
     retry_after: float | None = 5.0
 
 
+class UnknownAdapterError(KeyError):
+    """Raised by submit() when ``req.adapter`` names no resident adapter
+    (gofr_tpu.lora). 404 via the statusCodeResponder seam — the OpenAI
+    edge turns it into the model-not-found error envelope. A KeyError
+    subclass so registry-shaped callers that probe with ``except
+    KeyError`` keep working."""
+
+    status_code = 404
+
+    def __init__(self, name: str, resident=()):
+        super().__init__(name)
+        self.adapter = name
+        self.resident = sorted(resident)
+
+    def __str__(self) -> str:
+        return (
+            f"unknown adapter {self.adapter!r}; resident: "
+            f"{self.resident or 'none'}"
+        )
+
+
 class PoisonedRequestError(RuntimeError):
     """Raised by GenRequest.stream()/tokens() when the fleet refused a
     request further failover: it was in flight across
@@ -389,6 +436,13 @@ class GenRequest:
     # Requires the chunked scheduler; eos_token is taken from the
     # grammar when unset. None = unconstrained (zero new device work).
     grammar: Any = None
+    # Multi-tenant LoRA adapter name (gofr_tpu.lora; docs/advanced-guide/
+    # multi-tenancy.md): the resident adapter whose low-rank delta this
+    # request decodes under. The OpenAI edge maps model=<adapter> / the
+    # X-GoFr-Adapter header here. "" = the base model (gid 0 identity —
+    # token-identical to an engine with no adapter support). Requires the
+    # chunked scheduler and a LoRA-enabled engine (lora_slots > 0).
+    adapter: str = ""
     id: int = field(default_factory=itertools.count().__next__)
 
     def __post_init__(self):
@@ -446,6 +500,12 @@ class GenRequest:
         # continuation (preemption/failover) re-admits mid-output.
         self._g_id = -1
         self._g_state = 0
+        # -- multi-tenant LoRA (engine-maintained; gofr_tpu.lora) --
+        # _aid: the adapter pool gid this request's in-flight reference
+        # pins (0 = base/identity, never refcounted). Re-resolved from
+        # `adapter` on every submit — a failover continuation lands on a
+        # replica whose pool may bind the name to a different gid.
+        self._aid = 0
         # -- speculative decoding (gofr_tpu.spec; engine-maintained) --
         # acceptance-rate EMA driving the adaptive draft length, and the
         # plain-pass streak that paces the backed-off re-probe. Starts
@@ -576,6 +636,8 @@ class LLMEngine:
         numeric_check: bool | None = None,
         constrained: bool | None = None,
         constrained_grammars: int | None = None,
+        lora_slots: int | None = None,
+        lora_rank: int | None = None,
         fault_injector=None,
         logger=None,
         metrics=None,
@@ -903,6 +965,10 @@ class LLMEngine:
             metrics.set_gauge(
                 "app_llm_tp_degree", float(self.tp_degree), model=kv_label,
             )
+            metrics.set_gauge(
+                "app_llm_moe_experts",
+                float(getattr(cfg, "n_experts", 0) or 0), model=kv_label,
+            )
         self._tp_gather = None
         if self.tp_overlap:
             from .parallel.sharding import replicate_gather
@@ -928,6 +994,55 @@ class LLMEngine:
             params = jax.device_put(params, device)
         else:
             params = jax.device_put(params)
+
+        # -- multi-tenant LoRA adapter pool (gofr_tpu.lora;
+        # docs/advanced-guide/multi-tenancy.md) ---------------------------
+        # lora_slots > 0 merges stacked zero-initialized (A, B) tables and
+        # a per-slot adapter-id vector INTO the params pytree, so the same
+        # fused programs serve every tenant via a batched gather — no
+        # per-tenant compile, and a hot-load is one table-slice rewrite.
+        # Chunked-scheduler only, like constrained decoding: the wave path
+        # packs prefill rows != slots, so adapter ids cannot ride it.
+        if lora_slots is None:
+            lora_slots = int(_os.environ.get("TPU_LLM_LORA_SLOTS", "0") or 0)
+        if lora_rank is None:
+            lora_rank = int(_os.environ.get("TPU_LLM_LORA_RANK_MAX", "8") or 8)
+        self.lora_slots = max(0, int(lora_slots)) if self.chunked else 0
+        self.lora_rank = max(1, int(lora_rank))
+        if self.lora_slots:
+            from . import lora as lora_mod
+            from .lora import AdapterPool
+
+            self._lora_mod = lora_mod
+            tables = lora_mod.zero_tables(cfg, self.lora_slots, self.lora_rank)
+            aids0 = jnp.zeros((slots,), jnp.int32)
+            if self._sharded:
+                from jax.sharding import NamedSharding, PartitionSpec as _P
+
+                from .parallel.sharding import shard_params as _shard
+
+                tables = _shard(tables, mesh, lora_mod.table_specs(tables))
+                aids0 = jax.device_put(aids0, NamedSharding(mesh, _P(None)))
+            elif device is not None:
+                tables = jax.device_put(tables, device)
+                aids0 = jax.device_put(aids0, device)
+            else:
+                tables = jax.device_put(tables)
+                aids0 = jax.device_put(aids0)
+            # merged AFTER the quantize block on purpose: the tables stay
+            # f32 (lora.zero_tables) and quantize_params only touches
+            # _QUANT_KEYS, but this ordering makes it structural
+            params = {
+                **params,
+                "layers": {**params["layers"], **tables},
+                "aids": aids0,
+            }
+            self._lora_pool = AdapterPool(self.lora_slots)
+            self._aids_host = [0] * slots
+            self._aids_dirty = False
+            # staging programs compile lazily per table shape (6 targets x
+            # (a, b)); the gid is traced so every load reuses them
+            self._lora_set_ops: dict = {}
         self.params = params
         self.device = device
 
@@ -1147,6 +1262,13 @@ class LLMEngine:
                     pack[:, shape + 2], jnp.float32
                 )
                 slot_idx, finish = meta[0], meta[1]
+                # per-row adapter ids (LoRA engines only — static pytree
+                # check): packed prefill lanes gather their slot's id; the
+                # fused decode below reads the full per-slot vector itself
+                aids_row = (
+                    jnp.take(params["aids"], slot_idx, mode="clip")
+                    if "aids" in params else None
+                )
                 # gather the target slots' resident rows (padding lanes
                 # clip to a real slot but never write back)
                 sub = cache._replace(
@@ -1156,7 +1278,7 @@ class LLMEngine:
                 )
                 logits, sub = prefill_append(
                     params, cfg, tokens, sub, cursors, n_new,
-                    ring=self.kv.ring,
+                    ring=self.kv.ring, aids=aids_row,
                 )
                 cache = cache._replace(
                     k=cache.k.at[:, slot_idx].set(sub.k, mode="drop"),
@@ -1237,7 +1359,7 @@ class LLMEngine:
                 toks = jnp.concatenate([tail[:, None], drafts], axis=1)
                 logits, new_cache = verify_fn(
                     params, cfg, toks, cache, cache.length, n_in,
-                    ring=self.kv.ring,
+                    ring=self.kv.ring, aids=params.get("aids"),
                 )
                 rng, sub = jax.random.split(rng)
                 keys = jax.random.split(sub, Wv)
@@ -1333,6 +1455,10 @@ class LLMEngine:
                 )
                 slot_idx, finish = meta[0], meta[1]
                 gid_row, gstart = meta[2], meta[3]
+                aids_row = (
+                    jnp.take(params["aids"], slot_idx, mode="clip")
+                    if "aids" in params else None
+                )
                 sub = cache._replace(
                     k=jnp.take(cache.k, slot_idx, axis=1, mode="clip"),
                     v=jnp.take(cache.v, slot_idx, axis=1, mode="clip"),
@@ -1340,7 +1466,7 @@ class LLMEngine:
                 )
                 logits, sub = prefill_append(
                     params, cfg, tokens, sub, cursors, n_new,
-                    ring=self.kv.ring,
+                    ring=self.kv.ring, aids=aids_row,
                 )
                 cache = cache._replace(
                     k=cache.k.at[:, slot_idx].set(sub.k, mode="drop"),
@@ -1411,7 +1537,7 @@ class LLMEngine:
                 toks = jnp.concatenate([tail[:, None], drafts], axis=1)
                 logits, new_cache = verify_fn_c(
                     params, cfg, toks, cache, cache.length, n_in,
-                    ring=self.kv.ring,
+                    ring=self.kv.ring, aids=params.get("aids"),
                 )
                 rng, sub = jax.random.split(rng)
                 keys = jax.random.split(sub, Wv)
@@ -1624,12 +1750,17 @@ class LLMEngine:
                         pack[:, shape + 2], jnp.float32
                     )
                     slot_idx, finish = meta[0], meta[1]
+                    aids_row = (
+                        jnp.take(params["aids"], slot_idx, mode="clip")
+                        if "aids" in params else None
+                    )
                     tsub = jnp.take(
                         tables, jnp.clip(slot_idx, 0, slots - 1), axis=0
                     )
                     sub = _gather_view(cache, scales, tsub, cursors)
                     logits, sub2 = prefill_append(
                         params, cfg, tokens, sub, cursors, n_new, ring=0,
+                        aids=aids_row,
                     )
                     c = shape
                     pos_a = cursors[:, None] + jnp.arange(c, dtype=jnp.int32)[None, :]
@@ -1708,6 +1839,7 @@ class LLMEngine:
                     dense = _gather_view(cache, scales, tables, cache.length)
                     logits, nd = verify_fn(
                         params, cfg, toks, dense, cache.length, n_in, ring=0,
+                        aids=params.get("aids"),
                     )
                     pos = cache.length[:, None] + jnp.arange(
                         Wv, dtype=jnp.int32
@@ -1804,12 +1936,17 @@ class LLMEngine:
                     )
                     slot_idx, finish = meta[0], meta[1]
                     gid_row, gstart = meta[2], meta[3]
+                    aids_row = (
+                        jnp.take(params["aids"], slot_idx, mode="clip")
+                        if "aids" in params else None
+                    )
                     tsub = jnp.take(
                         tables, jnp.clip(slot_idx, 0, slots - 1), axis=0
                     )
                     sub = _gather_view(cache, scales, tsub, cursors)
                     logits, sub2 = prefill_append(
                         params, cfg, tokens, sub, cursors, n_new, ring=0,
+                        aids=aids_row,
                     )
                     c = shape
                     pos_a = cursors[:, None] + jnp.arange(
@@ -1915,6 +2052,7 @@ class LLMEngine:
                     dense = _gather_view(cache, scales, tables, cache.length)
                     logits, nd = verify_fn_c(
                         params, cfg, toks, dense, cache.length, n_in, ring=0,
+                        aids=params.get("aids"),
                     )
                     pos = cache.length[:, None] + jnp.arange(
                         Wv, dtype=jnp.int32
@@ -2070,6 +2208,7 @@ class LLMEngine:
         self._chunk_ops_c: dict[int, Any] = {}  # built on first use
         self._step_ops_c: dict[int, Any] = {}
         self._verify_op_c = None
+        self.adapter_requests = 0  # lifetime adapter-attributed submissions
         if device is not None:
             (
                 self._tail, self._active, self._temps, self._gstate,
@@ -2270,6 +2409,39 @@ class LLMEngine:
                     "app_llm_constrained_mask_seconds",
                     time.perf_counter() - t0g, model=self.label,
                 )
+        if req.adapter:
+            # acquire AFTER every shed/reject path (same discipline as
+            # grammar registration above): a rejected submit must not
+            # leak a pool reference. Re-resolve unconditionally — a
+            # failover continuation arrives with a stale _aid from a
+            # replica whose pool bound the name to a different gid.
+            if not self.lora_slots:
+                raise ValueError(
+                    f"request names adapter {req.adapter!r} but this "
+                    "engine has no adapter pool (lora_slots=0; set "
+                    "TPU_LLM_LORA_SLOTS)"
+                )
+            with self._lock:
+                try:
+                    req._aid = self._lora_pool.acquire(req.adapter)
+                except KeyError:
+                    raise UnknownAdapterError(
+                        req.adapter, self._lora_pool.resident()
+                    ) from None
+            # default billing identity: un-attributed tenant traffic
+            # bills to the adapter's pseudo-client so per-adapter quotas
+            # (ledger.set_weight at register time) take effect without
+            # every caller threading a client id
+            if not req.client:
+                req.client = f"adapter:{req.adapter}"
+            self.adapter_requests += 1
+            if self.metrics is not None:
+                self.metrics.increment_counter(
+                    "app_llm_adapter_requests_total", model=self.label,
+                    adapter=req.adapter,
+                )
+        else:
+            req._aid = 0
         now = time.perf_counter()
         req.submitted_at = now
         req.phase = "queued"
@@ -2382,6 +2554,17 @@ class LLMEngine:
                 "spec": self._spec_summary(),
                 # grammar-constrained decoding (gofr_tpu.structured)
                 "constrained": self._constrained_summary(),
+                # multi-tenant LoRA adapters (gofr_tpu.lora)
+                "adapters": {
+                    **(
+                        self._lora_pool.snapshot() if self.lora_slots
+                        else {"slots": 0, "resident": {}, "zombies": [],
+                              "evictions": 0, "swaps": 0}
+                    ),
+                    "requests": self.adapter_requests,
+                    "rank_max": self.lora_rank if self.lora_slots else 0,
+                },
+                "moe_experts": int(getattr(self.cfg, "n_experts", 0) or 0),
                 "load_tokens": self.load_tokens(),
                 "rejected": self.rejected,
                 "shed": self.shed,
@@ -2515,6 +2698,12 @@ class LLMEngine:
             "prefilling": len(self._prefilling),
             "spec": self._spec_summary(),
             "constrained": self._constrained_summary(),
+            "adapters": {
+                **self.adapters(),
+                "requests": self.adapter_requests,
+                "rank_max": self.lora_rank if self.lora_slots else 0,
+            },
+            "moe_experts": int(getattr(self.cfg, "n_experts", 0) or 0),
             "slot_table": slot_table,
             "inflight": inflight,
             "waiting_total": waiting_total,
@@ -2673,6 +2862,141 @@ class LLMEngine:
             if r is not None and r.grammar is not None and r._g_id >= 0:
                 gids[i] = r._g_id
         return gids
+
+    # -- multi-tenant LoRA adapter lifecycle (gofr_tpu.lora;
+    # docs/advanced-guide/multi-tenancy.md) ------------------------------
+    def _require_lora(self) -> None:
+        if not self.lora_slots:
+            raise ValueError(
+                "engine has no adapter pool (lora_slots=0; set "
+                "TPU_LLM_LORA_SLOTS or pass lora_slots=)"
+            )
+
+    def _lora_stage(self, gid: int, canon: dict) -> None:
+        """Write one adapter's padded (A, B) pairs into table row ``gid``
+        (every target; absent targets write zeros so residue from the
+        row's previous tenant can never leak into this one). The gid is
+        TRACED, so every load on an engine's life reuses the same
+        compiled set programs; params is never donated, so the rebuild
+        is a dict swap around fresh table buffers and the serving jit
+        caches stay warm."""
+        jnp = self._jnp
+        op = self._lora_set_ops.get("set")
+        if op is None:
+            def _set(tab, g, sl):
+                return tab.at[:, g].set(sl)
+
+            op = self._jax.jit(_set)
+            self._lora_set_ops["set"] = op
+        L, rmax = self.cfg.n_layers, self.lora_rank
+        layers = dict(self.params["layers"])
+        gid_dev = jnp.asarray(gid, jnp.int32)
+        for name, (d_in, d_out) in self._lora_mod.target_dims(
+            self.cfg
+        ).items():
+            ka, kb = f"lora_{name}_a", f"lora_{name}_b"
+            if ka not in layers:
+                continue
+            a_pad = np.zeros((L, d_in, rmax), np.float32)
+            b_pad = np.zeros((L, rmax, d_out), np.float32)
+            if name in canon:
+                a, b = canon[name]
+                r = a.shape[2]
+                a_pad[:, :, :r] = a
+                b_pad[:, :r, :] = b
+            layers[ka] = op(layers[ka], gid_dev, jnp.asarray(a_pad))
+            layers[kb] = op(layers[kb], gid_dev, jnp.asarray(b_pad))
+        # atomic publish of the new tables: dispatches read self.params
+        # once per call, and the staged gid has no live lane (refs == 0
+        # by allocate's contract), so a dispatch racing this swap serves
+        # every resident tenant identically from either dict
+        self.params = {**self.params, "layers": layers}
+
+    def load_adapter(
+        self, name: str, adapter: dict, *, version: str = "v1",
+        alpha: float | None = None, fair_weight: float | None = None,
+    ) -> int:
+        """Validate ``adapter`` against the base config, bind ``name`` to
+        a pool gid (LRU-evicting an idle resident when full), and stage
+        its delta into the device tables. Callable while serving: the
+        staged gid has no in-flight lane until a submit names it. Returns
+        the gid. ``fair_weight`` sets the per-tenant FairLedger share of
+        the adapter's pseudo-client (``adapter:<name>``)."""
+        self._require_lora()
+        canon = self._lora_mod.validate_adapter(
+            self.cfg, adapter, rank_max=self.lora_rank, alpha=alpha
+        )
+        rank = max((a.shape[2] for a, _ in canon.values()), default=0)
+        with self._lock:
+            ev0 = self._lora_pool.evictions
+            gid = self._lora_pool.allocate(name, version=version, rank=rank)
+            evicted = self._lora_pool.evictions - ev0
+        try:
+            self._lora_stage(gid, canon)
+        except BaseException:
+            with self._lock:
+                self._lora_pool.remove(name)
+            raise
+        if fair_weight is not None and self.ledger is not None:
+            self.ledger.set_weight(f"adapter:{name}", fair_weight)
+        if self.metrics is not None:
+            if evicted:
+                self.metrics.increment_counter(
+                    "app_llm_adapter_evictions_total", float(evicted),
+                    model=self.label,
+                )
+            self.metrics.set_gauge(
+                "app_llm_adapters_resident", float(len(self._lora_pool)),
+                model=self.label,
+            )
+        return gid
+
+    def publish_adapter(self, staging: str, name: str) -> int | None:
+        """Atomically repoint ``name`` at the gid staged under
+        ``staging`` (hot-load commit after a canary gate). In-flight
+        requests keep decoding on the OLD gid until they drain (zombie);
+        new submits resolve to the new one. Returns the previous gid or
+        None for a first load."""
+        self._require_lora()
+        with self._lock:
+            old = self._lora_pool.publish(staging, name)
+        if self.metrics is not None:
+            self.metrics.increment_counter(
+                "app_llm_adapter_swaps_total", model=self.label,
+            )
+            self.metrics.set_gauge(
+                "app_llm_adapters_resident", float(len(self._lora_pool)),
+                model=self.label,
+            )
+        return old
+
+    def evict_adapter(self, name: str) -> int:
+        """Unbind ``name`` (retire / canary reject). Its gid frees
+        immediately when idle, else drains as a zombie while in-flight
+        requests finish — the table row is not zeroed (no lane points at
+        it; the next allocate overwrites it wholesale)."""
+        self._require_lora()
+        with self._lock:
+            gid = self._lora_pool.remove(name)
+        if self.metrics is not None:
+            self.metrics.set_gauge(
+                "app_llm_adapters_resident", float(len(self._lora_pool)),
+                model=self.label,
+            )
+        return gid
+
+    def adapters(self) -> dict:
+        """Pool snapshot: resident adapters (gid/version/rank/refs),
+        zombie gids, lifetime eviction/swap counts. Empty-shaped on
+        engines without a pool so registry listings need no feature
+        probe."""
+        if not self.lora_slots:
+            return {
+                "slots": 0, "resident": {}, "zombies": [],
+                "evictions": 0, "swaps": 0,
+            }
+        with self._lock:
+            return self._lora_pool.snapshot()
 
     def _ensure_c_ops(self) -> None:
         """Build (and on first dispatch, compile) the constrained program
@@ -2922,6 +3246,8 @@ class LLMEngine:
             "app_llm_fairness_debt",
             "app_llm_spec_accept_rate",
             "app_llm_constrained_grammars",
+            "app_llm_adapters_resident",
+            "app_llm_moe_experts",
         ):
             self.metrics.set_gauge(name, 0.0, model=self.label)
         # a closed engine must not keep exporting its version row (the
@@ -3911,6 +4237,35 @@ class LLMEngine:
             old.out.put(None)
         self._slot_req[slot] = r
         r.slot = slot
+        if self.lora_slots and self._aids_host[slot] != r._aid:
+            # the slot's lane now computes under r's adapter; the device
+            # mirror re-ships lazily at the next dispatch (_ship_aids)
+            self._aids_host[slot] = r._aid
+            self._aids_dirty = True
+
+    def _ship_aids(self) -> None:
+        """Re-ship the per-slot adapter-id vector into the params pytree
+        when slot assignments changed (SCHEDULER THREAD ONLY — dispatches
+        follow immediately). One tiny [slots] int32 h2d per assignment
+        batch, not per dispatch: the tables inside params are untouched
+        and params is never donated, so this is a dict rebuild around the
+        same device buffers and every jit cache stays warm."""
+        if not self.lora_slots or not self._aids_dirty:
+            return
+        with self._lock:
+            host = np.asarray(self._aids_host, np.int32)
+            self._aids_dirty = False
+        if self._sharded:
+            from jax.sharding import NamedSharding, PartitionSpec as _P
+
+            aids = self._jax.device_put(
+                host, NamedSharding(self.mesh, _P(None))
+            )
+        elif self.device is not None:
+            aids = self._jax.device_put(host, self.device)
+        else:
+            aids = self._jax.device_put(host)
+        self.params = {**self.params, "aids": aids}
 
     # -- paged-pool plumbing (kvcache.paged; SCHEDULER THREAD ONLY — the
     # helpers below dispatch device work against the donated pool) -------
@@ -3952,6 +4307,12 @@ class LLMEngine:
         logits retained for exact hits. session=True publishes the whole
         conversation (prompt + emitted) and pins it to the session id."""
         if not self.kv.paged or self.kv.radix is None:
+            return
+        if r._aid != 0:
+            # adapted lanes never publish: their K/V rows were computed
+            # under THIS tenant's wq/wkv deltas, so sharing them through
+            # the radix tree would seed other tenants (or the base) with
+            # prefix state from the wrong weights
             return
         # session publishes drop the LAST emitted token: a sampled token's
         # K/V row is only written when it re-enters as the next step's
@@ -4333,10 +4694,13 @@ class LLMEngine:
                 # admits through _hit_first, a program the grammar mask
                 # does not ride — re-prefilling trades latency for the
                 # validity guarantee (partial seeds would be fine, but
-                # one rule is auditable)
+                # one rule is auditable). Adapted requests (gofr_tpu.lora)
+                # also force a miss: shared radix blocks hold K/V computed
+                # under the BASE wq/wkv, not this tenant's deltas.
                 plan = (
                     self.kv.lookup_seed(r.prompt_tokens)
-                    if self.kv.share and r.grammar is None else None
+                    if self.kv.share and r.grammar is None and r._aid == 0
+                    else None
                 )
                 r._kv_plan = plan
                 if not self.kv.admit_reserve(
@@ -4364,8 +4728,8 @@ class LLMEngine:
         elif self.kv.prefix is not None:
             rest = []
             for r in pulled:
-                if r.grammar is not None:
-                    rest.append(r)  # constrained: full prefill (see above)
+                if r.grammar is not None or r._aid != 0:
+                    rest.append(r)  # constrained/adapted: full prefill
                     continue
                 # mid-prompt seeding is a dense-layout move: a rolling
                 # entry's ring rows are laid out for ITS final length and
@@ -4662,6 +5026,12 @@ class LLMEngine:
             # becomes evictable once no live request holds it)
             self._g_refs[r._g_id] = max(0, self._g_refs[r._g_id] - 1)
             r._g_id = -1
+        if r._aid > 0 and self.lora_slots:
+            # release the adapter-pool reference (mirrors the grammar
+            # release above; the gid becomes evictable/reclaimable once
+            # no in-flight request pins it)
+            self._lora_pool.release(r._aid)
+            r._aid = 0
         total = None if r.submitted_at is None else now - r.submitted_at
         queue_wait = (
             None if r.admitted_at is None or r.submitted_at is None
@@ -4832,6 +5202,7 @@ class LLMEngine:
         prefill-priority jump still fetches its first token ahead of
         queued chunk fetches. The saturated path is unchanged (full chunks
         either way)."""
+        self._ship_aids()
         with self._work_cv:
             # partial-prefill occupants are resident but NOT decoding:
             # the chunk's tokens for their slots are garbage (device
@@ -4948,6 +5319,7 @@ class LLMEngine:
         False when every queued prefill row turned out stale
         (reassigned/cancelled)."""
         jnp = self._jnp
+        self._ship_aids()
         self._fault("device_step")  # before any cursor mutation
         with self._work_cv:
             # purge stale prefill rows (cancelled, or slot reassigned)
@@ -5133,6 +5505,10 @@ class LLMEngine:
                     )
             elif self.kv.prefix is not None and logits_dev is not None:
                 for j, slot, r in finishes:
+                    if r._aid != 0:
+                        # adapted rows hold tenant-delta K/V — never
+                        # shareable through the base prefix cache
+                        continue
                     keep_rows = (
                         self.kv.capacity if self.kv.rolling
                         else min(r._rows_hi, self.kv.capacity)
@@ -5285,6 +5661,7 @@ class LLMEngine:
         then runs the plain chunk pipeline, which is the adaptive
         backoff's no-regression guarantee at engine scope."""
         jnp = self._jnp
+        self._ship_aids()
         self._fault("device_step")
         with self._work_cv:
             steps = self._inflight_steps()
@@ -6489,6 +6866,12 @@ class ReplicatedLLMEngine:
         # weights legitimately produce different canary streams, so a v2
         # candidate must never be token-compared against the v1 reference
         self._canary_ref: dict[str, list[int]] = {}
+        # Fleet adapter registry (gofr_tpu.lora): host copies of every
+        # registered adapter checkpoint, so a rebuilt/shifted replica
+        # re-stages the SAME tenant set its peers serve (_build_replica).
+        # Insertion-ordered: re-staging replays loads oldest-first, which
+        # reproduces the pool's LRU layout closely enough for tests.
+        self._adapters_host: dict[str, dict] = {}
         # build replicas concurrently: XLA releases the GIL while compiling,
         # so N warmups overlap instead of serializing construction N-fold.
         # On any failure, close the replicas that DID come up — each holds
@@ -6568,6 +6951,23 @@ class ReplicatedLLMEngine:
             **self._engine_kw,
         )
         eng.failover_hook = self._failover
+        # re-stage the fleet's registered adapters (gofr_tpu.lora): a
+        # supervised restart or rollout shift must come back serving the
+        # same tenant set as its peers — a replica with an empty pool
+        # would 404 every adapter-routed request the router lands on it
+        if getattr(eng, "lora_slots", 0):
+            for name, rec in list(self._adapters_host.items()):
+                try:
+                    eng.load_adapter(
+                        name, rec["adapter"], version=rec["version"],
+                        alpha=rec["alpha"], fair_weight=rec["fair_weight"],
+                    )
+                except Exception as ex:  # noqa: BLE001
+                    if self.logger is not None:
+                        self.logger.warn(
+                            f"adapter {name!r} re-stage failed on rebuilt "
+                            f"replica: {ex}"
+                        )
         return eng
 
     def _spec_for_rebuild(self, i: int) -> tuple[dict, str] | None:
@@ -6817,6 +7217,82 @@ class ReplicatedLLMEngine:
         ctl = self._rollout
         return None if ctl is None else ctl.snapshot()
 
+    # -- multi-tenant adapters (gofr_tpu.lora;
+    # docs/advanced-guide/multi-tenancy.md) --------------------------------
+    def load_adapter(
+        self, name: str, adapter: dict, *, version: str = "v1",
+        alpha: float | None = None, fair_weight: float | None = None,
+    ) -> int:
+        """Stage ``adapter`` on every live replica and retain a host copy
+        so rebuilt/shifted replicas re-stage it (_build_replica). Returns
+        the number of replicas staged; raises when none took it (a
+        partial fleet serves — the router only lands adapter traffic on
+        replicas that resolved the name, via submit failover)."""
+        errs: list[Exception] = []
+        done = 0
+        for e in self.engines:
+            if not e.alive():
+                continue
+            try:
+                e.load_adapter(
+                    name, adapter, version=version, alpha=alpha,
+                    fair_weight=fair_weight,
+                )
+                done += 1
+            except Exception as ex:  # noqa: BLE001
+                errs.append(ex)
+        if not done:
+            raise errs[0] if errs else EngineStoppedError("all replicas dead")
+        self._adapters_host[name] = {
+            "adapter": adapter, "version": str(version), "alpha": alpha,
+            "fair_weight": fair_weight,
+        }
+        return done
+
+    def publish_adapter(self, staging: str, name: str) -> int:
+        """Commit a staged hot-load on every live replica (atomic
+        per-replica; in-flight requests drain on their old gid). Returns
+        replicas switched."""
+        done = 0
+        for e in self.engines:
+            if not e.alive():
+                continue
+            try:
+                e.publish_adapter(staging, name)
+                done += 1
+            except Exception:  # noqa: BLE001
+                pass  # replica without the staging name: nothing to commit
+        rec = self._adapters_host.pop(staging, None)
+        if rec is not None:
+            self._adapters_host[name] = rec
+        return done
+
+    def evict_adapter(self, name: str) -> int:
+        """Retire ``name`` fleet-wide (idle gids free now, busy ones
+        drain as zombies). Returns replicas that held it."""
+        self._adapters_host.pop(name, None)
+        done = 0
+        for e in self.engines:
+            if not e.alive():
+                continue
+            try:
+                e.evict_adapter(name)
+                done += 1
+            except KeyError:
+                pass
+        return done
+
+    def adapters(self) -> dict:
+        """Fleet adapter view: the registry's names plus the first live
+        replica's pool snapshot (replicas converge on the same resident
+        set; gids may differ per replica and are reported per-pool)."""
+        lead = next((e for e in self.engines if e.alive()), None)
+        snap = lead.adapters() if lead is not None else {
+            "slots": 0, "resident": {}, "zombies": [],
+            "evictions": 0, "swaps": 0,
+        }
+        return {**snap, "registered": sorted(self._adapters_host)}
+
     # -- routing -----------------------------------------------------------
     def _pick(
         self,
@@ -6903,6 +7379,17 @@ class ReplicatedLLMEngine:
         # the exclusion set alone is not a terminator.
         tried: set[int] = set()
         first_err: Exception | None = None
+        if req.adapter and req.adapter not in self._adapters_host:
+            # fast 404 for a name NO replica can serve (fleet registry
+            # miss + no direct per-engine load): walking the fleet would
+            # burn retry budget on an error every replica repeats
+            if not any(
+                req.adapter in e.adapters()["resident"]
+                for e in self.engines if e.alive()
+            ):
+                raise UnknownAdapterError(
+                    req.adapter, self._adapters_host
+                )
         # session affinity: the replica holding this conversation's KV
         # (resident or host-spilled) serves the next turn as a prefix
         # hit; any other replica re-prefills the whole history. Falls
@@ -6931,7 +7418,13 @@ class ReplicatedLLMEngine:
                 eng = self._pick(exclude=tried)
             try:
                 out = eng.submit(req)
-            except (EngineStoppedError, EngineDraining) as e:
+            except (
+                EngineStoppedError, EngineDraining, UnknownAdapterError,
+            ) as e:
+                # UnknownAdapterError is retryable HERE only: a replica
+                # mid-rebuild may not have re-staged the adapter yet,
+                # while its peers serve it (the registry fast-path above
+                # already 404'd names nobody holds)
                 first_err = first_err or e
                 tried.add(id(eng))
                 continue
@@ -7155,6 +7648,8 @@ class ReplicatedLLMEngine:
             "fairness": (
                 self.ledger.snapshot() if self.ledger is not None else None
             ),
+            # multi-tenant adapters (gofr_tpu.lora)
+            "adapters": self.adapters(),
             # fleet speculative-decoding totals (per-replica in per_replica)
             "spec": {
                 "enabled": any(

@@ -1,0 +1,331 @@
+"""Batched LoRA adapter serving — multi-tenant model multiplexing.
+
+One HBM-resident base model serves N tenants: each tenant's weight delta
+is a low-rank (A, B) pair per projection, and ALL resident adapters live
+stacked in device tables
+
+    lora_<name>_a [L, G, d_in, r_max]     lora_<name>_b [L, G, r_max, d_out]
+
+inside ``params["layers"]`` (the leading L axis rides the layer
+lax.scan exactly like every other stacked weight). A per-slot adapter-id
+vector ``params["aids"]`` [slots] int32 selects each lane's pair inside
+the fused device programs (prefill chunk / unified step / speculative
+verify, dense + paged):
+
+    out = x @ W + (x @ A[gid]) @ B[gid]
+
+Gid 0 is the reserved ZERO-RANK IDENTITY: its tables are all-zero, so an
+unadapted lane adds exact floating-point zeros and stays token-identical
+to an engine with no adapter support at all (test-pinned). Loading or
+evicting an adapter rewrites one gid's table slice in place — same
+shapes, so ONE compiled program family serves every tenant and a
+hot-load never recompiles anything (the Punica / S-LoRA batched-gather
+design, PAPERS.md).
+
+The per-name scaling alpha/r is folded into B at validation time, and
+ranks below r_max zero-pad — padded columns contribute exact zeros.
+
+``AdapterPool`` is the host-side bookkeeping mirror of the device
+tables: fixed gid slots (``TPU_LLM_LORA_SLOTS``), per-gid refcounts of
+in-flight requests, LRU eviction of idle named adapters, and zombie
+tracking for gids whose name moved on (a hot-load repoints the name at
+a freshly staged gid; the old gid keeps serving its in-flight requests
+until the last reference drains — the canary-reject-keeps-serving
+contract).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+__all__ = [
+    "LORA_TARGETS",
+    "AdapterPool",
+    "AdapterPoolFull",
+    "init_adapter",
+    "merge_adapter",
+    "table_specs",
+    "target_dims",
+    "validate_adapter",
+    "zero_tables",
+]
+
+# Projections an adapter may touch: q/k/v (wkv packs k and v), the output
+# projection, and the dense MLP. MoE expert weights are excluded —
+# adapters on a sparse base apply to attention only (target_dims drops
+# the 4-D expert entries automatically).
+LORA_TARGETS = ("wq", "wkv", "wo", "w_gate", "w_up", "w_down")
+
+
+def target_dims(cfg) -> dict[str, tuple[int, int]]:
+    """(d_in, d_out) per adaptable projection, derived via jax.eval_shape
+    over the base init so adapter checkpoints validate against the SAME
+    tree a real engine serves (never a hand-copied dimension table)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..models.transformer import init_params
+
+    shapes = jax.eval_shape(
+        lambda k: init_params(k, cfg),
+        jax.ShapeDtypeStruct((2,), jnp.uint32),
+    )["layers"]
+    out = {}
+    for name in LORA_TARGETS:
+        s = shapes.get(name)
+        if s is None or len(s.shape) != 3:  # MoE expert stacks are 4-D
+            continue
+        out[name] = (int(s.shape[1]), int(s.shape[2]))
+    return out
+
+
+def zero_tables(cfg, pool_slots: int, rank: int, dtype=None) -> dict:
+    """The all-identity stacked tables a LoRA-enabled engine starts with:
+    G = pool_slots + 1 gid rows (gid 0 reserved identity), every entry
+    zero. Tables compute in float32 regardless of the base dtype — the
+    delta matmuls are rank-r slivers, so full precision costs nothing
+    and keeps tiny adapters from drowning in bf16 rounding."""
+    import jax.numpy as jnp
+
+    del dtype  # tables are always f32 (see docstring)
+    L = cfg.n_layers
+    G = int(pool_slots) + 1
+    r = max(1, int(rank))
+    out = {}
+    for name, (d_in, d_out) in target_dims(cfg).items():
+        out[f"lora_{name}_a"] = jnp.zeros((L, G, d_in, r), jnp.float32)
+        out[f"lora_{name}_b"] = jnp.zeros((L, G, r, d_out), jnp.float32)
+    return out
+
+
+def table_specs(tables: dict):
+    """Replicated PartitionSpecs for the stacked tables (zipped into
+    param_specs on sharded engines). Rank-r slivers are too small to
+    shard; replication also keeps the batched gather collective-free."""
+    from jax.sharding import PartitionSpec as P
+
+    return {k: P(*([None] * v.ndim)) for k, v in tables.items()}
+
+
+def validate_adapter(
+    cfg, adapter: dict, *, rank_max: int, alpha: float | None = None,
+) -> dict:
+    """Check an adapter checkpoint against the base config and return the
+    canonical staged form {name: (a [L, d_in, r], b [L, r, d_out])} with
+    the alpha/r scale folded into b (f32).
+
+    Accepted entry forms per target name: {"a": ..., "b": ...} (optional
+    per-entry "alpha") or a bare (a, b) tuple. Raises ValueError on an
+    unknown target, a shape mismatch, or rank > rank_max. An empty
+    adapter is legal — it stages as a pure identity."""
+    import numpy as np
+
+    dims = target_dims(cfg)
+    L = cfg.n_layers
+    out = {}
+    for name, entry in adapter.items():
+        if name not in dims:
+            raise ValueError(
+                f"adapter targets unknown projection {name!r}; expected "
+                f"one of {sorted(dims)}"
+            )
+        if isinstance(entry, dict):
+            a, b = entry.get("a"), entry.get("b")
+            ent_alpha = entry.get("alpha", alpha)
+        else:
+            a, b = entry
+            ent_alpha = alpha
+        if a is None or b is None:
+            raise ValueError(f"adapter entry {name!r} needs both 'a' and 'b'")
+        a = np.asarray(a, np.float32)
+        b = np.asarray(b, np.float32)
+        d_in, d_out = dims[name]
+        if a.ndim != 3 or a.shape[0] != L or a.shape[1] != d_in:
+            raise ValueError(
+                f"adapter {name!r}: A must be [n_layers={L}, {d_in}, r], "
+                f"got {a.shape}"
+            )
+        r = int(a.shape[2])
+        if b.shape != (L, r, d_out):
+            raise ValueError(
+                f"adapter {name!r}: B must be [{L}, {r}, {d_out}] to match "
+                f"A {a.shape}, got {b.shape}"
+            )
+        if r > rank_max:
+            raise ValueError(
+                f"adapter {name!r} rank {r} exceeds the pool's rank_max "
+                f"{rank_max} (TPU_LLM_LORA_RANK_MAX)"
+            )
+        if r > 0 and ent_alpha is not None:
+            b = b * (float(ent_alpha) / r)
+        if r > 0:
+            out[name] = (a, b)
+    return out
+
+
+def init_adapter(
+    rng, cfg, rank: int, *, scale: float = 0.05, targets=None,
+) -> dict:
+    """Random test/bench adapter: A ~ N(0, scale/sqrt(d_in)), B ~ same —
+    both nonzero so adapted outputs measurably differ from the base
+    (real LoRA trains from B=0; a zero B would make every equality test
+    vacuously pass)."""
+    import jax
+    import jax.numpy as jnp
+
+    dims = target_dims(cfg)
+    names = list(targets) if targets is not None else list(dims)
+    L = cfg.n_layers
+    out = {}
+    for i, name in enumerate(names):
+        d_in, d_out = dims[name]
+        ka, kb = jax.random.split(jax.random.fold_in(rng, i))
+        out[name] = {
+            "a": jax.random.normal(ka, (L, d_in, rank), jnp.float32)
+            * (scale / d_in**0.5),
+            "b": jax.random.normal(kb, (L, rank, d_out), jnp.float32)
+            * (scale / max(1, rank) ** 0.5),
+        }
+    return out
+
+
+def merge_adapter(params: dict, cfg, adapter: dict, *, alpha=None) -> dict:
+    """Reference semantics: fold the adapter INTO the base weights
+    (W' = W + A @ B per layer). The equality tests pin the batched-gather
+    serving path against an engine built from these merged weights."""
+    import jax.numpy as jnp
+
+    canon = validate_adapter(cfg, adapter, rank_max=10**9, alpha=alpha)
+    layers = dict(params["layers"])
+    for name, (a, b) in canon.items():
+        w = layers[name]
+        delta = jnp.einsum(
+            "lir,lro->lio", jnp.asarray(a), jnp.asarray(b)
+        )
+        layers[name] = (w.astype(jnp.float32) + delta).astype(w.dtype)
+    return {**params, "layers": layers}
+
+
+class AdapterPoolFull(RuntimeError):
+    """No free gid and every resident adapter has in-flight requests."""
+
+
+class AdapterPool:
+    """Host bookkeeping for the fixed-gid device tables: name -> gid
+    binding, per-gid in-flight refcounts, LRU eviction of idle named
+    adapters, zombie gids (name moved on, refs still draining). NOT
+    thread-safe — the engine calls it under its own lock."""
+
+    def __init__(self, slots: int):
+        self.slots = int(slots)  # usable gids: 1..slots (0 = identity)
+        self._by_name: dict[str, dict] = {}
+        self._refs = [0] * (self.slots + 1)
+        self._zombies: set[int] = set()
+        self._clock = 0  # monotonic LRU tick (no wall time needed)
+        self.evictions = 0
+        self.swaps = 0
+
+    # -- queries ---------------------------------------------------------
+    def resident(self) -> dict[str, dict]:
+        return {
+            name: {
+                "gid": e["gid"], "version": e["version"], "rank": e["rank"],
+                "refs": self._refs[e["gid"]],
+            }
+            for name, e in sorted(self._by_name.items())
+        }
+
+    def lookup(self, name: str) -> dict:
+        return self._by_name[name]
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._by_name
+
+    def __len__(self) -> int:
+        return len(self._by_name)
+
+    def refs(self, gid: int) -> int:
+        return self._refs[gid]
+
+    # -- request lifecycle -----------------------------------------------
+    def acquire(self, name: str) -> int:
+        """Pin one in-flight request to name's gid (KeyError if absent)."""
+        e = self._by_name[name]
+        gid = e["gid"]
+        self._refs[gid] += 1
+        self._clock += 1
+        e["used"] = self._clock
+        return gid
+
+    def release(self, gid: int) -> None:
+        if 0 < gid <= self.slots:
+            self._refs[gid] = max(0, self._refs[gid] - 1)
+            if self._refs[gid] == 0:
+                self._zombies.discard(gid)
+
+    # -- adapter lifecycle -----------------------------------------------
+    def allocate(self, name: str, *, version: str, rank: int) -> int:
+        """Bind ``name`` to a free gid (staging slot for a load). A name
+        collision is an error — hot-loads stage under a distinct staging
+        name and repoint via publish(). Evicts the LRU idle adapter when
+        every gid is taken; raises AdapterPoolFull when none is idle."""
+        if name in self._by_name:
+            raise ValueError(f"adapter {name!r} already resident")
+        taken = {e["gid"] for e in self._by_name.values()}
+        taken |= {g for g in range(1, self.slots + 1) if self._refs[g] > 0}
+        taken |= self._zombies
+        free = [g for g in range(1, self.slots + 1) if g not in taken]
+        if not free:
+            idle = [
+                (e["used"], n) for n, e in self._by_name.items()
+                if self._refs[e["gid"]] == 0
+            ]
+            if not idle:
+                raise AdapterPoolFull(
+                    f"all {self.slots} adapter slots busy (in-flight "
+                    "requests hold every gid)"
+                )
+            _, victim = min(idle)
+            gid = self._by_name.pop(victim)["gid"]
+            self.evictions += 1
+        else:
+            gid = free[0]
+        self._clock += 1
+        self._by_name[name] = {
+            "gid": gid, "version": str(version), "rank": int(rank),
+            "used": self._clock,
+        }
+        return gid
+
+    def publish(self, staging: str, name: str) -> int | None:
+        """Atomically repoint ``name`` at the gid staged under
+        ``staging`` (hot-load commit). Returns the PREVIOUS gid (now a
+        zombie until its in-flight requests drain) or None for a first
+        load."""
+        entry = self._by_name.pop(staging)
+        old = self._by_name.pop(name, None)
+        self._by_name[name] = entry
+        self.swaps += 1
+        if old is None:
+            return None
+        if self._refs[old["gid"]] > 0:
+            self._zombies.add(old["gid"])
+        return old["gid"]
+
+    def remove(self, name: str) -> int:
+        """Unbind a name (retire / canary reject). The gid frees
+        immediately when idle, else drains as a zombie."""
+        e = self._by_name.pop(name)
+        gid = e["gid"]
+        if self._refs[gid] > 0:
+            self._zombies.add(gid)
+        return gid
+
+    def snapshot(self) -> dict[str, Any]:
+        return {
+            "slots": self.slots,
+            "resident": self.resident(),
+            "zombies": sorted(self._zombies),
+            "evictions": self.evictions,
+            "swaps": self.swaps,
+        }

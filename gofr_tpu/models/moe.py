@@ -96,18 +96,52 @@ def moe_init(rng: jax.Array, cfg: MoEConfig) -> dict:
     }
 
 
+_MOE_ACTS = {"gelu": jax.nn.gelu, "silu": jax.nn.silu}
+
+
+def _deq(w):
+    """int8-quantized expert/router weights dequantize into f32 before the
+    dispatch einsums — QTensor can't ride einsum/vmap directly, and the E
+    axis is tiny so the dequant cost is noise next to the expert matmuls."""
+    from .quant import QTensor
+
+    if isinstance(w, QTensor):
+        return w.q.astype(jnp.float32) * w.s.astype(jnp.float32)
+    return w
+
+
 def moe_ffn(
     x: jnp.ndarray,  # [T, d] token-major
     w_router: jnp.ndarray,  # [d, E]
     w_gate: jnp.ndarray,  # [E, d, ff]
     w_up: jnp.ndarray,
     w_down: jnp.ndarray,  # [E, ff, d]
-    cfg: MoEConfig,
+    cfg: MoEConfig | None = None,
+    *,
+    n_experts: int | None = None,
+    top_k: int | None = None,
+    capacity_factor: float | None = None,
+    act: str = "gelu",
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
-    """Returns (y [T, d], aux_loss scalar)."""
+    """Returns (y [T, d], aux_loss scalar).
+
+    Routing hyperparameters come either from explicit kwargs (the serving
+    path — models.transformer._mlp_block dispatches here when a layer
+    carries a router) or from a legacy MoEConfig positional (the in-file
+    training-shaped callers). Weights may be int8 QTensors (see _deq)."""
+    if cfg is not None:
+        n_experts = cfg.n_experts if n_experts is None else n_experts
+        top_k = cfg.top_k if top_k is None else top_k
+        if capacity_factor is None:
+            capacity_factor = cfg.capacity_factor
+    E, k = int(n_experts), int(top_k)
+    cf = 1.25 if capacity_factor is None else float(capacity_factor)
+    act_fn = _MOE_ACTS[act]
+    w_router, w_gate, w_up, w_down = (
+        _deq(w_router), _deq(w_gate), _deq(w_up), _deq(w_down),
+    )
     T, d = x.shape
-    E, k = cfg.n_experts, cfg.top_k
-    C = max(1, math.ceil(T / E * cfg.capacity_factor * k))
+    C = max(1, math.ceil(T / E * cf * k))
 
     logits = (x.astype(jnp.float32)) @ w_router.astype(jnp.float32)  # [T, E]
     probs = jax.nn.softmax(logits, axis=-1)
@@ -136,7 +170,7 @@ def moe_ffn(
     xin = jnp.einsum("tec,td->ecd", dispatch, x.astype(jnp.float32))  # [E, C, d]
 
     def expert(w_g, w_u, w_d, h):
-        a = jax.nn.gelu(h @ w_g.astype(jnp.float32)) * (h @ w_u.astype(jnp.float32))
+        a = act_fn(h @ w_g.astype(jnp.float32)) * (h @ w_u.astype(jnp.float32))
         return a @ w_d.astype(jnp.float32)
 
     yout = jax.vmap(expert)(w_gate, w_up, w_down, xin)  # [E, C, d]

@@ -57,6 +57,12 @@ class TransformerConfig:
     scale_embed: bool = True  # multiply embeddings by sqrt(d_model) (Gemma)
     sliding_window: int = 0  # Mistral-style local attention; 0 = global
     qkv_bias: bool = False  # Qwen2-style bias on the q/k/v projections
+    # Mixture-of-experts MLP (0 = dense). Experts replace the dense GeGLU
+    # with a routed top-k dispatch (models.moe.moe_ffn) inside the same
+    # scanned layer body; attention is unchanged.
+    n_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity: float = 1.25
     dtype: Any = jnp.bfloat16
 
     # ---- presets -------------------------------------------------------
@@ -143,6 +149,16 @@ class TransformerConfig:
             n_kv_heads=2, head_dim=16, d_ff=128, dtype=jnp.float32,
         )
 
+    @staticmethod
+    def tiny_moe(vocab_size: int = 512) -> "TransformerConfig":
+        """CI-sized sparse config: 4 experts, top-2 routing — expert count
+        divisible by TP=2/4 for the 8-virtual-device CPU mesh tests."""
+        return TransformerConfig(
+            vocab_size=vocab_size, d_model=64, n_layers=2, n_heads=4,
+            n_kv_heads=2, head_dim=16, d_ff=128, dtype=jnp.float32,
+            n_experts=4, moe_top_k=2,
+        )
+
 
 class KVCache(NamedTuple):
     """Preallocated per-layer KV with a per-sequence write cursor."""
@@ -182,16 +198,18 @@ def init_params(rng: jax.Array, cfg: TransformerConfig) -> dict:
         if cfg.qkv_bias
         else {}
     )
-    return {
-        "embed": w(keys[0], (cfg.vocab_size, d), d),
-        "final_norm": jnp.zeros((d,), cfg.dtype),
-        "layers": {
-            **bias,
-            "attn_norm": jnp.zeros((L, d), cfg.dtype),
-            "wq": w(keys[1], (L, d, hq * hd), d),
-            "wkv": w(keys[2], (L, d, 2 * hkv * hd), d),
-            "wo": w(keys[3], (L, hq * hd, d), hq * hd),
-            "mlp_norm": jnp.zeros((L, d), cfg.dtype),
+    if cfg.n_experts > 0:
+        # Sparse MLP: experts batched on a leading E axis (the EP shard
+        # axis — parallel.sharding.param_specs) plus a replicated router.
+        E = cfg.n_experts
+        mlp = {
+            "w_router": w(jax.random.fold_in(keys[3], 1), (L, d, E), d),
+            "w_gate": w(keys[4], (L, E, d, ff), d),
+            "w_up": w(jax.random.fold_in(keys[4], 1), (L, E, d, ff), d),
+            "w_down": w(keys[5], (L, E, ff, d), ff),
+        }
+    else:
+        mlp = {
             # gate and up are SEPARATE tensors, not a fused [d, 2*ff] matmul:
             # both get identical column-parallel shardings (so the
             # gelu(gate)*up product is TP-collective-free), and each matmul
@@ -202,6 +220,18 @@ def init_params(rng: jax.Array, cfg: TransformerConfig) -> dict:
             "w_gate": w(keys[4], (L, d, ff), d),
             "w_up": w(jax.random.fold_in(keys[4], 1), (L, d, ff), d),
             "w_down": w(keys[5], (L, ff, d), ff),
+        }
+    return {
+        "embed": w(keys[0], (cfg.vocab_size, d), d),
+        "final_norm": jnp.zeros((d,), cfg.dtype),
+        "layers": {
+            **bias,
+            "attn_norm": jnp.zeros((L, d), cfg.dtype),
+            "wq": w(keys[1], (L, d, hq * hd), d),
+            "wkv": w(keys[2], (L, d, 2 * hkv * hd), d),
+            "wo": w(keys[3], (L, hq * hd, d), hq * hd),
+            "mlp_norm": jnp.zeros((L, d), cfg.dtype),
+            **mlp,
         },
     }
 
@@ -263,6 +293,54 @@ def _act_fn(cfg: TransformerConfig):
         ) from None
 
 
+def _lora_delta(h, lp, name, aids):
+    """Per-row batched LoRA delta (h @ A[gid]) @ B[gid], f32, or None when
+    this layer carries no stacked tables / no adapter ids were passed —
+    the None path keeps non-LoRA engines byte-identical (the whole branch
+    is static pytree structure, so XLA never sees it).
+
+    ``lp[f"lora_{name}_a"]`` is [G, d_in, r] after the layer scan slices
+    the leading L axis; ``aids`` is [rows] int32 selecting each batch
+    row's adapter (gid 0 = all-zero identity tables, whose +0.0 delta
+    cannot change any downstream value — gofr_tpu.lora)."""
+    a = lp.get("lora_" + name + "_a")
+    if a is None or aids is None:
+        return None
+    b = lp["lora_" + name + "_b"]
+    ag = jnp.take(a, aids, axis=0)  # [rows, d_in, r]
+    bg = jnp.take(b, aids, axis=0)  # [rows, r, d_out]
+    t = jnp.einsum("bsd,bdr->bsr", h.astype(jnp.float32), ag)
+    return jnp.einsum("bsr,bro->bso", t, bg)
+
+
+def _lora_mm(mm, h, lp, name, aids):
+    """Base projection plus (optional) per-row adapter delta."""
+    out = mm(h, lp[name])
+    d = _lora_delta(h, lp, name, aids)
+    return out if d is None else out + d.astype(out.dtype)
+
+
+def _mlp_block(cfg, h, lp, mm, aids=None):
+    """Post-norm MLP output (the caller adds the residual): dense GeGLU
+    with optional per-row LoRA deltas, or the routed top-k mixture when
+    the layer carries a router (MoE checkpoints — models.moe). LoRA
+    skips expert weights by construction (lora.target_dims drops 4-D
+    stacks), so the two features compose on attention projections."""
+    if "w_router" in lp:
+        from .moe import moe_ffn
+
+        b, s, d = h.shape
+        y, _ = moe_ffn(
+            h.reshape(b * s, d), lp["w_router"], lp["w_gate"], lp["w_up"],
+            lp["w_down"], n_experts=cfg.n_experts, top_k=cfg.moe_top_k,
+            capacity_factor=cfg.moe_capacity, act=cfg.act,
+        )
+        return y.reshape(b, s, d).astype(h.dtype)
+    g = _lora_mm(mm, h, lp, "w_gate", aids)
+    u = _lora_mm(mm, h, lp, "w_up", aids)
+    return _lora_mm(mm, _act_fn(cfg)(g) * u, lp, "w_down", aids)
+
+
 def _layer_body(
     cfg: TransformerConfig,
     x: jnp.ndarray,  # [b, s, d]
@@ -274,6 +352,7 @@ def _layer_body(
     cache_length: jnp.ndarray | None,  # [b]
     decode: bool,
     prefill_attn=None,  # optional (q, k, v) -> attn override (ring/SP path)
+    aids: jnp.ndarray | None = None,  # [b] int32 per-row adapter ids (LoRA)
 ):
     b, s, d = x.shape
     hq, hkv, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
@@ -284,14 +363,14 @@ def _layer_body(
     mm = qmm if decode else qmm_a8
 
     h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
-    q = mm(h, lp["wq"])
+    q = _lora_mm(mm, h, lp, "wq", aids)
     if cfg.qkv_bias:  # Qwen2: bias rides the flat output (pre-reshape)
         q = q + lp["bq"].astype(q.dtype)
     q = q.reshape(b, s, hq, hd)
     # wkv packs heads OUTERMOST ([hkv, 2, hd] per output column block) so a
     # TP shard of the flat output dim holds whole (k, v) head pairs — keeps
     # Megatron column-parallel layout collective-free inside the layer.
-    kv = mm(h, lp["wkv"])
+    kv = _lora_mm(mm, h, lp, "wkv", aids)
     if cfg.qkv_bias:
         kv = kv + lp["bkv"].astype(kv.dtype)
     kv = kv.reshape(b, s, hkv, 2, hd)
@@ -327,11 +406,12 @@ def _layer_body(
         # Prefill fills the cache from position 0 (right-padded batches).
         new_k, new_v = k, v
 
-    x = x + mm(attn.reshape(b, s, hq * hd), lp["wo"]).astype(x.dtype)
+    x = x + _lora_mm(mm, attn.reshape(b, s, hq * hd), lp, "wo", aids).astype(
+        x.dtype
+    )
 
     h = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
-    act = _act_fn(cfg)
-    x = x + mm(act(mm(h, lp["w_gate"])) * mm(h, lp["w_up"]), lp["w_down"])
+    x = x + _mlp_block(cfg, h, lp, mm, aids)
     return x, new_k, new_v
 
 
@@ -346,6 +426,7 @@ def transformer_forward(
     decode: bool = False,
     unembed_positions: jnp.ndarray | None = None,  # [b] -> logits only there
     prefill_attn=None,  # optional attention override for the prefill path
+    aids: jnp.ndarray | None = None,  # [b] int32 per-row adapter ids (LoRA)
 ) -> tuple[jnp.ndarray, KVCache | None]:
     """Returns (logits float32, updated cache or None).
 
@@ -364,6 +445,7 @@ def transformer_forward(
             x, nk, nv = _layer_body(
                 cfg, x, lp, positions,
                 k_cache=kc, v_cache=vc, cache_length=cache.length, decode=True,
+                aids=aids,
             )
             return (x, None), (nk, nv)
 
@@ -378,7 +460,7 @@ def transformer_forward(
             x, nk, nv = _layer_body(
                 cfg, x, lp, positions,
                 k_cache=None, v_cache=None, cache_length=None, decode=False,
-                prefill_attn=prefill_attn,
+                prefill_attn=prefill_attn, aids=aids,
             )
             return (x, None), (nk, nv)
 
@@ -543,6 +625,10 @@ def decode_chunk(
     hq, hkv, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
     max_len = cache.k.shape[2]
     K = n_steps
+    # LoRA engines carry per-slot adapter ids beside the weights; chunk
+    # lanes ARE engine slots, so the vector applies row-for-row (absent on
+    # plain engines — static pytree structure, program unchanged).
+    aids = params.get("aids")
     kb0 = jnp.zeros((L, b, K, hkv, hd), cache.k.dtype)
     vb0 = jnp.zeros((L, b, K, hkv, hd), cache.v.dtype)
     rng, sub = jax.random.split(rng)
@@ -556,11 +642,11 @@ def decode_chunk(
         def layer(x, lp, rest):
             kc_l, vc_l, kb_l, vb_l = rest
             h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
-            q = qmm(h, lp["wq"])
+            q = _lora_mm(qmm, h, lp, "wq", aids)
             if cfg.qkv_bias:
                 q = q + lp["bq"].astype(q.dtype)
             q = q.reshape(b, 1, hq, hd)
-            kv = qmm(h, lp["wkv"])
+            kv = _lora_mm(qmm, h, lp, "wkv", aids)
             if cfg.qkv_bias:
                 kv = kv + lp["bkv"].astype(kv.dtype)
             kv = kv.reshape(b, 1, hkv, 2, hd)
@@ -578,12 +664,11 @@ def decode_chunk(
                 logit_cap=cfg.attn_logit_cap, window=cfg.sliding_window,
                 ring=ring,
             )
-            x = x + qmm(attn.reshape(b, 1, hq * hd), lp["wo"]).astype(x.dtype)
+            x = x + _lora_mm(
+                qmm, attn.reshape(b, 1, hq * hd), lp, "wo", aids
+            ).astype(x.dtype)
             h = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
-            x = x + qmm(
-                _act_fn(cfg)(qmm(h, lp["w_gate"])) * qmm(h, lp["w_up"]),
-                lp["w_down"],
-            )
+            x = x + _mlp_block(cfg, h, lp, qmm, aids)
             return x, (kb_l, vb_l)
 
         x, (kb, vb) = _layer_scan(
@@ -687,6 +772,7 @@ def decode_chunk_paged(
     L, b = cfg.n_layers, tokens.shape[0]
     hq, hkv, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
     K = n_steps
+    aids = params.get("aids")  # per-slot adapter ids (see decode_chunk)
     quant = scales is not None and scales.size > 0
     kb0 = jnp.zeros((L, b, K, hkv, hd), cfg.dtype)
     vb0 = jnp.zeros((L, b, K, hkv, hd), cfg.dtype)
@@ -708,11 +794,11 @@ def decode_chunk_paged(
                 kp_l, vp_l, kb_l, vb_l = rest
                 ks_l = vs_l = None
             h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
-            q = qmm(h, lp["wq"])
+            q = _lora_mm(qmm, h, lp, "wq", aids)
             if cfg.qkv_bias:
                 q = q + lp["bq"].astype(q.dtype)
             q = q.reshape(b, 1, hq, hd)
-            kv = qmm(h, lp["wkv"])
+            kv = _lora_mm(qmm, h, lp, "wkv", aids)
             if cfg.qkv_bias:
                 kv = kv + lp["bkv"].astype(kv.dtype)
             kv = kv.reshape(b, 1, hkv, 2, hd)
@@ -731,12 +817,11 @@ def decode_chunk_paged(
                 k_scales=ks_l, v_scales=vs_l,
                 use_kernel=use_kernel, interpret=interpret,
             )
-            x = x + qmm(attn.reshape(b, 1, hq * hd), lp["wo"]).astype(x.dtype)
+            x = x + _lora_mm(
+                qmm, attn.reshape(b, 1, hq * hd), lp, "wo", aids
+            ).astype(x.dtype)
             h = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
-            x = x + qmm(
-                _act_fn(cfg)(qmm(h, lp["w_gate"])) * qmm(h, lp["w_up"]),
-                lp["w_down"],
-            )
+            x = x + _mlp_block(cfg, h, lp, qmm, aids)
             return x, (kb_l, vb_l)
 
         rest = (
@@ -786,11 +871,17 @@ def _append_forward(
     n_new: jnp.ndarray,  # [b] int32 — valid tokens in this chunk (<= c)
     *,
     ring: int = 0,
+    aids: jnp.ndarray | None = None,  # [b] int32 per-row adapter ids (LoRA)
 ) -> tuple[jnp.ndarray, tuple[jnp.ndarray, jnp.ndarray]]:
     """Shared write-then-attend chunk append (prefill_append and
     verify_chunk): write the chunk's K/V rows at the per-sequence cursor,
     attend over all resident keys + the chunk's causal triangle, return
-    the final hidden states [b, c, d] plus the updated (k, v) stacks."""
+    the final hidden states [b, c, d] plus the updated (k, v) stacks.
+
+    ``aids`` is EXPLICIT here (unlike the decode chunks, which read
+    params["aids"] directly): the unified step ops prefill a PACKED
+    subset of engine slots, so the caller gathers the per-slot vector
+    down to the rows actually present."""
     b, c = tokens.shape
     hq, hkv, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
     capacity = cache.k.shape[2]
@@ -808,11 +899,11 @@ def _append_forward(
     def layer(x, xs):
         lp, kc, vc = xs  # [b, capacity, hkv, hd]
         h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
-        q = mm(h, lp["wq"])
+        q = _lora_mm(mm, h, lp, "wq", aids)
         if cfg.qkv_bias:
             q = q + lp["bq"].astype(q.dtype)
         q = q.reshape(b, c, hq, hd)
-        kv = mm(h, lp["wkv"])
+        kv = _lora_mm(mm, h, lp, "wkv", aids)
         if cfg.qkv_bias:
             kv = kv + lp["bkv"].astype(kv.dtype)
         kv = kv.reshape(b, c, hkv, 2, hd)
@@ -827,11 +918,11 @@ def _append_forward(
             logit_cap=cfg.attn_logit_cap, window=cfg.sliding_window,
             ring=ring,
         )
-        x = x + mm(attn.reshape(b, c, hq * hd), lp["wo"]).astype(x.dtype)
+        x = x + _lora_mm(
+            mm, attn.reshape(b, c, hq * hd), lp, "wo", aids
+        ).astype(x.dtype)
         h = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
-        x = x + mm(
-            _act_fn(cfg)(mm(h, lp["w_gate"])) * mm(h, lp["w_up"]), lp["w_down"]
-        )
+        x = x + _mlp_block(cfg, h, lp, mm, aids)
         return x, (kc, vc)
 
     x, (ks, vs) = jax.lax.scan(layer, x, (params["layers"], cache.k, cache.v))
@@ -847,6 +938,7 @@ def prefill_append(
     n_new: jnp.ndarray,  # [b] int32 — valid tokens in this chunk (<= c)
     *,
     ring: int = 0,  # >0: cache is a rolling ring of this capacity
+    aids: jnp.ndarray | None = None,  # [b] int32 per-row adapter ids (LoRA)
 ) -> tuple[jnp.ndarray, KVCache]:
     """Append one prefill chunk into an existing per-slot KV cache.
 
@@ -873,7 +965,7 @@ def prefill_append(
     """
     b, c = tokens.shape
     x, (ks, vs) = _append_forward(
-        params, cfg, tokens, cache, cursors, n_new, ring=ring
+        params, cfg, tokens, cache, cursors, n_new, ring=ring, aids=aids
     )
     last = jnp.clip(n_new - 1, 0, c - 1)
     x_last = jnp.take_along_axis(x, last[:, None, None].astype(jnp.int32), axis=1)
@@ -891,6 +983,7 @@ def verify_chunk(
     n_new: jnp.ndarray,  # [b] int32 — valid tokens (1 + drafts; <= c)
     *,
     ring: int = 0,  # >0: cache is a rolling ring of this capacity
+    aids: jnp.ndarray | None = None,  # [b] int32 per-row adapter ids (LoRA)
 ) -> tuple[jnp.ndarray, KVCache]:
     """Score every position of a speculative-decoding draft in ONE
     forward pass (gofr_tpu.spec; docs/advanced-guide/speculative-decoding.md).
@@ -915,7 +1008,7 @@ def verify_chunk(
     count). Positions >= n_new carry garbage logits the engine ignores.
     """
     x, (ks, vs) = _append_forward(
-        params, cfg, tokens, cache, cursors, n_new, ring=ring
+        params, cfg, tokens, cache, cursors, n_new, ring=ring, aids=aids
     )
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
     logits = _unembed(params, cfg, x)  # [b, c, vocab] f32

@@ -13,15 +13,24 @@ any other route):
   (``data: {chunk}\\n\\n`` ... ``data: [DONE]\\n\\n``); ``response_format
   {"type": "json_schema"}`` compiles the attached schema to a token
   grammar (gofr_tpu.structured) so the answer is schema-valid BY
-  CONSTRUCTION, not by retry.
+  CONSTRUCTION, not by retry; ``{"type": "regex", "regex": "..."}``
+  rides the same byte-regex -> token-DFA compiler for free-form
+  pattern-constrained output.
 - ``POST /v1/embeddings`` — mean-pooled model embedding rows,
   L2-normalized; accepts a string, a list of strings, or token-id lists.
-- ``GET /v1/models`` — the registered model list.
+- ``GET /v1/models`` — the registered model list, plus every resident
+  LoRA adapter as a first-class model id (``parent`` names its base —
+  the shape OpenAI uses for fine-tunes). ``model=<adapter>`` on the
+  chat route selects that tenant's delta over the shared base program
+  (docs/advanced-guide/multi-tenancy.md); an unknown non-empty name
+  answers the OpenAI 404 envelope rather than silently serving base
+  weights.
 
 Identity mapping: the OpenAI ``user`` field and the native
-``X-GoFr-Client``/``X-GoFr-Priority``/``X-GoFr-Session`` headers both
-feed the fair-queuing/overload machinery (handler.llm_request_kwargs);
-429/503 responses carry Retry-After exactly like the native edge.
+``X-GoFr-Client``/``X-GoFr-Priority``/``X-GoFr-Session``/
+``X-GoFr-Adapter`` headers both feed the fair-queuing/overload/
+multi-tenancy machinery (handler.llm_request_kwargs); 429/503
+responses carry Retry-After exactly like the native edge.
 
 Tokenization: pass a tokenizer (models.tokenizer.Tokenizer or anything
 with encode/decode/eos_id); without one the edge falls back to the
@@ -30,7 +39,7 @@ dependency-free byte-level tokenizer when the model's vocab admits it
 error envelope ``{"error": {"message", "type", "code"}}``.
 
 Knobs (docs/references/configs.md): ``GOFR_OPENAI_MODEL`` (served model
-name when the request omits/mismatches), ``GOFR_OPENAI_MAX_TOKENS``
+name when the request omits one), ``GOFR_OPENAI_MAX_TOKENS``
 (default + cap for max_tokens), ``GOFR_OPENAI_STREAM_TIMEOUT_S``.
 """
 
@@ -103,15 +112,44 @@ def register_openai_routes(
     # explicit `tokenizer=` applies to every model (single-model apps).
     state: dict[str, Any] = {"tok": {}, "embed": None, "vocab": {}}
 
+    def _adapter_names(handle) -> list[str]:
+        """Resident LoRA adapter names on this model (multi-tenancy.md),
+        plus the fleet's registered set — a replica mid-rebuild may lag
+        the registry, and the edge should still route to the fleet."""
+        eng = getattr(handle, "engine", handle)
+        names: set[str] = set()
+        try:
+            snap = eng.adapters()
+            names.update(snap.get("resident", {}))
+            names.update(snap.get("registered", ()))
+        except Exception:  # noqa: BLE001 — non-engine handles have no pool
+            pass
+        return sorted(names)
+
     def _handle(ctx, name: str = ""):
+        """Resolve the request's ``model`` field to (served name, handle,
+        adapter). A LoRA adapter name is a first-class model id here:
+        ``model=<adapter>`` routes to its base handle with the adapter
+        selected (one resident base, N tenant deltas — multi-tenancy.md).
+        Unknown NON-EMPTY names raise KeyError (the routes answer the
+        OpenAI 404 envelope) instead of silently serving base weights to
+        a tenant that asked for its fine-tune."""
         rt = ctx.container.tpu()
         llms = getattr(rt, "_llms", {})
         want = name or default_model
         if want and want in llms:
-            return want, llms[want]
+            return want, llms[want], ""
+        if want:
+            for base_name, handle in llms.items():
+                if want in _adapter_names(handle):
+                    return base_name, handle, want
+            raise KeyError(
+                f"model {want!r} not found; registered: "
+                f"{sorted(llms) or 'none'}"
+            )
         if llms:
             first = next(iter(llms))
-            return first, llms[first]
+            return first, llms[first], ""
         raise KeyError("no LLM registered")
 
     def _tokenizer(name: str, handle):
@@ -136,22 +174,32 @@ def register_openai_routes(
         ftype = response_format.get("type")
         if ftype in (None, "text"):
             return None
-        if ftype != "json_schema":
+        if ftype not in ("json_schema", "regex"):
             raise _OpenAIReject(_openai_error(
                 400,
                 f"response_format type {ftype!r} unsupported; use "
-                "'json_schema' (a full free-form 'json_object' grammar "
-                "needs a pushdown automaton, not a DFA)",
+                "'json_schema' or 'regex' (a full free-form 'json_object' "
+                "grammar needs a pushdown automaton, not a DFA)",
             ))
-        spec = response_format.get("json_schema") or {}
-        schema = spec.get("schema", spec if "properties" in spec else None)
-        if schema is None:
-            raise _OpenAIReject(_openai_error(
-                400, "response_format.json_schema.schema missing",
-            ))
+        if ftype == "regex":
+            pattern = response_format.get("regex") or response_format.get(
+                "pattern"
+            )
+            if not isinstance(pattern, str) or not pattern:
+                raise _OpenAIReject(_openai_error(
+                    400, "response_format.regex missing (pattern string)",
+                ))
+            schema = None
+        else:
+            spec = response_format.get("json_schema") or {}
+            schema = spec.get("schema", spec if "properties" in spec else None)
+            if schema is None:
+                raise _OpenAIReject(_openai_error(
+                    400, "response_format.json_schema.schema missing",
+                ))
         if tok is None:
             raise _OpenAIReject(_openai_error(
-                400, "json_schema needs a tokenizer on this deployment",
+                400, f"{ftype} needs a tokenizer on this deployment",
             ))
         from .structured import (
             JsonSchemaError,
@@ -167,6 +215,10 @@ def register_openai_routes(
                 400, "tokenizer exposes no eos; cannot close a grammar",
             ))
         try:
+            if ftype == "regex":
+                return grammar_cache.get_regex(
+                    pattern, state["vocab"][name], int(eos)
+                )
             return grammar_cache.get(schema, state["vocab"][name], int(eos))
         except JsonSchemaError as e:
             raise _OpenAIReject(_openai_error(400, str(e))) from e
@@ -175,17 +227,26 @@ def register_openai_routes(
         def __init__(self, resp: Response):
             self.resp = resp
 
-    def _gen_kwargs(ctx, body) -> dict:
+    def _gen_kwargs(ctx, body, adapter: str = "") -> dict:
         from .handler import llm_request_kwargs
 
         kw = llm_request_kwargs(ctx)
         user = body.get("user")
         if user and not kw.get("client"):
             kw["client"] = str(user)
+        # adapter from model-name resolution; an explicit X-GoFr-Adapter
+        # header (already in kw) wins — it is the more specific signal
+        if adapter and not kw.get("adapter"):
+            kw["adapter"] = adapter
         return kw
 
-    def _submit(ctx, name, handle, body, tok):
-        from .llm import EngineDraining, EngineOverloaded, GenRequest
+    def _submit(ctx, name, handle, body, tok, adapter: str = ""):
+        from .llm import (
+            EngineDraining,
+            EngineOverloaded,
+            GenRequest,
+            UnknownAdapterError,
+        )
 
         messages = body.get("messages")
         if not isinstance(messages, list) or not messages:
@@ -217,7 +278,7 @@ def register_openai_routes(
             temperature=float(body.get("temperature") or 0.0),
             eos_token=eos,
             grammar=grammar,
-            **_gen_kwargs(ctx, body),
+            **_gen_kwargs(ctx, body, adapter),
         )
         try:
             return handle.submit(req), len(toks)
@@ -227,6 +288,10 @@ def register_openai_routes(
                 status, str(e), etype="rate_limit_error" if status == 429
                 else "service_unavailable",
                 retry_after=getattr(e, "retry_after", None),
+            )) from e
+        except UnknownAdapterError as e:
+            raise _OpenAIReject(_openai_error(
+                404, str(e), etype="not_found_error",
             )) from e
         except ValueError as e:
             raise _OpenAIReject(_openai_error(400, str(e))) from e
@@ -241,17 +306,19 @@ def register_openai_routes(
         if not isinstance(body, dict):
             return _openai_error(400, "body must be a JSON object")
         try:
-            name, handle = _handle(ctx, str(body.get("model") or ""))
+            name, handle, adapter = _handle(ctx, str(body.get("model") or ""))
         except KeyError as e:
             return _openai_error(404, str(e), etype="not_found_error")
         tok = _tokenizer(name, handle)
         try:
-            req, n_prompt = _submit(ctx, name, handle, body, tok)
+            req, n_prompt = _submit(ctx, name, handle, body, tok, adapter)
         except _OpenAIReject as e:
             return e.resp
         cid = f"chatcmpl-{uuid.uuid4().hex[:16]}"
         created = int(time.time())
-        base = {"id": cid, "created": created, "model": name}
+        # answers echo the model the CLIENT selected: the adapter name
+        # when the request routed through a tenant fine-tune
+        base = {"id": cid, "created": created, "model": adapter or name}
         eos_id = req.eos_token
 
         if body.get("stream"):
@@ -337,7 +404,7 @@ def register_openai_routes(
         if not isinstance(body, dict):
             return _openai_error(400, "body must be a JSON object")
         try:
-            name, handle = _handle(ctx, str(body.get("model") or ""))
+            name, handle, _adapter = _handle(ctx, str(body.get("model") or ""))
         except KeyError as e:
             return _openai_error(404, str(e), etype="not_found_error")
         raw = body.get("input")
@@ -393,17 +460,30 @@ def register_openai_routes(
     def list_models(ctx):
         rt = ctx.container.tpu()
         llms = getattr(rt, "_llms", {})
-        return Response(200, [("Content-Type", "application/json")], to_json_bytes({
-            "object": "list",
-            "data": [
-                {
-                    "id": served_name or name,
+        data = [
+            {
+                "id": served_name or name,
+                "object": "model",
+                "created": 0,
+                "owned_by": "gofr_tpu",
+            }
+            for name in llms
+        ]
+        # LoRA adapters are first-class model ids (multi-tenancy.md):
+        # every resident adapter lists beside its base, the same shape
+        # OpenAI uses for fine-tunes — `parent` names the base model
+        for name, handle in llms.items():
+            for aname in _adapter_names(handle):
+                data.append({
+                    "id": aname,
                     "object": "model",
                     "created": 0,
                     "owned_by": "gofr_tpu",
-                }
-                for name in llms
-            ],
+                    "parent": served_name or name,
+                })
+        return Response(200, [("Content-Type", "application/json")], to_json_bytes({
+            "object": "list",
+            "data": data,
         }))
 
     # chat completions get their own timeout budget: a non-streaming

@@ -49,6 +49,25 @@ def param_specs(
         if getattr(cfg, "qkv_bias", False)
         else {}
     )
+    n_experts = getattr(cfg, "n_experts", 0)
+    if n_experts > 0:
+        # Expert parallelism over the SAME "model" axis (a TP submesh is
+        # the EP group): expert-batched [L, E, ...] weights shard on E
+        # when the degree divides the expert count, the router stays
+        # replicated. Head-granularity attention sharding is unchanged.
+        e = m if (tp > 1 and n_experts % tp == 0) else None
+        mlp = {
+            "w_router": P(None, None, None),
+            "w_gate": P(None, e, None, None),
+            "w_up": P(None, e, None, None),
+            "w_down": P(None, e, None, None),
+        }
+    else:
+        mlp = {
+            "w_gate": P(None, None, m),
+            "w_up": P(None, None, m),
+            "w_down": P(None, m, None),
+        }
     return {
         **extra,
         "embed": P(m, None),
@@ -60,9 +79,7 @@ def param_specs(
             "wkv": P(None, None, kv),
             "wo": P(None, q, None),
             "mlp_norm": P(None, None),
-            "w_gate": P(None, None, m),
-            "w_up": P(None, None, m),
-            "w_down": P(None, m, None),
+            **mlp,
         },
     }
 

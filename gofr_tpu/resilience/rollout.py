@@ -111,14 +111,17 @@ def _env_int(name: str, default: int) -> int:
 
 
 def shadow_probe(candidate, prompts, *, max_new: int = SHADOW_MAX_NEW,
-                 timeout: float = 60.0) -> tuple[bool, str]:
+                 timeout: float = 60.0, adapter: str = "") -> tuple[bool, str]:
     """Replay a few REAL prompts on a not-yet-routed candidate engine
     and judge sanity only: the stream must complete (``max_new`` tokens
     — no eos is set, a short stream means a dying engine) and stay
     inside the vocabulary (the numerical-watchdog sentinel ``-1`` is
     out-of-vocabulary by construction). Token equality is deliberately
     NOT checked — a new model version legitimately answers differently;
-    what must not change is that it answers at all."""
+    what must not change is that it answers at all. ``adapter`` routes
+    the replay through a STAGED LoRA adapter on a live engine (the
+    adapter hot-load gate: the candidate is a table row, not an
+    engine)."""
     from ..llm import GenRequest
 
     vocab = getattr(getattr(candidate, "cfg", None), "vocab_size", None)
@@ -126,7 +129,7 @@ def shadow_probe(candidate, prompts, *, max_new: int = SHADOW_MAX_NEW,
         try:
             req = candidate.submit(GenRequest(
                 list(prompt), max_new_tokens=max_new, temperature=0.0,
-                eos_token=-1,
+                eos_token=-1, adapter=adapter,
             ))
             toks = req.tokens(timeout=timeout)
         except Exception as e:  # noqa: BLE001 — a crashing replay IS the verdict
@@ -598,6 +601,25 @@ class _EngineSwapRollout(_RolloutBase):
             self.error = f"build failed: {e!r}"
             self._finish("rolled_back")
             return
+        # re-stage registered adapters BEFORE the gate (gofr_tpu.lora):
+        # the candidate must serve the same tenant set as the engine it
+        # replaces, and a failed re-stage is a gate failure — swapping
+        # in an engine that 404s every tenant is a regression
+        if getattr(cand, "lora_slots", 0):
+            for aname, rec in list(handle._adapters_host.items()):
+                try:
+                    cand.load_adapter(
+                        aname, rec["adapter"], version=rec["version"],
+                        alpha=rec["alpha"], fair_weight=rec["fair_weight"],
+                    )
+                except Exception as e:  # noqa: BLE001
+                    self.error = f"adapter {aname!r} re-stage failed: {e!r}"
+                    try:
+                        cand.close()
+                    except Exception:  # noqa: BLE001
+                        pass
+                    self._finish("rolled_back")
+                    return
         ok, detail = self._gate(cand)
         if not ok:
             self.error = detail
@@ -716,6 +738,10 @@ class ModelHandle:
         self._swap: _EngineSwapRollout | None = None
         # single-engine shadow source (the fleet keeps its own ring)
         self._shadow_ring: list = []
+        # single-engine adapter registry (gofr_tpu.lora): host copies of
+        # registered adapters so a blue-green engine swap re-stages them
+        # into the candidate (the fleet keeps its own _adapters_host)
+        self._adapters_host: dict = {}
 
     # -- engine surface ----------------------------------------------------
     @property
@@ -790,6 +816,89 @@ class ModelHandle:
             )
             self._swap.start()
         return self._swap.snapshot()
+
+    # -- multi-tenant adapters (gofr_tpu.lora;
+    # docs/advanced-guide/multi-tenancy.md) --------------------------------
+    def register_adapter(
+        self, name: str, adapter: dict, *, version: str = "v1",
+        alpha: float | None = None, fair_weight: float | None = None,
+        shadow_probes: int | None = None,
+    ) -> dict:
+        """Canary-gated adapter hot-load — the PR 9 deploy shape scaled
+        down to a table row. The checkpoint is validated against the
+        base config (``lora.validate_adapter`` via the engine's
+        ``eval_shape``-derived dims; a bad shape is a ValueError/4xx,
+        never a corrupted table), staged under ``<name>@<version>``,
+        shadow-gated with real recent prompts replayed THROUGH the
+        staged delta on the live engine, and only then atomically
+        published under ``name``. On a gate reject the staging row is
+        evicted and the previous binding of ``name`` — if any — keeps
+        serving untouched (canary-reject-keeps-serving, test-pinned).
+        In-flight requests on a replaced binding drain on their old gid.
+        ``fair_weight`` sets the tenant's FairLedger share
+        (``adapter:<name>``) after publish."""
+        eng = self._engine
+        staging = f"{name}@{version}"
+        probes = (
+            _env_int("TPU_LLM_ADAPTER_SHADOW", 2)
+            if shadow_probes is None else max(0, int(shadow_probes))
+        )
+        eng.load_adapter(staging, adapter, version=version, alpha=alpha)
+        ring = getattr(eng, "_shadow_ring", None)
+        if ring is None:  # bare engine: the handle keeps the ring
+            ring = self._shadow_ring
+        seen: list[tuple] = []
+        for p in reversed(list(ring)):
+            if p not in seen:
+                seen.append(p)
+            if len(seen) >= probes:
+                break
+        if probes > 0 and seen:
+            ok, detail = shadow_probe(eng, seen, adapter=staging)
+            if not ok:
+                eng.evict_adapter(staging)
+                host = getattr(eng, "_adapters_host", None)
+                if host is not None:
+                    host.pop(staging, None)
+                if self._metrics is not None:
+                    self._metrics.increment_counter(
+                        "app_llm_rollouts_rolled_back_total",
+                        model=getattr(eng, "label", self.name),
+                    )
+                raise RolloutError(
+                    f"adapter {name!r} version {version!r} rejected by "
+                    f"shadow gate: {detail}"
+                )
+        eng.publish_adapter(staging, name)
+        if fair_weight is not None:
+            ledger = getattr(eng, "ledger", None)
+            if ledger is not None:
+                ledger.set_weight(f"adapter:{name}", fair_weight)
+        # host registry: the fleet keeps its own (replica rebuilds
+        # re-stage from it); a bare engine's lives on this handle so the
+        # blue-green engine swap can re-stage into its candidate
+        rec = {
+            "adapter": adapter, "version": str(version), "alpha": alpha,
+            "fair_weight": fair_weight,
+        }
+        host = getattr(eng, "_adapters_host", None)
+        if host is not None:
+            host.pop(staging, None)
+            host[name] = rec
+        else:
+            self._adapters_host[name] = rec
+        return {"name": name, "version": version, "state": "published"}
+
+    def retire_adapter(self, name: str) -> None:
+        """Unbind ``name`` everywhere (idle gids free now, busy ones
+        drain as zombies) and forget its host copy — a later engine
+        swap or replica rebuild will not resurrect it."""
+        eng = self._engine
+        self._adapters_host.pop(name, None)
+        host = getattr(eng, "_adapters_host", None)
+        if host is not None:
+            host.pop(name, None)
+        eng.evict_adapter(name)
 
     def rollout_state(self) -> dict | None:
         eng = self._engine

@@ -18,7 +18,10 @@ The pipeline, all host-side and model-free:
    subset (object/array/string/number/integer/boolean/null/enum/const/
    anyOf, bounded repetition, fixed required-property order) lowers to a
    small regex AST over BYTES. Optional JSON whitespace is admitted at
-   the structural positions.
+   the structural positions. A second front-end (`_RegexParser` /
+   `compile_regex`, the OpenAI edge's ``response_format={"type":
+   "regex"}``) lowers a DFA-safe regex pattern STRING to the same AST —
+   both ride one NFA/DFA/token-table pipeline.
 2. **regex -> DFA** (`_RegexCompiler`): Thompson NFA -> subset
    construction -> prune states that cannot reach an accepting state.
 3. **byte DFA -> token DFA** (`compile_token_table`): for each DFA state
@@ -54,8 +57,10 @@ import numpy as np
 
 __all__ = [
     "JsonSchemaError",
+    "RegexError",
     "TokenGrammar",
     "compile_json_schema",
+    "compile_regex",
     "vocab_from_tokenizer",
     "grammar_cache",
 ]
@@ -70,6 +75,12 @@ class JsonSchemaError(ValueError):
     a client bug, never a server error."""
 
     status_code = 400
+
+
+class RegexError(JsonSchemaError):
+    """Malformed/unsupported regex pattern. Subclasses JsonSchemaError so
+    every existing edge catch (grammar compile -> 400) covers the regex
+    front-end too."""
 
 
 # ---------------------------------------------------------------------------
@@ -279,6 +290,262 @@ def _schema_ast(schema: Any, ws: bool, depth: int = 0) -> Any:
             _Lit(b"true"), _Lit(b"false"), _Lit(b"null"),
         )
     raise JsonSchemaError(f"unsupported schema type {t!r}")
+
+
+# ---------------------------------------------------------------------------
+# regex pattern string -> regex AST
+# ---------------------------------------------------------------------------
+
+# `.` and negated classes range over printable ASCII: the same closed
+# byte domain the schema front-end emits (_STR_CHARS rationale) — a DFA
+# over "any byte" would admit output the tokenizer cannot round-trip
+_ANY_CHARS = frozenset(range(0x20, 0x7F))
+_REP_MAX = 4096  # {m,n} bound — a typo like {1,999999} must not explode the NFA
+
+_ESC_CLASSES = {
+    "d": _DIGITS,
+    "D": _ANY_CHARS - _DIGITS,
+    "w": frozenset(b"abcdefghijklmnopqrstuvwxyz"
+                   b"ABCDEFGHIJKLMNOPQRSTUVWXYZ0123456789_"),
+    "s": frozenset(b" \t\n\r\f\v"),
+}
+_ESC_CLASSES["W"] = _ANY_CHARS - _ESC_CLASSES["w"]
+_ESC_CLASSES["S"] = _ANY_CHARS - _ESC_CLASSES["s"]
+_ESC_LITERALS = {"n": 0x0A, "t": 0x09, "r": 0x0D, "f": 0x0C, "v": 0x0B,
+                 "0": 0x00}
+
+
+class _RegexParser:
+    """Recursive-descent parser for the DFA-safe regex subset, lowering a
+    pattern string to the SAME byte-level AST the JSON-schema front-end
+    emits — so ``response_format={"type": "regex"}`` rides the existing
+    NFA/DFA/token-table pipeline unchanged.
+
+    Supported: literals, escapes (``\\d \\D \\w \\W \\s \\S \\n \\t`` +
+    escaped metachars), ``.``, character classes ``[a-z]``/``[^...]``,
+    grouping ``(...)`` / ``(?:...)``, alternation ``|``, quantifiers
+    ``* + ? {m} {m,} {m,n}``, optional anchors ``^``/``$`` (whole-string
+    match is implicit — the token DFA only ends a stream at EOS in an
+    accepting state). NOT supported (would need more than a DFA, or make
+    masks ambiguous): backreferences, lookaround, lazy quantifiers,
+    named groups, unicode classes."""
+
+    def __init__(self, pattern: str):
+        try:
+            self.data = pattern.encode("ascii")
+        except UnicodeEncodeError as e:
+            raise RegexError(
+                "regex patterns are byte-level: non-ASCII literals are "
+                "not supported"
+            ) from e
+        self.pos = 0
+
+    def _peek(self) -> str:
+        return chr(self.data[self.pos]) if self.pos < len(self.data) else ""
+
+    def _next(self) -> str:
+        ch = self._peek()
+        self.pos += 1
+        return ch
+
+    def parse(self) -> Any:
+        if self._peek() == "^":
+            self.pos += 1  # whole-string match is implicit
+        node = self._alternation()
+        if self.pos < len(self.data):
+            raise RegexError(
+                f"unexpected {self._peek()!r} at position {self.pos}"
+            )
+        return node
+
+    def _alternation(self) -> Any:
+        opts = [self._sequence()]
+        while self._peek() == "|":
+            self.pos += 1
+            opts.append(self._sequence())
+        return _alt(*opts)
+
+    def _sequence(self) -> Any:
+        parts: list[Any] = []
+        while True:
+            ch = self._peek()
+            if ch in ("", "|", ")"):
+                break
+            if ch == "$":
+                # accept a trailing anchor; anywhere else it's an error
+                # surfaced by parse()'s trailing-input check
+                if self.pos == len(self.data) - 1:
+                    self.pos += 1
+                    break
+                raise RegexError("'$' is only supported at the pattern end")
+            parts.append(self._quantified())
+        return _seq(*parts) if parts else _EPS
+
+    def _quantified(self) -> Any:
+        node = self._atom()
+        ch = self._peek()
+        if ch == "*":
+            self.pos += 1
+            node = _Rep(node, 0, None)
+        elif ch == "+":
+            self.pos += 1
+            node = _Rep(node, 1, None)
+        elif ch == "?":
+            self.pos += 1
+            node = _Rep(node, 0, 1)
+        elif ch == "{":
+            node = _Rep(node, *self._braces())
+        if self._peek() in ("*", "+", "?"):
+            raise RegexError(
+                f"lazy/stacked quantifiers unsupported at position {self.pos}"
+            )
+        return node
+
+    def _braces(self) -> tuple[int, int | None]:
+        start = self.pos
+        self.pos += 1  # consume '{'
+        body = ""
+        while self._peek() not in ("}", ""):
+            body += self._next()
+        if self._next() != "}":
+            raise RegexError(f"unterminated {{...}} at position {start}")
+        try:
+            if "," not in body:
+                lo = hi = int(body)
+            else:
+                lo_s, hi_s = body.split(",", 1)
+                lo = int(lo_s) if lo_s else 0
+                hi = int(hi_s) if hi_s.strip() else None
+        except ValueError as e:
+            raise RegexError(f"malformed repetition {{{body}}}") from e
+        if lo < 0 or (hi is not None and (hi < lo or hi > _REP_MAX)) or lo > _REP_MAX:
+            raise RegexError(f"repetition {{{body}}} out of range (max {_REP_MAX})")
+        return lo, hi
+
+    def _atom(self) -> Any:
+        ch = self._next()
+        if ch == "":
+            raise RegexError("unexpected end of pattern")
+        if ch == "(":
+            if self._peek() == "?":
+                self.pos += 1
+                if self._next() != ":":
+                    raise RegexError(
+                        "only non-capturing (?:...) groups are supported "
+                        "(no lookaround/named groups)"
+                    )
+            node = self._alternation()
+            if self._next() != ")":
+                raise RegexError("unbalanced '('")
+            return node
+        if ch == "[":
+            return self._char_class()
+        if ch == ".":
+            return _cls(_ANY_CHARS)
+        if ch == "\\":
+            return self._escape(in_class=False)
+        if ch in "*+?{":
+            raise RegexError(f"quantifier {ch!r} with nothing to repeat")
+        if ch in ")]}":
+            raise RegexError(f"unbalanced {ch!r}")
+        return _Lit(ch.encode())
+
+    def _escape(self, *, in_class: bool) -> Any:
+        ch = self._next()
+        if ch == "":
+            raise RegexError("dangling backslash")
+        if ch in _ESC_CLASSES:
+            allowed = _ESC_CLASSES[ch]
+            return frozenset(allowed) if in_class else _cls(allowed)
+        if ch in _ESC_LITERALS:
+            b = _ESC_LITERALS[ch]
+        elif ch == "x":
+            hexs = "".join(self._next() for _ in range(2))
+            try:
+                b = int(hexs, 16)
+            except ValueError as e:
+                raise RegexError(f"malformed \\x escape \\x{hexs}") from e
+        elif not ch.isalnum():
+            b = ord(ch)  # escaped metachar: \. \\ \[ \+ ...
+        else:
+            raise RegexError(f"unsupported escape \\{ch}")
+        return frozenset([b]) if in_class else _Lit(bytes([b]))
+
+    def _char_class(self) -> _Class:
+        start = self.pos
+        negate = self._peek() == "^"
+        if negate:
+            self.pos += 1
+        allowed: set[int] = set()
+        first = True
+        while True:
+            ch = self._next()
+            if ch == "":
+                raise RegexError(f"unterminated [...] at position {start}")
+            if ch == "]" and not first:
+                break
+            first = False
+            if ch == "\\":
+                got = self._escape(in_class=True)
+                allowed |= got
+                continue
+            lo = ord(ch)
+            if self._peek() == "-" and self.pos + 1 < len(self.data) and \
+                    chr(self.data[self.pos + 1]) != "]":
+                self.pos += 1  # consume '-'
+                hi_ch = self._next()
+                if hi_ch == "\\":
+                    got = self._escape(in_class=True)
+                    if len(got) != 1:
+                        raise RegexError("class range endpoint must be one char")
+                    hi = next(iter(got))
+                else:
+                    hi = ord(hi_ch)
+                if hi < lo:
+                    raise RegexError(
+                        f"reversed class range at position {self.pos}"
+                    )
+                allowed |= set(range(lo, hi + 1))
+            else:
+                allowed.add(lo)
+        if negate:
+            allowed = set(_ANY_CHARS) - allowed
+        if not allowed:
+            raise RegexError("character class admits nothing")
+        return _cls(allowed)
+
+
+def compile_regex(
+    pattern: str,
+    vocab: list[bytes | str],
+    eos_id: int,
+    *,
+    max_states: int | None = None,
+) -> TokenGrammar:
+    """Compile a regex pattern string into a TokenGrammar for one
+    vocabulary — the ``response_format={"type": "regex"}`` front-end.
+    The pattern is a WHOLE-string match (anchors optional): the token
+    DFA admits EOS only in accepting states, so the stream can only end
+    on a complete match."""
+    import os
+
+    if not isinstance(pattern, str) or not pattern:
+        raise RegexError("pattern must be a non-empty string")
+    if max_states is None:
+        max_states = int(
+            os.environ.get("TPU_LLM_CONSTRAINED_MAX_STATES", "4096") or 4096
+        )
+    norm = [v.encode() if isinstance(v, str) else bytes(v) for v in vocab]
+    ast = _RegexParser(pattern).parse()
+    dfa, accepting = _RegexCompiler().compile(ast, max_states)
+    table = compile_token_table(dfa, accepting, norm, eos_id)
+    key = hashlib.sha256(
+        b"re|" + pattern.encode() + b"|" + _vocab_key(norm).encode()
+        + b"|" + str(eos_id).encode()
+    ).hexdigest()
+    return TokenGrammar(
+        table, eos_id=eos_id, key=key, accepting_start=0 in accepting
+    )
 
 
 # ---------------------------------------------------------------------------
@@ -654,6 +921,27 @@ class _GrammarCache:
                 self._items[pre] = g  # LRU bump
                 return g
         g = compile_json_schema(schema, vocab, eos_id, **kw)
+        return self._put(pre, g)
+
+    def get_regex(
+        self, pattern: str, vocab: list[bytes], eos_id: int, **kw
+    ) -> TokenGrammar:
+        """Regex twin of get(): same LRU, keyed under a 're|' prefix so a
+        pattern that textually equals a schema dump cannot collide."""
+        pre = hashlib.sha256(
+            b"re|" + str(pattern).encode()
+            + b"|" + _vocab_key(vocab).encode() + b"|" + str(eos_id).encode()
+            + b"|" + json.dumps(kw, sort_keys=True).encode()
+        ).hexdigest()
+        with self._lock:
+            g = self._items.pop(pre, None)
+            if g is not None:
+                self._items[pre] = g  # LRU bump
+                return g
+        g = compile_regex(pattern, vocab, eos_id, **kw)
+        return self._put(pre, g)
+
+    def _put(self, pre: str, g: TokenGrammar) -> TokenGrammar:
         with self._lock:
             self._items[pre] = g
             while len(self._items) > self.cap:

@@ -1,0 +1,173 @@
+#!/usr/bin/env python
+"""CI smoke: multi-tenant LoRA serving over the OpenAI edge, end to end
+over real sockets.
+
+Boots one app serving one resident base model with a LoRA pool sized by
+the TPU_LLM_LORA_SLOTS env knob (the config-plumbing path, not a ctor
+kwarg), registers three tenant adapters through the rollout machinery,
+then speaks the RAW OpenAI wire format against it:
+
+- GET /v1/models lists every resident adapter with parent = the base,
+- model=<adapter> routes to that tenant's delta (response echoes the
+  adapter id; greedy bytes differ from the base for a scale-2 delta),
+- the X-GoFr-Adapter header selects the same tenant without model=,
+- tenant answers are byte-stable while a FOURTH adapter hot-loads
+  mid-traffic through the canary shadow gate (in-flight + subsequent
+  requests never wobble during a swap),
+- unknown model names 404 with the OpenAI error envelope (never a
+  silent fallback to base weights),
+- the adapter counters/gauges are live on /metrics.
+
+Usage: JAX_PLATFORMS=cpu python scripts/smoke_multitenant.py
+Exit codes: 0 clean, non-zero assertion failure (message on stderr).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import urllib.error
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+TENANTS = ("acme", "globex", "initech")
+
+
+def _post(base: str, path: str, body: dict, headers: dict | None = None,
+          timeout: float = 120.0):
+    req = urllib.request.Request(
+        f"{base}{path}", data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})},
+        method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+def _chat(base: str, *, model: str = "", headers: dict | None = None) -> dict:
+    body = {
+        "messages": [{"role": "user", "content": "name a vegetable"}],
+        "max_tokens": 8,
+    }
+    if model:
+        body["model"] = model
+    status, out = _post(base, "/v1/chat/completions", body, headers)
+    assert status == 200, out
+    return out
+
+
+def main() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+
+    import gofr_tpu
+    from gofr_tpu.config import new_mock_config
+    from gofr_tpu.lora import init_adapter
+    from gofr_tpu.models import TransformerConfig, init_params
+    from gofr_tpu.openai_compat import register_openai_routes
+
+    cfg = TransformerConfig.tiny(vocab_size=300)  # >= 258: byte-tokenizable
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    app = gofr_tpu.new(config=new_mock_config({
+        "APP_NAME": "multitenant-smoke", "HTTP_PORT": "0",
+        "METRICS_PORT": "0", "LOG_LEVEL": "ERROR", "TRACE_EXPORTER": "none",
+        "REQUEST_TIMEOUT": "10",
+        # the pool is sized by config, not code: 6 slots, rank cap 8
+        "TPU_LLM_LORA_SLOTS": "6", "TPU_LLM_LORA_RANK_MAX": "8",
+    }))
+    app.container.tpu().register_llm(
+        "tiny", cfg, params, slots=4, max_seq_len=256, warmup=False,
+    )
+    register_openai_routes(app, model="tiny")
+    handle = app.container.tpu().llm("tiny")
+    assert handle.engine.lora_slots == 6, handle.engine.lora_slots
+
+    thread = app.run_in_background()
+    base = f"http://127.0.0.1:{app.http_server.port}"
+    mbase = f"http://127.0.0.1:{app.metrics_server.port}"
+    try:
+        # -- phase 1: three tenants join the pool -------------------------
+        for i, name in enumerate(TENANTS):
+            handle.register_adapter(
+                name,
+                init_adapter(jax.random.PRNGKey(100 + i), cfg, rank=4,
+                             scale=2.0),
+                fair_weight=float(i + 1),
+            )
+        with urllib.request.urlopen(f"{base}/v1/models", timeout=30) as r:
+            models = json.loads(r.read())
+        ids = {m["id"]: m for m in models["data"]}
+        assert "tiny" in ids, models
+        for name in TENANTS:
+            assert ids[name]["parent"] == "tiny", ids.get(name)
+
+        # -- phase 2: model= and the header route to the tenant -----------
+        base_out = _chat(base)["choices"][0]["message"]["content"]
+        per_tenant = {}
+        for name in TENANTS:
+            out = _chat(base, model=name)
+            assert out["model"] == name, out["model"]
+            per_tenant[name] = out["choices"][0]["message"]["content"]
+        # a scale-2 rank-4 delta moves the greedy argmax off the base path
+        assert any(v != base_out for v in per_tenant.values()), per_tenant
+        hdr = _chat(base, headers={"X-GoFr-Adapter": "acme"})
+        assert hdr["choices"][0]["message"]["content"] == per_tenant["acme"]
+
+        # -- phase 3: hot-load a 4th tenant under live traffic ------------
+        # concurrent tenant requests in flight while the canary shadow
+        # gate probes + publishes "umbrella"; nobody's bytes may wobble
+        results: dict[str, str] = {}
+
+        def drive(name: str) -> None:
+            results[name] = _chat(
+                base, model=name
+            )["choices"][0]["message"]["content"]
+
+        threads = [
+            threading.Thread(target=drive, args=(n,)) for n in TENANTS
+        ]
+        for t in threads:
+            t.start()
+        handle.register_adapter(
+            "umbrella",
+            init_adapter(jax.random.PRNGKey(200), cfg, rank=4, scale=2.0),
+        )
+        for t in threads:
+            t.join(timeout=60)
+        assert results == per_tenant, (results, per_tenant)
+        assert _chat(base, model="umbrella")["model"] == "umbrella"
+        assert _chat(base)["choices"][0]["message"]["content"] == base_out
+
+        # -- phase 4: unknown tenants 404, never silent base fallback -----
+        try:
+            _chat(base, model="wayne")
+            raise AssertionError("unknown model did not 404")
+        except urllib.error.HTTPError as e:
+            assert e.code == 404, e.code
+            body = json.loads(e.read())
+            assert body["error"]["type"] == "not_found_error", body
+
+        # -- phase 5: adapter telemetry on /metrics over the socket -------
+        with urllib.request.urlopen(f"{mbase}/metrics", timeout=15) as r:
+            expo = r.read().decode()
+        for name in (
+            "app_llm_adapter_requests_total",
+            "app_llm_adapters_resident",
+        ):
+            assert name in expo, f"{name} missing from /metrics"
+        snap = handle.engine.adapters()
+        assert set(snap["resident"]) == set(TENANTS) | {"umbrella"}, snap
+        print("smoke_multitenant OK: 3 tenants + hot-load via canary gate, "
+              "models/parent, header routing, 404 envelope, /metrics")
+        return 0
+    finally:
+        app.shutdown()
+        thread.join(timeout=15)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

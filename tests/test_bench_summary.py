@@ -205,6 +205,27 @@ def test_summary_line_carries_structured():
     assert "structured" not in bench._summary_line(_serving_result())
 
 
+def test_summary_line_carries_multitenant():
+    """The multi-tenant LoRA point rides the summary as a compact block:
+    4-adapter mixed-batch decode tok/s vs the single-tenant baseline
+    (the batched-delta claim: ratio >= ~0.9), adapter hot-load latency,
+    and the publish-swap latency of a live v2 repoint."""
+    r = _serving_result()
+    r["detail"]["multitenant"] = {
+        "requests": 64, "new_tokens": 64, "adapters": 4, "rank": 8,
+        "single_tok_s": 21000.0, "multi_tok_s": 19800.0, "ratio": 0.943,
+        "hot_load_ms": 11.2, "swap_ms": 14.8, "swaps": 1, "evictions": 0,
+    }
+    s = bench._summary_line(r)
+    assert s["multitenant"] == {
+        "adapters": 4, "single_tok_s": 21000.0, "multi_tok_s": 19800.0,
+        "ratio": 0.943, "hot_load_ms": 11.2, "swap_ms": 14.8,
+    }
+    assert len(json.dumps(s)) < 1500
+    # absent block (--no-multitenant / CPU runs) must not leak a key
+    assert "multitenant" not in bench._summary_line(_serving_result())
+
+
 def test_summary_line_carries_sessions():
     """BENCH_r14+: the paged-pool sessions point rides the summary as a
     compact block (paged/int8 vs contiguous decode ratios, HBM bytes per

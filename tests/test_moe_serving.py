@@ -1,0 +1,132 @@
+"""Mixture-of-experts serving through the LLM engine (models.moe wired
+into models.transformer's layer scan; docs/advanced-guide/
+multi-tenancy.md#mixture-of-experts).
+
+The load-bearing invariants:
+
+- A TransformerConfig with ``n_experts > 0`` serves through the SAME
+  engine programs as the dense zoo — router + expert-batched FFN inside
+  the layer scan, dense attention unchanged.
+- **EP == single chip.** Tensor-parallel serving shards the
+  expert-batched weights on their expert axis over the submesh
+  (parallel.param_specs) and emits greedy token streams identical to
+  the single-device engine.
+- MoE composes with the multi-tenant LoRA pool: attention-side deltas
+  apply, expert weights stay shared, gid 0 stays token-exact."""
+
+import jax
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from gofr_tpu.llm import LLMEngine
+from gofr_tpu.models import TransformerConfig, init_params
+from gofr_tpu.parallel import make_mesh, param_specs
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs 8 virtual devices"
+)
+
+CFG = TransformerConfig.tiny_moe()  # 4 experts, top-2
+
+PROMPT = list(range(1, 17))
+REPETITIVE = ([5, 6, 7, 8] * 6)[:16]
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(jax.random.PRNGKey(0), CFG)
+
+
+def _engine(params, cfg=CFG, **kw):
+    kw.setdefault("slots", 2)
+    kw.setdefault("max_seq_len", 96)
+    kw.setdefault("prefill_buckets", (8,))
+    kw.setdefault("prefill_chunk", 8)
+    kw.setdefault("step_token_budget", 16)
+    kw.setdefault("decode_chunk", 4)
+    kw.setdefault("warmup", False)
+    return LLMEngine(cfg, params, **kw)
+
+
+def _tp_engine(params, tp, cfg=CFG, **kw):
+    mesh = make_mesh({"data": 1, "model": tp}, devices=jax.devices()[:tp])
+    return _engine(
+        params, cfg=cfg, mesh=mesh, param_specs=param_specs(cfg, mesh), **kw
+    )
+
+
+class TestMoESpecs:
+    def test_experts_shard_on_expert_axis_when_divisible(self):
+        mesh = make_mesh({"data": 1, "model": 2}, devices=jax.devices()[:2])
+        specs = param_specs(CFG, mesh)
+        assert specs["layers"]["w_gate"] == P(None, "model", None, None)
+        assert specs["layers"]["w_down"] == P(None, "model", None, None)
+        assert specs["layers"]["w_router"] == P(None, None, None)
+
+    def test_experts_replicate_on_indivisible_degree(self):
+        cfg3 = TransformerConfig.tiny_moe()
+        mesh = make_mesh({"data": 1, "model": 8})
+        # 8 does not divide 4 experts -> replicated expert tables
+        specs = param_specs(cfg3, mesh)
+        assert specs["layers"]["w_gate"] == P(None, None, None, None)
+
+    def test_moe_params_shapes(self, params):
+        lp = params["layers"]
+        L, E = CFG.n_layers, CFG.n_experts
+        assert lp["w_router"].shape == (L, CFG.d_model, E)
+        assert lp["w_gate"].shape[:2] == (L, E)
+        assert lp["w_down"].shape[:2] == (L, E)
+
+
+class TestMoEServing:
+    def test_moe_engine_generates(self, params):
+        eng = _engine(params)
+        try:
+            toks = eng.generate(PROMPT, max_new_tokens=12)
+            assert len(toks) == 12
+            assert all(0 <= t < CFG.vocab_size for t in toks)
+            assert eng.stats()["moe_experts"] == CFG.n_experts
+        finally:
+            eng.close()
+
+    @pytest.mark.slow  # ~25s: two engines + TP compile of the MoE scan
+    def test_moe_tp2_matches_single_device(self, params):
+        base = _engine(params)
+        want = [base.generate(p, max_new_tokens=12)
+                for p in (PROMPT, REPETITIVE)]
+        base.close()
+        eng = _tp_engine(params, tp=2)
+        try:
+            got = [eng.generate(p, max_new_tokens=12)
+                   for p in (PROMPT, REPETITIVE)]
+        finally:
+            eng.close()
+        assert got == want
+
+    def test_moe_zero_adapter_identity(self, params):
+        """The LoRA program family stays token-exact over an MoE config
+        (deltas target attention; expert tables are untouched)."""
+        base = _engine(params)
+        want = base.generate(PROMPT, max_new_tokens=12)
+        base.close()
+        eng = _engine(params, lora_slots=2)
+        try:
+            assert eng.generate(PROMPT, max_new_tokens=12) == want
+        finally:
+            eng.close()
+
+    def test_moe_adapted_matches_merged(self, params):
+        from gofr_tpu.lora import init_adapter, merge_adapter
+
+        ad = init_adapter(jax.random.PRNGKey(7), CFG, rank=4, scale=2.0)
+        merged = merge_adapter(params, CFG, ad)
+        ref = _engine(merged)
+        want = ref.generate(PROMPT, max_new_tokens=12)
+        ref.close()
+        eng = _engine(params, lora_slots=2)
+        try:
+            eng.load_adapter("tenant", ad)
+            got = eng.generate(PROMPT, max_new_tokens=12, adapter="tenant")
+        finally:
+            eng.close()
+        assert got == want

@@ -141,6 +141,42 @@ class TestChatCompletions:
             _post(base, "/v1/chat/completions", {"messages": []})
         assert ei.value.code == 400
 
+    def test_unknown_model_404_openai_envelope(self, served):
+        """Unknown NON-EMPTY model names must 404, not silently fall
+        back to base weights (a tenant asking for its fine-tune)."""
+        _app, base = served
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(base, "/v1/chat/completions", {
+                "model": "nope",
+                "messages": [{"role": "user", "content": "x"}],
+            })
+        assert ei.value.code == 404
+        body = json.loads(ei.value.read())
+        assert body["error"]["type"] == "not_found_error"
+
+    def test_regex_response_format(self, served):
+        _app, base = served
+        status, out = _post(base, "/v1/chat/completions", {
+            "messages": [{"role": "user", "content": "pick one"}],
+            "max_tokens": 20,
+            "response_format": {"type": "regex", "regex": "(yes|no)!?"},
+        })
+        assert status == 200
+        content = out["choices"][0]["message"]["content"]
+        import re
+
+        assert re.fullmatch(r"(yes|no)!?", content), content
+        assert out["choices"][0]["finish_reason"] == "stop"
+
+    def test_bad_regex_400(self, served):
+        _app, base = served
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(base, "/v1/chat/completions", {
+                "messages": [{"role": "user", "content": "x"}],
+                "response_format": {"type": "regex", "regex": "(?=look)"},
+            })
+        assert ei.value.code == 400
+
     def test_chat_prompt_template(self):
         p = chat_prompt([
             {"role": "system", "content": "be brief"},

@@ -785,10 +785,14 @@ class TestEdges:
         ))
         assert kw == {
             "priority": "batch", "client": "tenant-a", "session_id": "",
+            "adapter": "",
         }
         # session id rides the same kwargs (paged KV session tier)
         kw = llm_request_kwargs(ctx_for({"x-gofr-session": "conv-7"}))
         assert kw["session_id"] == "conv-7"
+        # LoRA tenant selection rides the same kwargs (multi-tenancy)
+        kw = llm_request_kwargs(ctx_for({"x-gofr-adapter": "acme"}))
+        assert kw["adapter"] == "acme"
         # API key fallback for keyed deployments: HASHED, never verbatim
         # — ledger client ids surface on the debug/stats routes, and a
         # raw key there would be a credential disclosure
