@@ -24,6 +24,7 @@ from .handler import (
     debug_compiles_handler,
     debug_engine_handler,
     debug_profile_handler,
+    debug_traces_handler,
     favicon_wire_handler,
     health_handler,
     live_handler,
@@ -281,6 +282,9 @@ class App:
         self.get("/.well-known/alive", live_handler)
         self.get("/.well-known/debug/engine", debug_engine_handler)
         self.get("/.well-known/debug/compiles", debug_compiles_handler)
+        # Journey ring shard read (the fleet stitcher's fan-out target;
+        # docs/advanced-guide/observability-serving.md#request-journeys)
+        self.get("/.well-known/debug/traces", debug_traces_handler)
         # The profile route gets its own timeout budget: a capture costs
         # its window (<=30 s) plus ~10 s of one-time profiler init, which
         # must not be bounded by the API-SLO REQUEST_TIMEOUT (default 5 s).

@@ -98,6 +98,11 @@ class BatchJob:
         self.client = str(data.get("client") or "")
         self.session = str(data.get("session") or "")
         self.attempt = int(data.get("_attempt", 0))
+        # W3C trace context injected at publish (submit edge / cron tick):
+        # the worker resumes it, so the async hop does not shatter the
+        # submitter's journey. Rides `raw`, so requeues and DLQ re-walks
+        # keep carrying it.
+        self.traceparent = str(data.get("traceparent") or "") or None
         self.raw = dict(data)
 
     @classmethod
@@ -245,6 +250,7 @@ class BatchWorker:
         self.store = store if store is not None else BatchStore()
         self.logger = container.logger
         self.metrics = container.metrics_manager
+        self.tracer = getattr(container, "tracer", None)
         self._grammar_vocab = None  # lazy (tokenizer -> byte vocab)
         self._inflight: set[str] = set()
         self._lock = threading.Lock()
@@ -312,17 +318,49 @@ class BatchWorker:
                 )
             toks = self.tokenizer.encode(job.prompt)
             eos = self.tokenizer.eos_id if self.tokenizer.eos_id is not None else -1
-        req = handle.submit(GenRequest(
-            toks,
-            max_new_tokens=job.max_new_tokens,
-            temperature=job.temperature,
-            eos_token=eos,
-            priority="batch",  # the overload ladder's pressure reservoir
-            client=job.client,
-            session_id=job.session,
-            grammar=grammar,
-        ))
-        out = req.tokens(timeout=300.0)
+        # Resume the submitter's trace across the pub/sub hop: the batch.job
+        # span parents to the traceparent the publish edge injected (or a
+        # cron tick's), so the journey survives the async boundary — and the
+        # engine's llm.request span nests under it via req.traceparent.
+        tp = job.traceparent
+        jspan = None
+        if self.tracer is not None:
+            from ..tracing import parse_traceparent
+
+            jspan = self.tracer.start_detached_span(
+                "batch.job",
+                parent=parse_traceparent(tp) if tp else None,
+                attributes={
+                    "batch.job_id": job.id,
+                    "batch.topic": self.topic,
+                    "batch.attempt": job.attempt,
+                },
+            )
+            tp = jspan.traceparent
+        try:
+            req = handle.submit(GenRequest(
+                toks,
+                max_new_tokens=job.max_new_tokens,
+                temperature=job.temperature,
+                eos_token=eos,
+                priority="batch",  # the overload ladder's pressure reservoir
+                client=job.client,
+                session_id=job.session,
+                grammar=grammar,
+                traceparent=tp,
+            ))
+            out = req.tokens(timeout=300.0)
+        except BaseException as e:
+            if jspan is not None:
+                jspan.set_attribute("error", repr(e))
+                jspan.set_status("ERROR")
+                jspan.end()
+            raise
+        if jspan is not None:
+            jspan.set_attribute("batch.finish_reason", req.finish_reason or "")
+            if req.finish_reason not in _TERMINAL_OK:
+                jspan.set_status("ERROR")
+            jspan.end()
         if req.finish_reason not in _TERMINAL_OK:
             raise RuntimeError(
                 f"generation finished {req.finish_reason!r}"
@@ -666,6 +704,13 @@ def attach_batch_worker(
 
             raise ErrorInvalidParam("jobs")
         batch_id = f"batch_{uuid.uuid4().hex[:12]}"
+        # Inject the caller's trace context into every envelope: the worker
+        # resumes it, so a /v1/batches submit and its eventual generation
+        # stitch into one journey even across the durable queue.
+        from ..tracing import current_span
+
+        cs = current_span()
+        tp = cs.traceparent if cs is not None and cs.end_ns == 0 else None
         ids = []
         for data in jobs:
             try:
@@ -677,8 +722,11 @@ def attach_batch_worker(
                 err.status_code = 400
                 raise err from e
             worker.store.register(job.id, batch_id)
+            env = job.raw | {"id": job.id}
+            if tp and not env.get("traceparent"):
+                env["traceparent"] = tp
             app.container.pubsub.publish_sync(
-                topic, json.dumps(job.raw | {"id": job.id}).encode()
+                topic, json.dumps(env).encode()
             )
             ids.append(job.id)
         from ..http.responder import Response, to_json_bytes
@@ -719,6 +767,17 @@ def attach_batch_worker(
                 counter["n"] += 1
                 payload = dict(template)
                 payload.setdefault("id", f"{name}_{counter['n']}")
+                # A cron-published job's journey starts at the cron tick:
+                # mint a root span here and inject its traceparent so the
+                # worker's batch.job span parents to the tick, not nothing.
+                tracer = getattr(app, "tracer", None)
+                if tracer is not None and not payload.get("traceparent"):
+                    tick = tracer.start_detached_span(
+                        "batch.cron_tick",
+                        attributes={"cron.job": name, "batch.topic": topic},
+                    )
+                    payload["traceparent"] = tick.traceparent
+                    tick.end()
                 app.container.pubsub.publish_sync(
                     topic, json.dumps(payload).encode()
                 )

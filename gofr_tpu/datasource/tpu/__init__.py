@@ -597,6 +597,17 @@ class TPURuntime:
         # baseline a later ModelHandle.deploy() / POST
         # /.well-known/debug/rollout shifts away from
         engine_kw.setdefault("version", "v1")
+        # per-tenant SLO targets (docs/advanced-guide/
+        # observability-serving.md#slo-burn-rates): explicit slo= /
+        # slo_tenants= kwargs win; otherwise the TPU_LLM_SLO_* config
+        # knobs apply fleet-wide. No targets anywhere -> no SLO engine,
+        # no gauges — the targets themselves are the opt-in.
+        if "slo" not in engine_kw and self.config is not None:
+            from ...metrics.slo import SLOPolicy
+
+            _slo = SLOPolicy.from_config(self.config)
+            if _slo.active():
+                engine_kw["slo"] = _slo
         if not hasattr(self, "_llms"):
             self._llms: dict[str, Any] = {}
         if name in self._llms:

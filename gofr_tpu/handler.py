@@ -203,6 +203,14 @@ def _serving_status(container) -> str:
             return "degraded"
     except Exception:  # noqa: BLE001 — health must not fail on metrics shape
         pass
+    # fast SLO burn is unconditional too: the targets themselves are the
+    # opt-in (no TPU_LLM_SLO_* configured -> the gauge never exists), and
+    # a fleet burning its monthly error budget in days must shed load NOW
+    try:
+        if m.gauge_total("app_llm_slo_fast_burn") > 0:
+            return "degraded"
+    except Exception:  # noqa: BLE001 — health must not fail on metrics shape
+        pass
     try:
         depth_max = cfg.get_float("HEALTH_DEGRADED_QUEUE_DEPTH", 0.0)
         backlog_max = cfg.get_float("HEALTH_DEGRADED_ADMISSION_BACKLOG", 0.0)
@@ -295,6 +303,33 @@ def debug_compiles_handler(_ctx: Context) -> Any:
     from .profiling import default_registry
 
     return default_registry().snapshot()
+
+
+def debug_traces_handler(ctx: Context) -> Any:
+    """/.well-known/debug/traces — this process's journey ring (the
+    bounded in-memory span store every tracer tees into, zero external
+    infra). ``?trace_id=<32 hex>`` returns that trace's span fragments
+    AS STORED — the cross-process stitcher (the front router's journey
+    route) fans this query over the fleet and assembles the tree, so
+    this endpoint stays a dumb shard read. Without ``trace_id``: recent
+    trace summaries plus ring occupancy. Read-only and bounded; safe on
+    a saturated engine."""
+    tracer = getattr(ctx.container, "tracer", None)
+    ring = getattr(tracer, "ring", None)
+    if ring is None:
+        return {
+            "traces": [], "stats": None,
+            "note": "trace ring disabled (TRACE_RING_SPANS=0)",
+        }
+    tid = (ctx.param("trace_id") or "").strip().lower()
+    if tid:
+        spans = ring.query(tid)
+        return {"trace_id": tid, "span_count": len(spans), "spans": spans}
+    try:
+        limit = int(ctx.param("limit") or 64)
+    except ValueError:
+        limit = 64
+    return {"traces": ring.trace_ids(limit=limit), "stats": ring.stats()}
 
 
 def debug_profile_handler(ctx: Context) -> Any:

@@ -526,6 +526,13 @@ class GenRequest:
         self.first_token_at: float | None = None
         self.span = None  # detached llm.request span (engine has a tracer)
         self._observed = False  # terminal observability emitted (idempotence)
+        # journey accounting: hop counts every re-admission after the
+        # first (failover re-submit, preemption continuation) so the
+        # wide event reads "hop 2 of journey J"; journey_id pins the
+        # trace id of the FIRST submit and survives kills — the handle a
+        # cross-process stitch is queried by.
+        self.hop = 0
+        self.journey_id: str | None = None
 
     # -- consumption ------------------------------------------------------
     def _raise_terminal(self) -> None:
@@ -654,6 +661,8 @@ class LLMEngine:
         host_cache_mb: float | None = None,
         kv_label: str = "llm",
         version: str = "v1",
+        slo=None,
+        slo_tenants: dict | None = None,
     ):
         import jax
         import jax.numpy as jnp
@@ -878,6 +887,33 @@ class LLMEngine:
             metrics.set_gauge(
                 "app_llm_model_version_info", 1.0,
                 model=self.label, version=self.version,
+            )
+        # -- per-tenant SLO engine (docs/advanced-guide/
+        # observability-serving.md#slo) ----------------------------------
+        # Declared targets -> goodput counters + 5m/1h burn-rate gauges.
+        # `slo` is an SLOPolicy/dict from register_llm (which merges the
+        # TPU_LLM_SLO_* config knobs with per-model overrides); a bare
+        # engine falls back to the process env so tests and scripts can
+        # arm it without an app. None/inactive -> zero per-request cost.
+        from .metrics.slo import SLOPolicy, SLOTracker
+
+        policy = SLOPolicy.coerce(slo)
+        if policy is None:
+            policy = SLOPolicy(
+                ttft_ms=float(_os.environ.get("TPU_LLM_SLO_TTFT_MS", "") or 0) or None,
+                tpot_ms=float(_os.environ.get("TPU_LLM_SLO_TPOT_MS", "") or 0) or None,
+                availability=float(
+                    _os.environ.get("TPU_LLM_SLO_AVAILABILITY", "") or 0
+                ) or None,
+            )
+        self.slo = None
+        if policy.active():
+            self.slo = SLOTracker(
+                policy, metrics, self.label,
+                tenant_overrides={
+                    str(t): SLOPolicy.coerce(p)
+                    for t, p in (slo_tenants or {}).items()
+                },
             )
         # recent-window phase samples (seconds) for stats()/debug — exact
         # p50/p99 over the last ~512 observations, deque-append cheap
@@ -2459,16 +2495,18 @@ class LLMEngine:
             # Contextvar capture happens HERE, on the submitting thread —
             # the scheduler/collector threads that serve the request never
             # see the caller's context, so every later phase span is
-            # parented through the ids captured now. Fallback: an explicit
-            # traceparent on the request (callers submitting from threads
-            # the contextvar does not reach).
+            # parented through the ids captured now. An EXPLICIT
+            # traceparent on the request outranks the contextvar: it is a
+            # deliberate re-parent by infrastructure code (the disagg
+            # journey span, batch workers, failover seams) that may run
+            # on a thread where someone else's span is still live.
             from .tracing import current_span, parse_traceparent
 
-            parent = current_span()
-            if parent is not None and parent.end_ns == 0:
-                link = (parent.trace_id, parent.span_id)
-            else:
-                link = parse_traceparent(req.traceparent)
+            link = parse_traceparent(req.traceparent)
+            if link is None:
+                parent = current_span()
+                if parent is not None and parent.end_ns == 0:
+                    link = (parent.trace_id, parent.span_id)
             req.span = self.tracer.start_detached_span(
                 "llm.request", parent=link,
                 attributes={
@@ -2478,6 +2516,34 @@ class LLMEngine:
                     "llm.max_new_tokens": req.max_new_tokens,
                 },
             )
+            if req.journey_id is None:
+                req.journey_id = req.span.trace_id
+        elif self.tracer is not None and (req.deaths or req.retries or req.preempted):
+            # failover continuation landing on a new replica: the original
+            # llm.request span stays open (same trace — the journey_id is
+            # stable across kills), and this hop gets its own continuation
+            # span LINKED to the original so a 3-hop failover reads as one
+            # journey even in link-aware external backends.
+            req.hop += 1
+            t_ns = time.time_ns()
+            self.tracer.record_span(
+                "llm.continuation",
+                trace_id=req.span.trace_id,
+                parent_id=req.span.span_id,
+                start_ns=t_ns, end_ns=t_ns,
+                attributes={
+                    "llm.model": self.label,
+                    "llm.request_id": req.id,
+                    "llm.hop": req.hop,
+                    "llm.kind": "failover",
+                    "llm.deaths": req.deaths,
+                    "llm.preempted": req.preempted,
+                    "llm.emitted": req.emitted,
+                },
+                links=[(req.span.trace_id, req.span.span_id)],
+            )
+        if req.journey_id is None and req.span is not None:
+            req.journey_id = req.span.trace_id
         self.submitted += 1  # routing/diagnostic counter (GIL-atomic enough)
         with self._lock:
             # outstanding-token estimate for the replica router: prompt
@@ -2710,6 +2776,7 @@ class LLMEngine:
             "waiting": waiting,
             "admitting": self._admitting,
             "phases": phases,
+            "slo": self.slo.snapshot() if self.slo is not None else None,
             "mfu": self._mfu_summary(),
             "warmup_s": self.warmup_s,
             # this engine's rows from the process compile registry (the
@@ -3257,6 +3324,12 @@ class LLMEngine:
             "app_llm_model_version_info", 0.0,
             model=self.label, version=self.version,
         )
+        # SLO burn state is load state: a dead engine must not hold
+        # "fast burn" (health would stay degraded forever) nor keep its
+        # last burn rate on the dashboard; windows clear so a restarted
+        # engine starts on a clean error budget
+        if self.slo is not None:
+            self.slo.zero_gauges()
 
     def _teardown_profiling(self) -> None:
         """Compile-observatory teardown (close() and _die()): drop this
@@ -3811,6 +3884,29 @@ class LLMEngine:
         r._spec_inflight = 0
         r.phase = "queued"
         r.preempted += 1
+        if self.tracer is not None and r.span is not None:
+            # journey hop: the preemption continuation re-admits inside
+            # this engine (it never passes through submit()), so the
+            # continuation span is recorded here — linked to the original
+            # request span, same trace, hop bumped (wide event reads
+            # "hop N of journey J" across preemptions AND failovers)
+            r.hop += 1
+            t_ns = time.time_ns()
+            self.tracer.record_span(
+                "llm.continuation",
+                trace_id=r.span.trace_id,
+                parent_id=r.span.span_id,
+                start_ns=t_ns, end_ns=t_ns,
+                attributes={
+                    "llm.model": self.label,
+                    "llm.request_id": r.id,
+                    "llm.hop": r.hop,
+                    "llm.kind": "preemption",
+                    "llm.preempted": r.preempted,
+                    "llm.emitted": r.emitted,
+                },
+                links=[(r.span.trace_id, r.span.span_id)],
+            )
         # fresh wait epoch, mirroring failover's path through submit():
         # without this, re-admission would observe queue_wait from the
         # ORIGINAL submit — service time + both waits in one inflated
@@ -4221,6 +4317,10 @@ class LLMEngine:
             if self.metrics is not None:
                 self.metrics.record_histogram(
                     "app_llm_queue_wait_seconds", wait, model=self.label,
+                    exemplar=(
+                        {"trace_id": r.span.trace_id}
+                        if r.span is not None else None
+                    ),
                     **self._role_labels,
                 )
             self._phase_span(r, "llm.queue_wait", r.submitted_at, now)
@@ -5048,9 +5148,26 @@ class LLMEngine:
             if self.metrics is not None:
                 self.metrics.record_histogram(
                     "app_llm_time_per_output_token_seconds", tpot,
+                    exemplar=(
+                        {"trace_id": r.span.trace_id}
+                        if r.span is not None else None
+                    ),
                     **self._role_labels,
                     model=self.label,
                 )
+        if self.slo is not None and r.finish_reason not in ("cancelled", "disconnect"):
+            # SLO verdict: availability counts service failures only — a
+            # client that hung up is not our error budget. TTFT/TPOT
+            # targets judge in ms; a request that never reached first
+            # token but finished "eos"/"length" cannot happen, so None
+            # latencies only ride the availability term.
+            self.slo.observe(
+                tenant=r.adapter or "-",
+                priority=r.priority if r.priority == "batch" else "interactive",
+                ok=r.finish_reason in ("eos", "length"),
+                ttft_ms=None if ttft is None else ttft * 1e3,
+                tpot_ms=None if tpot is None else tpot * 1e3,
+            )
         if r.finish_reason == "disconnect":
             # dead-peer cancellation (edge detected a closed connection):
             # the slot is free and the remaining decode was never done —
@@ -5091,6 +5208,12 @@ class LLMEngine:
                 "model_version": self.version,
                 "id": r.id,
                 "trace_id": r.span.trace_id if r.span is not None else "",
+                # journey identity: stable across failover/preemption
+                # hops (the trace id of the FIRST submit), plus which hop
+                # finished the work — `grep journey_id` over the fleet's
+                # logs reconstructs the same object the stitcher serves
+                "journey_id": r.journey_id or "",
+                "hop": r.hop,
                 "prompt_tokens": len(r.prompt_tokens),
                 "output_tokens": r.emitted,
                 "finish_reason": r.finish_reason,
@@ -5146,8 +5269,16 @@ class LLMEngine:
                     ttft = now - r.submitted_at
                     self._phases["ttft"].observe(ttft)
                     if self.metrics is not None:
+                        # exemplar: the p99 TTFT bucket on /metrics links
+                        # the trace id of the request that landed there —
+                        # feed it to the journey aggregator for the full
+                        # cross-process timeline
                         self.metrics.record_histogram(
                             "app_llm_ttft_seconds", ttft, model=self.label,
+                            exemplar=(
+                                {"trace_id": r.span.trace_id}
+                                if r.span is not None else None
+                            ),
                             **self._role_labels,
                         )
                         self.metrics.record_histogram(
@@ -7764,8 +7895,20 @@ class ReplicatedLLMEngine:
             "poisoned": self.poisoned,
             "canary": self._canary_enabled,
             "phases": self._merged_phases(),
+            "slo": self._merged_slo(),
             "per_replica": [e.debug_state() for e in self.engines],
         }
+
+    def _merged_slo(self) -> dict | None:
+        """Fleet SLO pooling: summed goodput, max-burn-across-replicas
+        (the hottest replica gates health — same semantics as
+        gauge_total over the per-replica fast-burn gauge)."""
+        from .metrics.slo import pool_snapshots
+
+        snaps = [
+            e.slo.snapshot() for e in self.engines if e.slo is not None
+        ]
+        return pool_snapshots(snaps) or None
 
     def drain(self) -> None:
         """Fleet drain: stop the supervisor from rebuilding (the process

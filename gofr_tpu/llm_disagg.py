@@ -127,6 +127,12 @@ class DisaggregatedLLMEngine:
         self.handoff_d2d = bool(handoff_d2d)
         self.logger = logger
         self.metrics = engine_kw.get("metrics")
+        # trace continuity across the disagg seam: the probe, the KV
+        # handoff, and the decode admit are phases of ONE caller journey —
+        # submit() captures the caller's context (the handoff executor
+        # threads never see the contextvar) and every phase span parents
+        # under it (docs/advanced-guide/observability-serving.md#journeys)
+        self.tracer = engine_kw.get("tracer")
         self.label = engine_kw.pop("kv_label", "llm")
         self.version = str(version)
 
@@ -249,6 +255,17 @@ class DisaggregatedLLMEngine:
             raise EngineDraining("engine draining (rolling deploy)")
         with self._lock:
             self.submitted += 1
+        # capture the caller's trace context HERE, on the submitting
+        # thread — _serve runs on the handoff executor where the tracing
+        # contextvar is empty, and without this stamp the probe and the
+        # decode-side request would each start a FRESH trace (the
+        # shattered-journey bug this threading exists to fix)
+        if self.tracer is not None and req.traceparent is None:
+            from .tracing import current_span
+
+            cs = current_span()
+            if cs is not None and cs.end_ns == 0:
+                req.traceparent = cs.traceparent
         if req.session_id:
             # conversation KV lives with the decode pool (the publishing
             # side); routing turns through the prefill pool would
@@ -263,10 +280,30 @@ class DisaggregatedLLMEngine:
                 self.fallbacks += 1
             self._count_handoff("fallback")
             return self.decode.submit(req)
+        dspan = None
+        if self.tracer is not None:
+            from .tracing import parse_traceparent
+
+            # one detached journey span for the whole disagg decision:
+            # the prefill probe's llm.request, the handoff phases, and
+            # the decode-side llm.request all parent under it, so the
+            # stitcher renders probe -> handoff -> decode as ONE subtree
+            dspan = self.tracer.start_detached_span(
+                "llm.disagg",
+                parent=parse_traceparent(req.traceparent),
+                attributes={
+                    "llm.model": self.label,
+                    "llm.request_id": req.id,
+                    "llm.prompt_tokens": len(req.prompt_tokens),
+                },
+            )
+            req.traceparent = dspan.traceparent
+            if req.journey_id is None:
+                req.journey_id = dspan.trace_id
         preq = GenRequest(
             list(req.prompt_tokens), max_new_tokens=1, temperature=0.0,
             eos_token=-1, priority=req.priority, client=req.client,
-            deadline=req.deadline,
+            deadline=req.deadline, traceparent=req.traceparent,
         )
         # synchronous prefill-pool admission: overload/validation errors
         # (429 + Retry-After, prompt-too-long) surface to the CALLER,
@@ -285,23 +322,42 @@ class DisaggregatedLLMEngine:
                     with self._lock:
                         self.fallbacks += 1
                     self._count_handoff("fallback")
+                    if dspan is not None:
+                        dspan.set_attribute("llm.disagg.outcome", "fallback")
+                        dspan.end()
                     return self.decode.submit(req)
         t0 = time.perf_counter()
-        self._pool.submit(self._serve, req, peng, preq, t0)
+        self._pool.submit(self._serve, req, peng, preq, t0, dspan)
         return req
 
-    def _serve(self, req, peng, preq, t0: float) -> None:
+    def _rec_phase(self, dspan, name: str, t0_ns: int, attrs: dict) -> None:
+        """Retrospective child span for one handoff phase (worker thread,
+        wall-clock anchored — same pattern as LLMEngine._phase_span)."""
+        if dspan is None or self.tracer is None:
+            return
+        self.tracer.record_span(
+            name, trace_id=dspan.trace_id, parent_id=dspan.span_id,
+            start_ns=t0_ns, end_ns=time.time_ns(), attributes=attrs,
+        )
+
+    def _serve(self, req, peng, preq, t0: float, dspan=None) -> None:
         """Handoff worker: wait out the prefill probe, move the prompt's
         KV blocks to a decode replica, then hand the caller's request to
         it (an exact radix hit — prefill skipped). Every failure mode
         falls back to a colocated submit; the stream only errors when NO
         live replica exists anywhere."""
         try:
+            probe_t0 = time.time_ns()
             try:
                 preq.tokens(timeout=max(60.0, self.handoff_timeout_s))
                 prefilled = preq.finish_reason in ("eos", "length")
             except Exception:  # noqa: BLE001 — probe died with its replica
                 prefilled = False
+            self._rec_phase(dspan, "disagg.prefill_probe", probe_t0, {
+                "llm.request_id": req.id,
+                "disagg.prefilled": prefilled,
+            })
+            handoff_t0 = time.time_ns()
             payload = None
             if prefilled and peng.alive():
                 try:
@@ -312,6 +368,10 @@ class DisaggregatedLLMEngine:
                     if self.logger is not None:
                         self.logger.warn(f"kv handoff export failed: {e!r}")
                     payload = None
+            handoff_bytes = sum(
+                int(getattr(payload.get(k), "nbytes", 0) or 0)
+                for k in ("k", "v")
+            ) if payload is not None else 0
             deng = self._pick_decode()
             imported = False
             if deng is not None and payload is not None:
@@ -324,12 +384,14 @@ class DisaggregatedLLMEngine:
                     if self.logger is not None:
                         self.logger.warn(f"kv handoff import failed: {e!r}")
                     imported = False
+            admit_t0 = time.time_ns()
             placed_on = self._submit_decode(req, deng)
             # outcome AFTER placement: "ok" means the request was
             # actually accepted by the replica holding the transferred
             # blocks — an import whose target died/drained before the
             # submit re-prefilled elsewhere and is a miss, not a win
             if imported and placed_on is deng:
+                outcome = "ok"
                 dt = time.perf_counter() - t0
                 with self._lock:
                     self.handoffs_ok += 1
@@ -337,15 +399,41 @@ class DisaggregatedLLMEngine:
                 self._count_handoff("ok")
                 if self.metrics is not None:
                     self.metrics.record_histogram(
-                        "app_llm_kv_handoff_seconds", dt, model=self.label
+                        "app_llm_kv_handoff_seconds", dt, model=self.label,
+                        exemplar=(
+                            {"trace_id": dspan.trace_id}
+                            if dspan is not None else None
+                        ),
                     )
             else:
+                outcome = "miss"
                 with self._lock:
                     self.handoffs_miss += 1
                 self._count_handoff("miss")
+            self._rec_phase(dspan, "disagg.kv_handoff", handoff_t0, {
+                "llm.request_id": req.id,
+                "disagg.outcome": outcome,
+                "disagg.bytes": handoff_bytes,
+                "disagg.imported": imported,
+            })
+            self._rec_phase(dspan, "disagg.decode_admit", admit_t0, {
+                "llm.request_id": req.id,
+                "disagg.placed": placed_on is not None,
+                "disagg.on_transfer_target": placed_on is deng,
+            })
+            if dspan is not None:
+                dspan.set_attribute("llm.disagg.outcome", outcome)
+                dspan.set_attribute("llm.disagg.bytes", handoff_bytes)
+                if placed_on is None:
+                    dspan.set_status("ERROR")
+                dspan.end()
         except BaseException as e:  # noqa: BLE001 — the stream must terminate
             if self.logger is not None:
                 self.logger.error(f"disaggregated serve failed: {e!r}")
+            if dspan is not None and dspan.end_ns == 0:
+                dspan.set_attribute("error", repr(e))
+                dspan.set_status("ERROR")
+                dspan.end()
             if req.finish_reason is None:
                 req.finish_reason = "error"
                 req.out.put(None)
@@ -518,6 +606,10 @@ class DisaggregatedLLMEngine:
         }
 
     def debug_state(self) -> dict:
+        from .metrics.slo import pool_snapshots
+
+        pre = self.prefill.debug_state()
+        dec = self.decode.debug_state()
         return {
             "disaggregated": True,
             "draining": self._draining,
@@ -529,8 +621,13 @@ class DisaggregatedLLMEngine:
                 "timeout_s": self.handoff_timeout_s,
                 "latency": self._handoff_window.summary(),
             },
-            "prefill": self.prefill.debug_state(),
-            "decode": self.decode.debug_state(),
+            # pooled across BOTH role pools (the caller's SLO does not
+            # care which pool burned the budget)
+            "slo": pool_snapshots(
+                [s for s in (pre.get("slo"), dec.get("slo")) if s]
+            ) or None,
+            "prefill": pre,
+            "decode": dec,
         }
 
     # -- lifecycle ----------------------------------------------------------

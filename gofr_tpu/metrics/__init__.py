@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import bisect
 import threading
+import time
 from typing import Iterable
 
 from ..logging import Logger
@@ -92,8 +93,13 @@ class Histogram:
         self._lock = threading.Lock()
         # per label-set: [bucket counts..., +inf count], sum, count
         self._series: dict[tuple[tuple[str, str], ...], list] = {}
+        # per label-set: bucket index -> (exemplar labels, value, unix ts) —
+        # last-wins, bounded by (label sets x buckets), so a percentile on
+        # the exposition always links the most recent trace that landed in
+        # that bucket (OpenMetrics exemplars).
+        self._exemplars: dict[tuple[tuple[str, str], ...], dict[int, tuple]] = {}
 
-    def record(self, value: float, **labels: str) -> None:
+    def record(self, value: float, exemplar: dict | None = None, **labels: str) -> None:
         key = _label_key(labels)
         idx = bisect.bisect_left(self.buckets, value)
         with self._lock:
@@ -104,11 +110,19 @@ class Histogram:
             s[0][idx] += 1
             s[1] += value
             s[2] += 1
+            if exemplar:
+                self._exemplars.setdefault(key, {})[idx] = (
+                    dict(exemplar), value, time.time(),
+                )
 
     def collect_histogram(self):
         with self._lock:
             items = [(k, ([*v[0]], v[1], v[2])) for k, v in self._series.items()]
         return items
+
+    def collect_exemplars(self):
+        with self._lock:
+            return {k: dict(v) for k, v in self._exemplars.items()}
 
     def percentile(self, q: float, **labels: str) -> float:
         """Approximate percentile from bucket midpoints (for health/bench)."""
@@ -152,21 +166,69 @@ class RollingWindow:
     The Prometheus histograms are cumulative-forever; live debugging wants
     "what do the LAST few hundred requests look like" — this keeps that
     window in-process at deque-append cost (O(1), one small lock) so the
-    serving hot loop can afford one observe() per phase transition."""
+    serving hot loop can afford one observe() per phase transition.
 
-    def __init__(self, size: int = 512):
+    With `max_age_s` set the window is additionally time-bounded: each
+    observation is timestamped and values older than the horizon fall out
+    on read — the form the SLO burn-rate engine uses for its 5m/1h
+    goodness windows (a quiet tenant's hour-old failures must stop
+    burning budget once they age past the window).
+    """
+
+    def __init__(self, size: int = 512, max_age_s: float | None = None, clock=None):
         from collections import deque
 
         self._lock = threading.Lock()
-        self._values: deque[float] = deque(maxlen=size)
+        self._age = float(max_age_s) if max_age_s else None
+        self._clock = clock if clock is not None else time.monotonic
+        self._values: deque = deque(maxlen=size)
+        self._sum = 0.0  # running sum -> O(1) mean() on the SLO hot path
 
     def observe(self, value: float) -> None:
         with self._lock:
-            self._values.append(value)
+            if (
+                self._values.maxlen is not None
+                and len(self._values) == self._values.maxlen
+                and self._values
+            ):
+                evicted = self._values[0]
+                self._sum -= evicted[1] if self._age is not None else evicted
+            if self._age is None:
+                self._values.append(value)
+            else:
+                self._values.append((self._clock(), value))
+            self._sum += value
+
+    def _trim_locked(self) -> None:
+        if self._age is None:
+            return
+        horizon = self._clock() - self._age
+        while self._values and self._values[0][0] < horizon:
+            _, v = self._values.popleft()
+            self._sum -= v
 
     def values(self) -> list[float]:
         with self._lock:
-            return list(self._values)
+            self._trim_locked()
+            if self._age is None:
+                return list(self._values)
+            return [v for _, v in self._values]
+
+    def mean(self) -> float:
+        with self._lock:
+            self._trim_locked()
+            n = len(self._values)
+            return (self._sum / n) if n else 0.0
+
+    def __len__(self) -> int:
+        with self._lock:
+            self._trim_locked()
+            return len(self._values)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._values.clear()
+            self._sum = 0.0
 
     def summary(self) -> dict:
         return summarize_window(self.values())
@@ -230,10 +292,10 @@ class Manager:
         if c:
             c.delta(by, **labels)
 
-    def record_histogram(self, name: str, value: float, **labels: str) -> None:
+    def record_histogram(self, name: str, value: float, exemplar: dict | None = None, **labels: str) -> None:
         h = self._get(name, Histogram)
         if h:
-            h.record(value, **labels)
+            h.record(value, exemplar=exemplar, **labels)
 
     def set_gauge(self, name: str, value: float, **labels: str) -> None:
         g = self._get(name, Gauge)
@@ -256,6 +318,17 @@ class Manager:
     # -- exposition --
     def render_prometheus(self) -> str:
         """Prometheus text format 0.0.4."""
+        return self._render(openmetrics=False)
+
+    def render_openmetrics(self) -> str:
+        """OpenMetrics text: the 0.0.4 exposition plus histogram-bucket
+        exemplars (`... # {trace_id="..."} value timestamp`) and the
+        mandatory `# EOF` terminator. Exemplars are only legal on this
+        content type, so the metrics server negotiates it via Accept —
+        it is how a p99 bucket links back to a stitchable journey."""
+        return self._render(openmetrics=True)
+
+    def _render(self, openmetrics: bool) -> str:
         with self._lock:
             instruments = list(self._instruments.values())
         out: list[str] = []
@@ -265,19 +338,29 @@ class Manager:
                 out.append(f"# HELP {name} {inst.description}")  # type: ignore[attr-defined]
             out.append(f"# TYPE {name} {inst.kind}")  # type: ignore[attr-defined]
             if isinstance(inst, Histogram):
+                exemplars = inst.collect_exemplars() if openmetrics else {}
                 for key, (counts, total_sum, count) in inst.collect_histogram():
                     base = dict(key)
+                    ex = exemplars.get(key, {})
                     acc = 0
-                    for ub, c in zip(inst.buckets, counts):
+                    for i, (ub, c) in enumerate(zip(inst.buckets, counts)):
                         acc += c
-                        out.append(_line(f"{name}_bucket", {**base, "le": _fmt(ub)}, acc))
+                        line = _line(f"{name}_bucket", {**base, "le": _fmt(ub)}, acc)
+                        if i in ex:
+                            line += _exemplar_suffix(*ex[i])
+                        out.append(line)
                     acc += counts[-1]
-                    out.append(_line(f"{name}_bucket", {**base, "le": "+Inf"}, acc))
+                    line = _line(f"{name}_bucket", {**base, "le": "+Inf"}, acc)
+                    if len(inst.buckets) in ex:
+                        line += _exemplar_suffix(*ex[len(inst.buckets)])
+                    out.append(line)
                     out.append(_line(f"{name}_sum", base, total_sum))
                     out.append(_line(f"{name}_count", base, count))
             else:
                 for mname, labels, value in inst.collect():  # type: ignore[attr-defined]
                     out.append(_line(mname, labels, value))
+        if openmetrics:
+            out.append("# EOF")
         return "\n".join(out) + "\n"
 
 
@@ -294,6 +377,11 @@ def _line(name: str, labels: dict[str, str], value) -> str:
 
 def _escape(v: str) -> str:
     return str(v).replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _exemplar_suffix(labels: dict, value: float, ts: float) -> str:
+    lab = ",".join(f'{k}="{_escape(v)}"' for k, v in sorted(labels.items()))
+    return f" # {{{lab}}} {_fmt(float(value))} {ts:.3f}"
 
 
 def new_metrics_manager(logger: Logger | None = None) -> Manager:

@@ -42,9 +42,19 @@ class _Handler(BaseHTTPRequestHandler):
             self.end_headers()
             return
         refresh_runtime_gauges(self.manager)
-        body = self.manager.render_prometheus().encode("utf-8")
+        # OpenMetrics negotiation (how Prometheus asks for exemplars):
+        # exemplar suffixes are only legal on the openmetrics content type,
+        # so the plain scrape stays byte-compatible 0.0.4.
+        accept = self.headers.get("Accept", "")
+        openmetrics = "application/openmetrics-text" in accept
+        if openmetrics:
+            body = self.manager.render_openmetrics().encode("utf-8")
+            ctype = "application/openmetrics-text; version=1.0.0; charset=utf-8"
+        else:
+            body = self.manager.render_prometheus().encode("utf-8")
+            ctype = "text/plain; version=0.0.4; charset=utf-8"
         self.send_response(200)
-        self.send_header("Content-Type", "text/plain; version=0.0.4; charset=utf-8")
+        self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
         self.wfile.write(body)

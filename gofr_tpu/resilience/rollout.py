@@ -111,7 +111,8 @@ def _env_int(name: str, default: int) -> int:
 
 
 def shadow_probe(candidate, prompts, *, max_new: int = SHADOW_MAX_NEW,
-                 timeout: float = 60.0, adapter: str = "") -> tuple[bool, str]:
+                 timeout: float = 60.0, adapter: str = "",
+                 tracer=None) -> tuple[bool, str]:
     """Replay a few REAL prompts on a not-yet-routed candidate engine
     and judge sanity only: the stream must complete (``max_new`` tokens
     — no eos is set, a short stream means a dying engine) and stay
@@ -121,28 +122,55 @@ def shadow_probe(candidate, prompts, *, max_new: int = SHADOW_MAX_NEW,
     what must not change is that it answers at all. ``adapter`` routes
     the replay through a STAGED LoRA adapter on a live engine (the
     adapter hot-load gate: the candidate is a table row, not an
-    engine)."""
+    engine). With ``tracer``, the whole replay is one
+    ``rollout.shadow_replay`` journey and each probe's engine spans nest
+    under it — a failed gate is debuggable from the trace store like any
+    other request."""
     from ..llm import GenRequest
+
+    gate_span = None
+    tp = None
+    if tracer is None:
+        tracer = getattr(candidate, "tracer", None)
+    if tracer is not None:
+        gate_span = tracer.start_detached_span(
+            "rollout.shadow_replay",
+            attributes={
+                "rollout.probes": len(list(prompts)),
+                "rollout.adapter": adapter,
+            },
+        )
+        tp = gate_span.traceparent
+
+    def _verdict(ok: bool, detail: str) -> tuple[bool, str]:
+        if gate_span is not None:
+            gate_span.set_attribute("rollout.verdict", detail)
+            if not ok:
+                gate_span.set_status("ERROR")
+            gate_span.end()
+        return ok, detail
 
     vocab = getattr(getattr(candidate, "cfg", None), "vocab_size", None)
     for n, prompt in enumerate(prompts):
         try:
             req = candidate.submit(GenRequest(
                 list(prompt), max_new_tokens=max_new, temperature=0.0,
-                eos_token=-1, adapter=adapter,
+                eos_token=-1, adapter=adapter, traceparent=tp,
             ))
             toks = req.tokens(timeout=timeout)
         except Exception as e:  # noqa: BLE001 — a crashing replay IS the verdict
-            return False, f"shadow probe {n} crashed: {e!r}"
+            return _verdict(False, f"shadow probe {n} crashed: {e!r}")
         if len(toks) != max_new:
-            return (
+            return _verdict(
                 False,
                 f"shadow probe {n} incomplete ({len(toks)}/{max_new} "
                 f"tokens, finish={req.finish_reason!r})",
             )
         if vocab is not None and any(t < 0 or t >= vocab for t in toks):
-            return False, f"shadow probe {n} emitted out-of-vocabulary token"
-    return True, "ok"
+            return _verdict(
+                False, f"shadow probe {n} emitted out-of-vocabulary token"
+            )
+    return _verdict(True, "ok")
 
 
 class _RolloutBase:

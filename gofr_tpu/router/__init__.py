@@ -219,6 +219,10 @@ class FrontRouter:
             metrics.new_counter(
                 "app_router_autoscale_total", "scale events by direction"
             )
+            metrics.new_counter(
+                "app_router_journey_queries_total",
+                "fleet journey stitches by outcome (ok|partial|empty)",
+            )
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> None:
@@ -538,6 +542,69 @@ class FrontRouter:
         )
 
 
+def journey_handler(ctx):
+    """GET /.well-known/debug/journey?trace_id=<32 hex> — the fleet
+    stitcher: fan the trace query over every fleet backend's journey
+    ring (GET /.well-known/debug/traces — each process keeps only its
+    OWN fragment), fold in this router's own hop spans, and assemble
+    one parent-linked journey tree. A request that crossed the router,
+    a prefill pool, a KV handoff, and a decode pool — or died and was
+    failed over — reads as ONE tree under one trace id, with zero
+    external tracing infra. Backends that can't answer (down, breaker
+    open) are reported in ``backends`` rather than failing the stitch:
+    a partial journey beats none while a replica is rebooting."""
+    from ..http.errors import ErrorInvalidParam
+    from ..tracing import stitch_spans
+
+    tid = (ctx.param("trace_id") or "").strip().lower()
+    if len(tid) != 32:
+        raise ErrorInvalidParam("trace_id")
+    spans: list[dict] = []
+    # the router's own spans first: router.proxy is the journey's top hop
+    ring = getattr(getattr(ctx.container, "tracer", None), "ring", None)
+    if ring is not None:
+        for s in ring.query(tid):
+            spans.append({**s, "process": "router"})
+    fr = getattr(ctx.container, "front_router", None)
+    polled: list[dict] = []
+    failures = 0
+    if fr is not None:
+        cfg = ctx.container.config
+        try:
+            timeout = cfg.get_float("TPU_ROUTER_JOURNEY_TIMEOUT_S", 5.0)
+        except Exception:  # noqa: BLE001 — malformed config -> default
+            timeout = 5.0
+        for b in fr.fleet.backends():
+            try:
+                out = b.svc.request(
+                    "GET", "/.well-known/debug/traces",
+                    params={"trace_id": tid}, timeout=timeout,
+                ).json()
+            except Exception as e:  # noqa: BLE001 — a dead shard is partial data
+                failures += 1
+                polled.append({
+                    "address": b.address, "ok": False, "error": repr(e),
+                })
+                continue
+            frag = out.get("data", out) if isinstance(out, dict) else {}
+            got = frag.get("spans") or []
+            for s in got:
+                if isinstance(s, dict):
+                    spans.append({**s, "process": b.address})
+            polled.append({
+                "address": b.address, "ok": True, "spans": len(got),
+            })
+        outcome = (
+            "empty" if not spans else ("partial" if failures else "ok")
+        )
+        fr._count("app_router_journey_queries_total", outcome=outcome)
+    return {
+        "trace_id": tid,
+        "backends": polled,
+        "journey": stitch_spans(spans),
+    }
+
+
 def router_debug_handler(ctx):
     """GET /.well-known/router — the live fleet view: per-backend
     health/load/breaker state, ring membership, admission + autoscaler
@@ -572,6 +639,9 @@ def new_router_app(config=None, *, configs_dir: str = "./configs"):
 
     proxy_timeout = app.config.get_float("TPU_ROUTER_PROXY_TIMEOUT_S", 300.0)
     app.get("/.well-known/router", router_debug_handler)
+    # the fleet stitcher (docs/advanced-guide/observability-serving.md):
+    # registered ahead of the catch-all so it answers from THIS process
+    app.get("/.well-known/debug/journey", journey_handler)
     # HEAD rides along so LB health probes / curl -I against proxied
     # paths answer like direct engine access would; OPTIONS needs no
     # route — the CORS middleware short-circuits every preflight

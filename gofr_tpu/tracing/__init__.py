@@ -32,7 +32,7 @@ def _rand_hex(nbytes: int) -> str:
 
 
 class Span:
-    __slots__ = ("trace_id", "span_id", "parent_id", "name", "start_ns", "end_ns", "attributes", "status", "_token", "_tracer")
+    __slots__ = ("trace_id", "span_id", "parent_id", "name", "start_ns", "end_ns", "attributes", "status", "links", "_token", "_tracer")
 
     def __init__(self, name: str, trace_id: str, span_id: str, parent_id: str | None, tracer: "Tracer | None"):
         self.name = name
@@ -43,11 +43,21 @@ class Span:
         self.end_ns = 0
         self.attributes: dict[str, Any] = {}
         self.status = "OK"
+        self.links: list[tuple[str, str]] | None = None
         self._token = None
         self._tracer = tracer
 
     def set_attribute(self, key: str, value: Any) -> None:
         self.attributes[key] = value
+
+    def add_link(self, trace_id: str, span_id: str) -> None:
+        """Causal link to another span (possibly in another trace) — the
+        OTel span-link: a failover continuation links the original request
+        span so a multi-hop journey reads as one object even if a seam
+        ever re-roots the trace."""
+        if self.links is None:
+            self.links = []
+        self.links.append((trace_id, span_id))
 
     def set_status(self, status: str) -> None:
         self.status = status
@@ -146,7 +156,17 @@ class ZipkinExporter(Exporter):
                 "timestamp": s.start_ns // 1000,
                 "duration": s.duration_us,
                 "localEndpoint": {"serviceName": self.service_name},
-                "tags": {str(k): str(v) for k, v in s.attributes.items()},
+                "tags": {
+                    **{str(k): str(v) for k, v in s.attributes.items()},
+                    **(
+                        {
+                            f"link.{i}": f"{t}/{sp}"
+                            for i, (t, sp) in enumerate(s.links)
+                        }
+                        if s.links
+                        else {}
+                    ),
+                },
             }
             for s in spans
         ]
@@ -208,6 +228,19 @@ class OTLPHTTPExporter(Exporter):
                                     "status": {
                                         "code": 2 if s.status == "ERROR" else 1
                                     },
+                                    **(
+                                        {
+                                            "links": [
+                                                {
+                                                    "traceId": t,
+                                                    "spanId": sp,
+                                                }
+                                                for t, sp in s.links
+                                            ]
+                                        }
+                                        if s.links
+                                        else {}
+                                    ),
                                 }
                                 for s in spans
                             ],
@@ -224,6 +257,126 @@ class OTLPHTTPExporter(Exporter):
         )
         with urllib.request.urlopen(req, timeout=5):  # noqa: S310
             pass
+
+
+def span_to_dict(s: Span) -> dict:
+    """Wire/debug form of a finished span — what the journey ring stores
+    and `GET /.well-known/debug/traces` serves."""
+    d = {
+        "trace_id": s.trace_id,
+        "span_id": s.span_id,
+        "parent_id": s.parent_id,
+        "name": s.name,
+        "start_ns": s.start_ns,
+        "end_ns": s.end_ns or s.start_ns,
+        "duration_us": s.duration_us,
+        "status": s.status,
+        "attributes": {str(k): v for k, v in s.attributes.items()},
+    }
+    if s.links:
+        d["links"] = [{"trace_id": t, "span_id": sp} for t, sp in s.links]
+    return d
+
+
+class RingExporter:
+    """Bounded per-process span store: the last `capacity` finished spans,
+    queryable by trace id, served at GET /.well-known/debug/traces.
+
+    Unlike the push exporters this is not fed through the BatchProcessor
+    thread — Tracer._on_end appends synchronously (one deque append under
+    a small lock), so it tees alongside ANY configured exporter, including
+    none, and a journey is queryable the instant its spans end. The fleet
+    aggregator (gofr_tpu/router/) fans the same query over every backend
+    and stitches the fragments: p99 spike -> exemplar trace id -> full
+    cross-process timeline with zero external infra."""
+
+    def __init__(self, capacity: int = 2048, service_name: str = ""):
+        from collections import deque
+
+        self.capacity = int(capacity)
+        self.service_name = service_name
+        self._lock = threading.Lock()
+        self._spans: "deque[dict]" = deque(maxlen=max(1, self.capacity))
+
+    def on_end(self, span: Span) -> None:
+        d = span_to_dict(span)
+        if self.service_name:
+            d["service"] = self.service_name
+        with self._lock:
+            self._spans.append(d)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._spans)
+
+    def query(self, trace_id: str) -> list[dict]:
+        tid = (trace_id or "").strip().lower()
+        with self._lock:
+            return [s for s in self._spans if s["trace_id"] == tid]
+
+    def trace_ids(self, limit: int = 64) -> list[dict]:
+        """Most-recent-first summary of distinct trace ids in the ring."""
+        with self._lock:
+            spans = list(self._spans)
+        seen: dict[str, dict] = {}
+        for s in spans:  # oldest -> newest; newest wins the root name
+            e = seen.setdefault(
+                s["trace_id"],
+                {"trace_id": s["trace_id"], "spans": 0, "root": s["name"]},
+            )
+            e["spans"] += 1
+            if not s.get("parent_id"):
+                e["root"] = s["name"]
+        out = list(seen.values())[::-1]
+        return out[: max(0, int(limit))]
+
+    def clear(self) -> int:
+        """Flush the ring (shutdown path — the dead-engine-gauge rule:
+        no stale journey fragments survive the process's serving life)."""
+        with self._lock:
+            n = len(self._spans)
+            self._spans.clear()
+        return n
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"spans": len(self._spans), "capacity": self.capacity}
+
+
+def stitch_spans(spans: list[dict]) -> dict:
+    """Stitch span fragments (possibly from many processes) into one
+    parent-linked journey tree. Children sort by start time; spans whose
+    parent is absent from the set become roots (the fragment boundary).
+    A well-threaded journey — router hop -> llm.request -> phases, with
+    continuations parented under the original request span — yields
+    exactly ONE root."""
+    by_id: dict[str, dict] = {}
+    nodes: list[dict] = []
+    for s in sorted(spans, key=lambda s: s.get("start_ns", 0)):
+        node = dict(s)
+        node["children"] = []
+        # keep first occurrence on span-id collision (dup fan-in replies)
+        if node.get("span_id") in by_id:
+            continue
+        by_id[node["span_id"]] = node
+        nodes.append(node)
+    roots: list[dict] = []
+    for node in nodes:
+        parent = by_id.get(node.get("parent_id") or "")
+        if parent is not None and parent is not node:
+            parent["children"].append(node)
+        else:
+            roots.append(node)
+    trace_ids = sorted({n["trace_id"] for n in nodes})
+    processes = sorted(
+        {str(n.get("process") or n.get("service") or "") for n in nodes} - {""}
+    )
+    return {
+        "trace_id": trace_ids[0] if len(trace_ids) == 1 else trace_ids,
+        "span_count": len(nodes),
+        "processes": processes,
+        "roots": roots,
+    }
 
 
 class BatchProcessor:
@@ -277,9 +430,10 @@ class BatchProcessor:
 class Tracer:
     """Factory for spans; owns the processor. One per app."""
 
-    def __init__(self, service_name: str = "gofr-tpu-app", processor: BatchProcessor | None = None):
+    def __init__(self, service_name: str = "gofr-tpu-app", processor: BatchProcessor | None = None, ring: RingExporter | None = None):
         self.service_name = service_name
         self._processor = processor
+        self.ring = ring
 
     def start_span(self, name: str, *, traceparent: str | None = None, attributes: dict | None = None) -> Span:
         parent = _current_span.get()
@@ -316,7 +470,7 @@ class Tracer:
     def record_span(
         self, name: str, *, trace_id: str, parent_id: str | None,
         start_ns: int, end_ns: int, attributes: dict | None = None,
-        status: str = "OK",
+        status: str = "OK", links: list[tuple[str, str]] | None = None,
     ) -> Span:
         """Record an already-elapsed interval as a finished span — the
         retrospective form the engine uses for phases it only measures
@@ -327,17 +481,23 @@ class Tracer:
         span.end_ns = max(end_ns, start_ns)
         if attributes:
             span.attributes.update(attributes)
+        if links:
+            span.links = list(links)
         span.status = status
         self._on_end(span)
         return span
 
     def _on_end(self, span: Span) -> None:
+        if self.ring is not None:
+            self.ring.on_end(span)
         if self._processor is not None:
             self._processor.on_end(span)
 
     def shutdown(self) -> None:
         if self._processor is not None:
             self._processor.shutdown()
+        if self.ring is not None:
+            self.ring.clear()
 
 
 def current_span() -> Span | None:
@@ -373,9 +533,19 @@ def new_tracer(config, logger=None) -> Tracer:
         exporter = ConsoleExporter(logger)
     elif exporter_kind == "memory":
         exporter = InMemoryExporter()
+    # Journey ring: on by default (it IS the zero-infra trace store the
+    # debug/traces endpoint and the fleet stitcher read); TRACE_RING_SPANS=0
+    # opts out, any other value sizes the ring.
+    try:
+        ring_cap = int(
+            config.get_or_default("TRACE_RING_SPANS", "2048") if config else 2048
+        )
+    except (TypeError, ValueError):
+        ring_cap = 2048
+    ring = RingExporter(ring_cap, name) if ring_cap > 0 else None
     if exporter is None:
-        return Tracer(name, None)
+        return Tracer(name, None, ring)
     proc = BatchProcessor(exporter)
-    t = Tracer(name, proc)
+    t = Tracer(name, proc, ring)
     t.exporter = exporter  # type: ignore[attr-defined] - exposed for tests
     return t

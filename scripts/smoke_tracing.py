@@ -1,0 +1,271 @@
+#!/usr/bin/env python
+"""CI tracing smoke: fleet journeys, SLO burn, and exemplars over real
+sockets (docs/advanced-guide/observability-serving.md).
+
+Boots a front router over two engine apps — a single-engine backend and
+a 2-replica fleet with a fault injector — then asserts the journey
+plane end to end:
+
+- a routed request's trace id fetches ONE stitched tree from the
+  router's GET /.well-known/debug/journey (router.proxy hop + the
+  engine's llm.request/phases, processes >= 2),
+- a request surviving an injected mid-stream replica kill stays
+  token-identical to an unfaulted run AND stays ONE journey: same trace
+  id end to end, an llm.continuation span with llm.hop >= 1 linked to
+  the original request span,
+- SLO-violating load (an unmeetable TPOT target) drives
+  app_llm_slo_total / app_llm_slo_burn_rate / app_llm_slo_fast_burn on
+  /metrics and flips /.well-known/health to degraded,
+- the hot-phase histograms expose trace-id exemplars under the
+  OpenMetrics content type (and NOT under classic Prometheus text).
+
+Usage: JAX_PLATFORMS=cpu python scripts/smoke_tracing.py
+Exit codes: 0 clean, non-zero assertion failure (message on stderr).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# two virtual CPU devices for the 2-replica fleet — BEFORE jax import
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=2"
+    ).strip()
+
+
+def _wait(pred, timeout: float, what: str) -> None:
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def _get(base: str, path: str, headers: dict | None = None, timeout=30):
+    req = urllib.request.Request(f"{base}{path}", headers=headers or {})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.read().decode()
+
+
+def _post(base: str, path: str, payload: dict, headers=None, timeout=60):
+    req = urllib.request.Request(
+        f"{base}{path}", data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})},
+        method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read())["data"]
+
+
+def _tree_names(node) -> set:
+    out = {node["name"]}
+    for c in node.get("children", []):
+        out |= _tree_names(c)
+    return out
+
+
+def _tree_spans(node) -> list:
+    out = [node]
+    for c in node.get("children", []):
+        out.extend(_tree_spans(c))
+    return out
+
+
+def main() -> int:
+    import jax
+
+    from gofr_tpu import App
+    from gofr_tpu import tracing as gt
+    from gofr_tpu.config import new_mock_config
+    from gofr_tpu.handler import llm_request_kwargs
+    from gofr_tpu.llm import LLMEngine
+    from gofr_tpu.models import TransformerConfig, init_params
+    from gofr_tpu.resilience import FaultInjector
+    from gofr_tpu.router import new_router_app
+
+    cfg = TransformerConfig.tiny()
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    assert len(jax.devices()) >= 2, jax.devices()
+    inj = FaultInjector()
+
+    def engine_app(name, **llm_kw):
+        app = App(config=new_mock_config({
+            "APP_NAME": name, "HTTP_PORT": "0", "METRICS_PORT": "0",
+            "LOG_LEVEL": "ERROR", "TPU_TELEMETRY_INTERVAL_S": "0",
+            "REQUEST_TIMEOUT": "120",
+            # an unmeetable TPOT target: every decoded request is
+            # SLO-bad, so the burn-rate plane lights up under load
+            "TPU_LLM_SLO_TPOT_MS": "0.000001",
+            "TPU_LLM_SLO_AVAILABILITY": "0.999",
+        }))
+        app.container.tpu().register_llm(
+            "tiny", cfg, params, max_seq_len=128, prefill_buckets=(8,),
+            prefill_chunk=4, step_token_budget=4, decode_chunk=2,
+            lookahead=1, warmup=False, **llm_kw,
+        )
+
+        def gen(ctx):
+            body = ctx.bind()
+            sp = gt.current_span()
+            out = ctx.tpu().llm("tiny").generate(
+                list(body["tokens"]),
+                max_new_tokens=int(body.get("max_new_tokens", 4)),
+                **llm_request_kwargs(ctx),
+            )
+            return {"tokens": out, "backend": name,
+                    "trace_id": sp.trace_id if sp else None}
+
+        app.post("/generate", gen)
+        app.run_in_background()
+        return app
+
+    e1 = engine_app("e1", slots=2)
+    e2 = engine_app("e2", slots=2, replicas=2, fault_injector=inj)
+    router = new_router_app(config=new_mock_config({
+        "APP_NAME": "router", "HTTP_PORT": "0", "METRICS_PORT": "0",
+        "LOG_LEVEL": "ERROR", "REQUEST_TIMEOUT": "60",
+        "TPU_ROUTER_BACKENDS": ",".join(
+            f"http://127.0.0.1:{b.http_server.port}" for b in (e1, e2)
+        ),
+        "TPU_ROUTER_POLL_INTERVAL_S": "0.1",
+    }))
+    router.run_in_background()
+
+    rbase = f"http://127.0.0.1:{router.http_server.port}"
+    e2base = f"http://127.0.0.1:{e2.http_server.port}"
+    try:
+        fr = router.front_router
+        _wait(lambda: len(fr.fleet.accepting()) == 2, 15, "fleet accepting")
+        prompt = list(range(1, 25))  # 24 tokens -> 6 prefill chunks
+
+        # ------------------------------------------------------- journey 1
+        # routed request -> ONE stitched cross-process tree
+        out = _post(rbase, "/generate", {"tokens": prompt,
+                                         "max_new_tokens": 4})
+        tid = out["trace_id"]
+        assert tid and len(tid) == 32, out
+
+        def stitched(trace_id):
+            j = json.loads(_get(
+                rbase, f"/.well-known/debug/journey?trace_id={trace_id}"
+            ))["data"]["journey"]
+            return j if j["roots"] else None
+
+        box: dict = {}
+        _wait(lambda: box.update(j=stitched(tid))
+              or (box["j"] and len(box["j"]["roots"]) == 1
+                  and len(box["j"]["processes"]) >= 2),
+              20, "stitched routed journey")
+        names = _tree_names(box["j"]["roots"][0])
+        for n in ("router.proxy", "llm.request", "llm.queue_wait",
+                  "llm.prefill", "llm.decode"):
+            assert n in names, sorted(names)
+        print(f"journey {tid[:8]}…: one tree, "
+              f"{box['j']['span_count']} spans over "
+              f"{len(box['j']['processes'])} processes")
+
+        # ------------------------------------------------------- journey 2
+        # failover mid-stream: token identity AND journey identity
+        mono = LLMEngine(
+            cfg, params, slots=2, max_seq_len=128, prefill_buckets=(8,),
+            prefill_chunk=4, step_token_budget=4, decode_chunk=2,
+            warmup=False,
+        )
+        try:
+            want = mono.generate(prompt, max_new_tokens=48)
+        finally:
+            mono.close()
+
+        rep = e2.container.tpu().llm("tiny").engine
+        result: dict = {}
+
+        def client():
+            result.update(_post(
+                e2base, "/generate",
+                {"tokens": prompt, "max_new_tokens": 48}, timeout=120,
+            ))
+
+        t = threading.Thread(target=client)
+        t.start()
+
+        def serving_index():
+            for i, e in enumerate(rep.engines):
+                if any(r is not None and r.emitted > 0
+                       for r in e._slot_req):
+                    return i
+            return None
+
+        _wait(lambda: serving_index() is not None, 30, "first token")
+        victim = serving_index()
+        inj.arm("replica_kill", label=f"/r{victim}")
+        print(f"killed replica {victim} mid-stream")
+        t.join(timeout=120)
+        assert not t.is_alive(), "client hung"
+        assert result["tokens"] == want, "failed-over stream diverged"
+        ftid = result["trace_id"]
+
+        _wait(lambda: box.update(j=stitched(ftid)) or box["j"], 20,
+              "stitched failover journey")
+        tree = box["j"]
+        assert len(tree["roots"]) == 1, "failover forked the journey"
+        spans = _tree_spans(tree["roots"][0])
+        conts = [s for s in spans if s["name"] == "llm.continuation"]
+        assert conts, sorted(s["name"] for s in spans)
+        hop = max(s["attributes"]["llm.hop"] for s in conts)
+        assert hop >= 1, conts
+        assert conts[0]["attributes"]["llm.kind"] == "failover"
+        req_spans = [s for s in spans if s["name"] == "llm.request"]
+        assert len(req_spans) == 1, "continuation forked llm.request"
+        assert conts[0]["links"][0]["span_id"] == req_spans[0]["span_id"]
+        print(f"failover journey {ftid[:8]}…: one tree, hop {hop}, "
+              f"token-identical")
+
+        # ------------------------------------------------- SLO burn plane
+        # the unmeetable TPOT target makes every decoded request bad:
+        # drive enough through e2 to arm the fast-burn two-window AND
+        for _ in range(12):
+            _post(e2base, "/generate", {"tokens": [1, 2, 3],
+                                        "max_new_tokens": 4})
+        e2m = f"http://127.0.0.1:{e2.metrics_server.port}"
+        expo = _get(e2m, "/metrics")
+        assert "app_llm_slo_total" in expo, "slo counters missing"
+        burn = [ln for ln in expo.splitlines()
+                if ln.startswith("app_llm_slo_burn_rate{")]
+        assert burn and any(float(ln.rsplit(" ", 1)[1]) > 0 for ln in burn)
+        fast = [ln for ln in expo.splitlines()
+                if ln.startswith("app_llm_slo_fast_burn{")]
+        assert fast and any(
+            float(ln.rsplit(" ", 1)[1]) == 1.0 for ln in fast
+        ), fast
+        health = json.loads(_get(e2base, "/.well-known/health"))["data"]
+        assert health["status"] == "degraded", health
+        print("slo burn: gauges hot, fast-burn flipped health degraded")
+
+        # ------------------------------------------------------- exemplars
+        om = _get(e2m, "/metrics",
+                  {"Accept": "application/openmetrics-text"})
+        assert '# {trace_id="' in om, "no exemplar in openmetrics expo"
+        assert om.rstrip().endswith("# EOF")
+        assert '# {trace_id="' not in _get(e2m, "/metrics")
+        print("exemplars: trace ids on hot-phase buckets (openmetrics only)")
+
+        print("TRACING SMOKE OK")
+        return 0
+    finally:
+        router.shutdown()
+        e1.shutdown()
+        e2.shutdown()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,707 @@
+"""Fleet-scale request journeys (docs/advanced-guide/
+observability-serving.md#request-journeys): the per-process span ring,
+cross-process journey stitching, per-tenant SLO burn rates, and
+OpenMetrics exemplars.
+
+The load-bearing invariants:
+
+- ONE journey, one trace id: a request that crosses a fleet seam —
+  disagg prefill -> KV handoff -> decode, a failover re-submit, a batch
+  job resumed from a queue payload — stitches into exactly ONE
+  parent-linked tree, and a failover continuation never changes the
+  journey_id OR the emitted tokens (token identity is re-asserted here
+  under tracing, not just in test_resilience).
+- SLO gauges follow the dead-engine-gauge rule: zero at close() AND
+  _die(); burn windows are time-bounded so old failures age out.
+
+scripts/smoke_tracing.py drives the router aggregator + exemplar path
+over real sockets in CI."""
+
+import io
+import json
+import threading
+import time
+import urllib.request
+from types import SimpleNamespace
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gofr_tpu import tracing as gt
+from gofr_tpu.config import new_mock_config
+from gofr_tpu.llm import GenRequest, LLMEngine, ReplicatedLLMEngine
+from gofr_tpu.logging import Logger
+from gofr_tpu.metrics import new_metrics_manager
+from gofr_tpu.metrics.slo import (
+    SLOPolicy,
+    SLOTracker,
+    pool_snapshots,
+)
+from gofr_tpu.models import TransformerConfig, generate, init_params
+from gofr_tpu.resilience import FaultInjector
+
+CFG = TransformerConfig.tiny()
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(jax.random.PRNGKey(0), CFG)
+
+
+def _wait(pred, timeout: float, what: str = "condition") -> None:
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def _ring_tracer(extra=None):
+    return gt.new_tracer(new_mock_config({
+        "TRACE_EXPORTER": "memory", **(extra or {}),
+    }))
+
+
+def _tree_names(node) -> set:
+    out = {node["name"]}
+    for c in node.get("children", []):
+        out |= _tree_names(c)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# journey store: the per-process span ring
+# ---------------------------------------------------------------------------
+class TestRingExporter:
+    def test_capacity_bound_and_query(self):
+        ring = gt.RingExporter(capacity=4, service_name="svc")
+        tracer = gt.Tracer("svc", processor=None, ring=ring)
+        tids = []
+        for i in range(6):
+            s = tracer.start_detached_span(f"op{i}")
+            tids.append(s.trace_id)
+            s.end()
+        # bounded: the two oldest spans fell out
+        assert len(ring) == 4
+        assert ring.query(tids[0]) == []
+        got = ring.query(tids[-1])
+        assert len(got) == 1 and got[0]["name"] == "op5"
+        assert got[0]["service"] == "svc"
+        assert ring.stats() == {"spans": 4, "capacity": 4}
+
+    def test_trace_ids_newest_first_and_clear(self):
+        ring = gt.RingExporter(capacity=16)
+        tracer = gt.Tracer("svc", processor=None, ring=ring)
+        for i in range(3):
+            with tracer.start_span(f"root{i}"):
+                with tracer.start_span("child"):
+                    pass
+        ids = ring.trace_ids()
+        assert [e["spans"] for e in ids] == [2, 2, 2]
+        assert ids[0]["root"] == "root2"  # newest first
+        assert ring.clear() == 6
+        assert ring.trace_ids() == [] and len(ring) == 0
+
+    def test_new_tracer_tees_ring_and_shutdown_clears(self):
+        tracer = _ring_tracer()
+        assert tracer.ring is not None
+        s = tracer.start_detached_span("op")
+        s.end()
+        assert len(tracer.ring) == 1  # synchronous tee, no flush needed
+        tracer.shutdown()
+        # shutdown flushes the exporter AND clears the ring: a restarted
+        # process must not serve stale journey fragments
+        assert len(tracer.ring) == 0
+        assert any(sp.name == "op" for sp in tracer.exporter.spans)
+
+    def test_ring_disabled_by_config(self):
+        tracer = gt.new_tracer(new_mock_config({
+            "TRACE_EXPORTER": "memory", "TRACE_RING_SPANS": "0",
+        }))
+        assert tracer.ring is None
+        tracer.start_detached_span("op").end()
+        tracer.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# stitching
+# ---------------------------------------------------------------------------
+class TestStitchSpans:
+    def _span(self, name, tid, sid, parent=None, start=0, process=""):
+        d = {
+            "trace_id": tid, "span_id": sid, "parent_id": parent,
+            "name": name, "start_ns": start, "end_ns": start + 1,
+            "duration_us": 0, "status": "OK", "attributes": {},
+        }
+        if process:
+            d["process"] = process
+        return d
+
+    def test_single_tree_children_sorted(self):
+        tid = "ab" * 16
+        spans = [
+            self._span("b", tid, "b" * 16, parent="a" * 16, start=20),
+            self._span("root", tid, "a" * 16, start=0),
+            self._span("a", tid, "c" * 16, parent="a" * 16, start=10),
+        ]
+        tree = gt.stitch_spans(spans)
+        assert tree["trace_id"] == tid and tree["span_count"] == 3
+        assert len(tree["roots"]) == 1
+        kids = [c["name"] for c in tree["roots"][0]["children"]]
+        assert kids == ["a", "b"]  # start-time order, not input order
+
+    def test_orphans_become_roots_and_processes_collected(self):
+        tid = "cd" * 16
+        spans = [
+            self._span("root", tid, "a" * 16, process="router"),
+            self._span("orphan", tid, "b" * 16, parent="f" * 16,
+                       start=5, process="http://e1"),
+        ]
+        tree = gt.stitch_spans(spans)
+        # the absent parent is a fragment boundary, not a dropped span
+        assert [r["name"] for r in tree["roots"]] == ["root", "orphan"]
+        assert tree["processes"] == ["http://e1", "router"]
+
+    def test_span_links_serialize(self):
+        tracer = gt.Tracer("svc", processor=None, ring=gt.RingExporter(8))
+        s = tracer.start_detached_span("continuation")
+        s.add_link("12" * 16, "34" * 8)
+        s.end()
+        d = tracer.ring.query(s.trace_id)[0]
+        assert d["links"] == [{"trace_id": "12" * 16, "span_id": "34" * 8}]
+
+
+# ---------------------------------------------------------------------------
+# SLO policy + tracker units
+# ---------------------------------------------------------------------------
+class TestSLOPolicy:
+    def test_judge_and_violations(self):
+        p = SLOPolicy(ttft_ms=100, tpot_ms=10, availability=0.999)
+        assert p.judge(ok=True, ttft_ms=50, tpot_ms=5)
+        assert p.violations(ok=True, ttft_ms=200, tpot_ms=20) == [
+            "ttft", "tpot",
+        ]
+        assert p.violations(ok=False, ttft_ms=None, tpot_ms=None) == [
+            "availability",
+        ]
+        # unset targets never judge; unreached phases (None) never judge
+        assert SLOPolicy(availability=0.999).judge(
+            ok=True, ttft_ms=9999, tpot_ms=9999
+        )
+        assert p.judge(ok=True, ttft_ms=None, tpot_ms=None)
+
+    def test_merged_override_and_budget(self):
+        base = SLOPolicy(ttft_ms=100, availability=0.999)
+        gold = base.merged(SLOPolicy(availability=0.9999))
+        assert gold.ttft_ms == 100 and gold.availability == 0.9999
+        assert gold.budget() == pytest.approx(1e-4)
+        assert base.merged(None) is base
+
+    def test_from_config_and_coerce(self):
+        p = SLOPolicy.from_config(new_mock_config({
+            "TPU_LLM_SLO_TTFT_MS": "250", "TPU_LLM_SLO_AVAILABILITY": "0.99",
+        }))
+        assert p.ttft_ms == 250 and p.availability == 0.99 and p.active()
+        assert not SLOPolicy.from_config(new_mock_config({})).active()
+        assert SLOPolicy.coerce({"tpot_ms": 5}).tpot_ms == 5
+        with pytest.raises(TypeError):
+            SLOPolicy.coerce("nope")
+
+
+class TestSLOTracker:
+    def test_counters_and_breach_attribution(self):
+        m = new_metrics_manager()
+        t = SLOTracker(SLOPolicy(ttft_ms=100, availability=0.999), m, "llm")
+        assert t.observe(tenant="-", priority="interactive", ok=True,
+                         ttft_ms=50, tpot_ms=None)
+        assert not t.observe(tenant="-", priority="interactive", ok=True,
+                             ttft_ms=500, tpot_ms=None)
+        assert not t.observe(tenant="gold", priority="batch", ok=False,
+                             ttft_ms=None, tpot_ms=None)
+        snap = t.snapshot()
+        assert snap["good"] == 1 and snap["total"] == 3
+        expo = m.render_prometheus()
+        assert 'app_llm_slo_total{model="llm",priority="interactive",tenant="-"} 2' in expo
+        assert 'app_llm_slo_good_total{model="llm",priority="interactive",tenant="-"} 1' in expo
+        # which objective burns the budget, attributed per violation
+        assert 'app_llm_slo_breaches_total{model="llm",objective="ttft"} 1' in expo
+        assert 'app_llm_slo_breaches_total{model="llm",objective="availability"} 1' in expo
+
+    def test_tenant_override_refines_base_policy(self):
+        t = SLOTracker(
+            SLOPolicy(ttft_ms=1000), None, "llm",
+            tenant_overrides={"gold": SLOPolicy(ttft_ms=10)},
+        )
+        assert t.observe(tenant="-", priority="interactive", ok=True,
+                         ttft_ms=500, tpot_ms=None)
+        assert not t.observe(tenant="gold", priority="interactive", ok=True,
+                             ttft_ms=500, tpot_ms=None)
+
+    def test_burn_rates_fast_burn_and_ageing(self):
+        now = [0.0]
+        m = new_metrics_manager()
+        t = SLOTracker(SLOPolicy(availability=0.999), m, "llm",
+                       clock=lambda: now[0])
+        for _ in range(20):
+            t.observe(tenant="-", priority="interactive", ok=False,
+                      ttft_ms=None, tpot_ms=None)
+        # all-bad: burn = 1.0 / 0.001 budget = 1000x in both windows
+        assert t.burn_rates()["5m"] == pytest.approx(1000.0)
+        assert t.fast_burn()
+        assert m.gauge_total("app_llm_slo_fast_burn") == 1.0
+        # failures age past the 5m horizon -> the short window recovers
+        # (and with it the two-window AND)
+        now[0] = 301.0
+        t.observe(tenant="-", priority="interactive", ok=True,
+                  ttft_ms=None, tpot_ms=None)
+        assert t.burn_rates()["5m"] == 0.0
+        assert t.burn_rates()["1h"] > 0.0  # long window still remembers
+        assert not t.fast_burn()
+
+    def test_fast_burn_needs_min_samples(self):
+        from gofr_tpu.metrics.slo import MIN_FAST_BURN_SAMPLES
+
+        t = SLOTracker(SLOPolicy(availability=0.999), None, "llm")
+        for _ in range(MIN_FAST_BURN_SAMPLES - 1):
+            t.observe(tenant="-", priority="interactive", ok=False,
+                      ttft_ms=None, tpot_ms=None)
+        assert not t.fast_burn()  # one bad request must not page
+        t.observe(tenant="-", priority="interactive", ok=False,
+                  ttft_ms=None, tpot_ms=None)
+        assert t.fast_burn()
+
+    def test_zero_gauges_clears_windows_and_gauges(self):
+        m = new_metrics_manager()
+        t = SLOTracker(SLOPolicy(availability=0.999), m, "llm")
+        for _ in range(12):
+            t.observe(tenant="-", priority="interactive", ok=False,
+                      ttft_ms=None, tpot_ms=None)
+        assert m.gauge_total("app_llm_slo_fast_burn") == 1.0
+        t.zero_gauges()
+        assert m.gauge_total("app_llm_slo_fast_burn") == 0.0
+        assert m.gauge_total("app_llm_slo_burn_rate") == 0.0
+        assert t.burn_rates()["1h"] == 0.0  # windows cleared too
+
+    def test_pool_snapshots(self):
+        mk = lambda good, total, burn, fast: {  # noqa: E731
+            "policy": {"availability": 0.999}, "good": good, "total": total,
+            "burn_rates": {"5m": burn}, "fast_burn": fast,
+        }
+        pooled = pool_snapshots([mk(9, 10, 2.0, False), mk(5, 10, 50.0, True)])
+        assert pooled["replicas"] == 2
+        assert pooled["good"] == 14 and pooled["total"] == 20
+        assert pooled["burn_rates"]["5m"] == 50.0  # max: hottest replica
+        assert pooled["fast_burn"] is True
+        assert pool_snapshots([]) == {}
+
+
+# ---------------------------------------------------------------------------
+# OpenMetrics exemplars
+# ---------------------------------------------------------------------------
+class TestExemplars:
+    def test_exemplar_renders_openmetrics_only(self):
+        m = new_metrics_manager()
+        m.new_histogram("app_test_seconds", "t", buckets=[0.1, 1.0])
+        m.record_histogram(
+            "app_test_seconds", 0.05,
+            exemplar={"trace_id": "ab" * 16}, model="x",
+        )
+        om = m.render_openmetrics()
+        assert f'# {{trace_id="{"ab" * 16}"}} 0.05' in om
+        assert om.rstrip().endswith("# EOF")
+        prom = m.render_prometheus()
+        assert "trace_id" not in prom  # classic scrapers get classic text
+        assert "# EOF" not in prom
+
+
+# ---------------------------------------------------------------------------
+# engine wiring: SLO verdicts, journey fields, exemplars, gauge lifecycle
+# ---------------------------------------------------------------------------
+class TestEngineSLO:
+    def _engine(self, params, **kw):
+        metrics = new_metrics_manager()
+        out = io.StringIO()
+        logger = Logger(out=out, err=out, pretty=False)
+        tracer = _ring_tracer()
+        eng = LLMEngine(
+            CFG, params, slots=2, max_seq_len=64, prefill_buckets=(8,),
+            warmup=False, logger=logger, metrics=metrics, tracer=tracer,
+            slo={"availability": 0.999}, **kw,
+        )
+        return eng, metrics, tracer, out
+
+    def _wide_event(self, out: io.StringIO) -> dict:
+        lines = [ln for ln in out.getvalue().splitlines()
+                 if "llm_request" in ln]
+        assert lines, out.getvalue()
+        return json.loads(lines[-1])["message"]
+
+    def test_slo_verdict_journey_fields_and_exemplar(self, params):
+        eng, metrics, tracer, out = self._engine(params)
+        try:
+            parent = tracer.start_span("handler POST /generate")
+            eng.submit(GenRequest([5, 9, 2], max_new_tokens=4)).tokens()
+            parent.end()
+            _wait(lambda: "llm_request" in out.getvalue(), 10, "wide event")
+            ev = self._wide_event(out)
+            # journey fields: journey_id is the ORIGINAL trace id, hop 0
+            # for a request served by its first replica
+            assert ev["journey_id"] == parent.trace_id
+            assert ev["hop"] == 0
+            st = eng.debug_state()["slo"]
+            assert st["total"] == 1 and st["good"] == 1
+            assert st["policy"]["availability"] == 0.999
+            # the hot-phase histograms carry the trace id as an exemplar
+            om = metrics.render_openmetrics()
+            assert f'trace_id="{parent.trace_id}"' in om
+            assert "app_llm_ttft_seconds" in om
+        finally:
+            eng.close()
+            tracer.shutdown()
+        # dead-engine-gauge rule at close()
+        assert metrics.gauge_total("app_llm_slo_burn_rate") == 0.0
+        assert metrics.gauge_total("app_llm_slo_fast_burn") == 0.0
+
+    def test_slo_gauges_zero_at_die(self, params):
+        """_die() is the path close() never takes — the regression class
+        where a dead replica exports 'fast burn' forever."""
+        eng, metrics, tracer, _ = self._engine(params)
+        try:
+            # burn the budget: shed-class finishes are availability-bad
+            for _ in range(12):
+                eng.slo.observe(tenant="-", priority="interactive",
+                                ok=False, ttft_ms=None, tpot_ms=None)
+            assert metrics.gauge_total("app_llm_slo_fast_burn") == 1.0
+            eng._die("test-induced death")
+            _wait(lambda: not eng.alive(), 10, "engine death")
+            assert metrics.gauge_total("app_llm_slo_fast_burn") == 0.0
+            assert metrics.gauge_total("app_llm_slo_burn_rate") == 0.0
+        finally:
+            eng.close()
+            tracer.shutdown()
+
+    def test_fast_burn_flips_health_degraded(self, params):
+        from gofr_tpu.handler import _serving_status
+
+        eng, metrics, tracer, _ = self._engine(params)
+        try:
+            container = SimpleNamespace(
+                config=new_mock_config({}), metrics_manager=metrics,
+            )
+            assert _serving_status(container) == "UP"
+            for _ in range(12):
+                eng.slo.observe(tenant="-", priority="interactive",
+                                ok=False, ttft_ms=None, tpot_ms=None)
+            # unconditional, like a parked replica: the SLO targets
+            # themselves are the opt-in
+            assert _serving_status(container) == "degraded"
+        finally:
+            eng.close()
+            tracer.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# batch jobs: traceparent rides the payload across the queue
+# ---------------------------------------------------------------------------
+class TestBatchJourney:
+    def test_worker_resumes_payload_traceparent(self, params):
+        import asyncio
+
+        from gofr_tpu.batch import BatchJob, BatchWorker
+        from gofr_tpu.datasource.pubsub import MemoryPubSub
+
+        cfg300 = TransformerConfig.tiny(vocab_size=300)
+        p300 = init_params(jax.random.PRNGKey(0), cfg300)
+        tracer = _ring_tracer()
+        eng = LLMEngine(cfg300, p300, slots=2, max_seq_len=64,
+                        warmup=False, tracer=tracer)
+        ps = MemoryPubSub()
+        container = SimpleNamespace(
+            pubsub=ps, logger=None, metrics_manager=None, tracer=tracer,
+            tpu=lambda: SimpleNamespace(llm=lambda name: eng),
+        )
+        w = BatchWorker(container, "jobs", model="m", poll_timeout=0.1)
+        tid, sid = "ef" * 16, "ab" * 8
+        ps.publish_sync("jobs", json.dumps({
+            "id": "j1", "tokens": [1, 2, 3], "max_new_tokens": 2,
+            "traceparent": f"00-{tid}-{sid}-01",
+        }).encode())
+        loop = asyncio.new_event_loop()
+        th = threading.Thread(
+            target=lambda: loop.run_until_complete(w.run()), daemon=True,
+        )
+        th.start()
+        try:
+            _wait(lambda: w.jobs_ok == 1, 60, "job ok")
+            spans = tracer.ring.query(tid)
+            by_name = {s["name"]: s for s in spans}
+            # the queue payload's context resumed: batch.job parents to
+            # the submitter's span, llm.request parents to batch.job
+            assert "batch.job" in by_name and "llm.request" in by_name
+            job = by_name["batch.job"]
+            assert job["parent_id"] == sid
+            assert job["attributes"]["batch.job_id"] == "j1"
+            assert by_name["llm.request"]["parent_id"] == job["span_id"]
+            tree = gt.stitch_spans(spans)
+            assert len(tree["roots"]) == 1  # one journey
+        finally:
+            w.close()
+            th.join(timeout=10)
+            loop.close()
+            eng.close()
+            tracer.shutdown()
+        # requeue/DLQ re-walks republish job.raw — the traceparent must
+        # survive the round trip so a retry continues the same journey
+        j = BatchJob({"tokens": [1], "traceparent": f"00-{tid}-{sid}-01"})
+        assert BatchJob(dict(j.raw)).traceparent == f"00-{tid}-{sid}-01"
+
+
+# ---------------------------------------------------------------------------
+# failover: one journey, stable id, token-identical continuation
+# ---------------------------------------------------------------------------
+class TestFailoverJourney:
+    PROMPT = tuple(range(1, 25))  # 24 tokens -> 6 prefill chunks of 4
+
+    def test_kill_mid_flight_single_journey(self, params):
+        inj = FaultInjector()
+        tracer = _ring_tracer()
+        rep = ReplicatedLLMEngine(
+            CFG, params, replicas=2, fault_injector=inj, supervise=False,
+            slots=2, max_seq_len=128, prefill_buckets=(8,), prefill_chunk=4,
+            step_token_budget=4, decode_chunk=2, lookahead=1, warmup=False,
+            tracer=tracer, slo={"availability": 0.999},
+        )
+        try:
+            toks = jnp.asarray([list(self.PROMPT)], jnp.int32)
+            lens = jnp.asarray([len(self.PROMPT)], jnp.int32)
+            want = [int(t) for t in np.asarray(
+                generate(params, CFG, toks, lens, 8))[0]]
+
+            parent = tracer.start_span("handler POST /generate")
+            req = GenRequest(list(self.PROMPT), max_new_tokens=8)
+            rep.engines[0].submit(req)
+            parent.end()
+            _wait(lambda: req.prefill_pos > 0, 20, "first prefill chunk")
+            inj.arm("replica_kill", label="/r0")
+            got = req.tokens(timeout=60)
+
+            # recovery changed scheduling, never results
+            assert got == want
+            assert rep.failovers >= 1
+            # journey identity pinned across the kill: same trace, same
+            # journey_id, hop counts the re-submit
+            assert req.journey_id == parent.trace_id
+            assert req.hop >= 1
+            spans = tracer.ring.query(parent.trace_id)
+            cont = [s for s in spans if s["name"] == "llm.continuation"]
+            assert cont, [s["name"] for s in spans]
+            assert cont[0]["attributes"]["llm.kind"] == "failover"
+            assert cont[0]["attributes"]["llm.hop"] >= 1
+            assert cont[0]["attributes"]["llm.deaths"] >= 1
+            # linked to the original request span (the OTel idiom)
+            req_span = next(s for s in spans if s["name"] == "llm.request")
+            assert cont[0]["links"] == [{
+                "trace_id": parent.trace_id, "span_id": req_span["span_id"],
+            }]
+            # exactly ONE llm.request span: the original stays open across
+            # the kill, continuations never fork a second root
+            assert sum(1 for s in spans if s["name"] == "llm.request") == 1
+            tree = gt.stitch_spans(spans)
+            assert len(tree["roots"]) == 1
+            assert tree["roots"][0]["name"] == "handler POST /generate"
+            # fleet-pooled SLO view survives the death
+            pooled = rep.debug_state()["slo"]
+            assert pooled["total"] >= 1 and pooled["replicas"] == 2
+        finally:
+            rep.close()
+            tracer.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# disaggregated serving: prefill -> handoff -> decode, one tree
+# ---------------------------------------------------------------------------
+class TestDisaggJourney:
+    def test_one_stitched_tree_across_pools(self, params):
+        from gofr_tpu.llm_disagg import DisaggregatedLLMEngine
+
+        tracer = _ring_tracer()
+        eng = DisaggregatedLLMEngine(
+            CFG, params, replicas=2, prefill_replicas=1, supervise=False,
+            slots=4, max_seq_len=64, prefill_buckets=(8,), decode_chunk=4,
+            prefill_chunk=4, step_token_budget=8, warmup=False,
+            tracer=tracer,
+        )
+        try:
+            parent = tracer.start_span("handler POST /generate")
+            got = eng.generate(list(range(1, 21)), max_new_tokens=4)
+            parent.end()
+            assert len(got) == 4
+
+            spans = tracer.ring.query(parent.trace_id)
+            names = sorted(s["name"] for s in spans)
+            for name in ("llm.disagg", "disagg.prefill_probe",
+                         "disagg.kv_handoff", "disagg.decode_admit"):
+                assert name in names, names
+            assert names.count("llm.request") == 2  # probe + decode
+            dspan = next(s for s in spans if s["name"] == "llm.disagg")
+            assert dspan["parent_id"] == parent.span_id
+            assert dspan["attributes"]["llm.disagg.outcome"] == "ok"
+            handoff = next(
+                s for s in spans if s["name"] == "disagg.kv_handoff"
+            )
+            assert handoff["attributes"]["disagg.outcome"] == "ok"
+            assert handoff["attributes"]["disagg.bytes"] > 0
+            # every phase child hangs under llm.disagg; ONE root overall
+            tree = gt.stitch_spans(spans)
+            assert len(tree["roots"]) == 1
+            under_disagg = _tree_names(
+                next(c for c in tree["roots"][0]["children"]
+                     if c["name"] == "llm.disagg")
+            )
+            assert {"disagg.prefill_probe", "disagg.kv_handoff",
+                    "disagg.decode_admit", "llm.request"} <= under_disagg
+        finally:
+            eng.close()
+            tracer.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# real sockets: router aggregator stitches spans across processes
+# ---------------------------------------------------------------------------
+class TestFleetJourneyEndpoint:
+    def _engine_app(self, name, cfg, params, **llm_kw):
+        from gofr_tpu.app import App
+        from gofr_tpu.handler import llm_request_kwargs
+
+        app = App(config=new_mock_config({
+            "APP_NAME": name, "HTTP_PORT": "0", "METRICS_PORT": "0",
+            "LOG_LEVEL": "ERROR", "TPU_TELEMETRY_INTERVAL_S": "0",
+            "REQUEST_TIMEOUT": "60",
+        }))
+        app.container.tpu().register_llm(
+            "tiny", cfg, params, max_seq_len=64, prefill_buckets=(8,),
+            warmup=False, **llm_kw,
+        )
+
+        def gen(ctx):
+            body = ctx.bind()
+            sp = gt.current_span()
+            kw = llm_request_kwargs(ctx)
+            # the session header steers ROUTER affinity only here: a
+            # session-pinned request is served colocated by the disagg
+            # engine (its KV lives with the decode pool), and this test
+            # needs the handoff path
+            kw.pop("session_id", None)
+            out = ctx.tpu().llm("tiny").generate(
+                list(body["tokens"]),
+                max_new_tokens=int(body.get("max_new_tokens", 4)),
+                **kw,
+            )
+            return {"tokens": out, "backend": name,
+                    "trace_id": sp.trace_id if sp else None}
+
+        app.post("/generate", gen)
+        app.run_in_background()
+        return app
+
+    def _get(self, app, path, timeout=30):
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{app.http_server.port}{path}",
+            timeout=timeout,
+        ) as resp:
+            return json.loads(resp.read())
+
+    def test_cross_process_stitch_disagg_fleet(self, params):
+        from gofr_tpu.router import new_router_app
+
+        e1 = self._engine_app("e1", CFG, params, slots=2)
+        e2 = self._engine_app(
+            "e2", CFG, params, slots=4, disagg=True, replicas=2,
+            prefill_replicas=1, supervise=False, prefill_chunk=4,
+            step_token_budget=8, decode_chunk=4,
+        )
+        router = new_router_app(config=new_mock_config({
+            "APP_NAME": "router", "HTTP_PORT": "0", "METRICS_PORT": "0",
+            "LOG_LEVEL": "ERROR", "REQUEST_TIMEOUT": "30",
+            "TPU_ROUTER_BACKENDS": ",".join(
+                f"http://127.0.0.1:{b.http_server.port}" for b in (e1, e2)
+            ),
+            "TPU_ROUTER_POLL_INTERVAL_S": "0.1",
+        }))
+        router.run_in_background()
+        try:
+            fr = router.front_router
+            _wait(lambda: len(fr.fleet.accepting()) == 2, 15,
+                  "both backends accepting")
+            # drive one request through EACH backend (session affinity
+            # pins a conversation; scan sessions until both are hit)
+            traces = {}  # backend name -> trace id
+            for i in range(32):
+                data = json.dumps({
+                    "tokens": list(range(1, 21)), "max_new_tokens": 4,
+                }).encode()
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{router.http_server.port}/generate",
+                    data=data, method="POST",
+                    headers={"Content-Type": "application/json",
+                             "X-GoFr-Session": f"conv-{i}"},
+                )
+                with urllib.request.urlopen(req, timeout=60) as resp:
+                    out = json.loads(resp.read())["data"]
+                traces.setdefault(out["backend"], out["trace_id"])
+                if len(traces) == 2:
+                    break
+            assert set(traces) == {"e1", "e2"}, traces
+
+            for backend, tid in traces.items():
+                # the backend's own ring serves the fragment...
+                app = e1 if backend == "e1" else e2
+                frag = self._get(
+                    app, f"/.well-known/debug/traces?trace_id={tid}"
+                )["data"]
+                assert frag["span_count"] > 0
+
+                # ...and the router stitches router + engine fragments
+                # into ONE tree (poll: the server span lands in the ring
+                # a beat after the response is written)
+                def stitched():
+                    out = self._get(
+                        router,
+                        f"/.well-known/debug/journey?trace_id={tid}",
+                    )["data"]
+                    j = out["journey"]
+                    return out if (
+                        len(j["roots"]) == 1
+                        and len(j["processes"]) >= 2
+                    ) else None
+
+                box = {}
+                _wait(lambda: box.update(j=stitched()) or box["j"], 20,
+                      f"stitched journey via {backend}")
+                out = box["j"]
+                assert all(b["ok"] for b in out["backends"])
+                journey = out["journey"]
+                assert journey["trace_id"] == tid
+                names = _tree_names(journey["roots"][0])
+                # router hop + engine request + every engine phase
+                assert "router.proxy" in names, names
+                for n in ("llm.request", "llm.queue_wait", "llm.prefill",
+                          "llm.decode"):
+                    assert n in names, (backend, sorted(names))
+                if backend == "e2":  # the disagg pair: handoff spans too
+                    for n in ("llm.disagg", "disagg.prefill_probe",
+                              "disagg.kv_handoff", "disagg.decode_admit"):
+                        assert n in names, sorted(names)
+            # outcome counter moved
+            expo = urllib.request.urlopen(
+                f"http://127.0.0.1:{router.metrics_server.port}/metrics",
+                timeout=10,
+            ).read().decode()
+            assert "app_router_journey_queries_total" in expo
+        finally:
+            router.shutdown()
+            e1.shutdown()
+            e2.shutdown()
