@@ -21,6 +21,7 @@ from .config import Config, EnvConfig
 from .container import Container
 from .context import Context
 from .handler import (
+    debug_blackbox_handler,
     debug_compiles_handler,
     debug_engine_handler,
     debug_profile_handler,
@@ -28,6 +29,7 @@ from .handler import (
     favicon_wire_handler,
     health_handler,
     live_handler,
+    replay_handler,
     rollout_handler,
     rollout_status_handler,
     wrap_handler,
@@ -304,6 +306,22 @@ class App:
         self.get("/.well-known/debug/rollout", rollout_status_handler)
         self._add(
             "POST", "/.well-known/debug/rollout", rollout_handler,
+            timeout_s=max(120.0, self.request_timeout),
+        )
+        # Incident flight recorder (docs/advanced-guide/
+        # incident-debugging.md): GET lists this process's black-box
+        # bundles + recorder state (the router fans it fleet-wide);
+        # POST replays a flight record. The replay gets its own timeout
+        # budget — it re-decodes the recorded emission on the serving
+        # chips, which the API-SLO REQUEST_TIMEOUT must not bound.
+        # Loopback-only unless GOFR_REPLAY_REMOTE=1.
+        # The front router binds its FLEET-FAN variant to this path at
+        # build time; the per-process built-in must yield to it (the
+        # well-known block runs late, at serve()).
+        if not self.router.has("GET", "/.well-known/debug/blackbox"):
+            self.get("/.well-known/debug/blackbox", debug_blackbox_handler)
+        self._add(
+            "POST", "/.well-known/debug/replay", replay_handler,
             timeout_s=max(120.0, self.request_timeout),
         )
         self.router.add("GET", "/favicon.ico", favicon_wire_handler)

@@ -102,6 +102,75 @@ def profile_command(ctx: Context) -> str:
     )
 
 
+def replay_command(ctx: Context) -> str:
+    """Built-in `replay` subcommand: the record/replay loop's CLI face
+    (gofr_tpu.flightrec; docs/advanced-guide/incident-debugging.md).
+
+    ``replay -id=N`` POSTs the serving process's loopback-only
+    /.well-known/debug/replay route — the engine re-executes flight
+    record N with pinned version/adapter/grammar/seed and reports the
+    first-divergence token index vs the recorded emission. Flags:
+    -id=N (required), -url=http://127.0.0.1:9100 (default), -model=NAME
+    (searches all models when omitted), -timeout=SECONDS (default 120).
+
+    ``replay -bundle=DIR`` instead lists the flight records inside a
+    black-box bundle directory on disk — the "which id do I replay"
+    step of the incident runbook."""
+    import json as _json
+    import os as _os_mod
+    import urllib.request
+
+    bundle = ctx.param("bundle")
+    if bundle:
+        path = _os_mod.path.join(bundle, "flight_records.json")
+        with open(path) as f:
+            records = _json.load(f)
+        lines = [f"{len(records)} flight record(s) in {bundle}:"]
+        for r in records:
+            lines.append(
+                f"  id={r.get('id')} model={r.get('model')}"
+                f"@{r.get('model_version')} "
+                f"prompt={r.get('prompt_len')} "
+                f"emitted={r.get('emitted_len')} "
+                f"finish={r.get('finish_reason')} "
+                f"{'final' if r.get('final') else 'IN-FLIGHT'}"
+                f"{' redacted' if r.get('redacted') else ''}"
+            )
+        return "\n".join(lines)
+    rid = ctx.param("id")
+    if not rid:
+        raise ValueError("replay needs -id=N (or -bundle=DIR to list one)")
+    url = ctx.param("url") or "http://127.0.0.1:9100"
+    body: dict[str, Any] = {"id": int(rid)}
+    if ctx.param("model"):
+        body["model"] = ctx.param("model")
+    timeout = float(ctx.param("timeout") or 120.0)
+    body["timeout"] = timeout
+    req = urllib.request.Request(
+        f"{url}/.well-known/debug/replay",
+        data=_json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=timeout + 30.0) as resp:
+        out = _json.loads(resp.read())
+    data = out.get("data", out) if isinstance(out, dict) else {}
+    rep = data.get("replay", {})
+    if rep.get("error"):
+        raise ValueError(f"replay failed: {rep['error']}")
+    div = rep.get("first_divergence")
+    verdict = (
+        "token-identical" if rep.get("match")
+        else f"DIVERGED at token index {div}"
+    )
+    return (
+        f"replay id={rep.get('id')} model={data.get('model')}"
+        f"@{rep.get('model_version')} {verdict} "
+        f"(recorded {rep.get('recorded_len')} tokens, replayed "
+        f"{rep.get('replayed_len')}, {rep.get('replay_ms')} ms)"
+    )
+
+
 class CMDApp:
     """App without servers; run() dispatches one subcommand (cmd.go:27-52)."""
 
@@ -119,6 +188,11 @@ class CMDApp:
             re.compile(r"profile\Z"),
             profile_command,
             "capture a device profile (-seconds=N -dir=PATH -out=FILE.zip)",
+        ), (
+            re.compile(r"replay\Z"),
+            replay_command,
+            "deterministically replay a flight record "
+            "(-id=N [-url=... -model=... -timeout=S] | -bundle=DIR)",
         )]
 
     def sub_command(self, pattern: str, handler: Callable, description: str = "") -> None:

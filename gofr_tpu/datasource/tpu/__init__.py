@@ -295,6 +295,17 @@ class TPURuntime:
         # tensor-parallel over a submesh of that many chips;
         # TPU_LLM_DISAGG splits the fleet into prefill/decode role pools
         # with device-to-device KV handoff
+        # incident flight recorder knobs (gofr_tpu.flightrec; "" =
+        # engine defaults, which read the same names as process env
+        # vars) — docs/advanced-guide/incident-debugging.md
+        self.default_llm_flight_records = get("TPU_LLM_FLIGHT_RECORDS", "")
+        self.default_llm_flight_redact = get("TPU_LLM_FLIGHT_REDACT", "")
+        self.default_llm_blackbox_dir = get("GOFR_BLACKBOX_DIR", "")
+        self.default_llm_blackbox_interval = get(
+            "GOFR_BLACKBOX_INTERVAL_S", ""
+        )
+        self.default_llm_anomaly = get("TPU_LLM_ANOMALY", "")
+        self.default_llm_wide_sample = get("TPU_LLM_WIDE_EVENT_SAMPLE", "")
         self.default_llm_tp = get("TPU_LLM_TP", "")
         self.default_llm_disagg = get("TPU_LLM_DISAGG", "")
         self.default_llm_disagg_prefill = get(
@@ -589,6 +600,35 @@ class TPURuntime:
         if self.default_llm_host_cache_mb != "":
             engine_kw.setdefault(
                 "host_cache_mb", float(self.default_llm_host_cache_mb)
+            )
+        # incident flight recorder (docs/advanced-guide/
+        # incident-debugging.md): record-ring size/redaction, black-box
+        # bundle directory + per-trigger rate limit, perf-anomaly gate,
+        # wide-event sampling factor
+        if self.default_llm_flight_records != "":
+            engine_kw.setdefault(
+                "flight_records", int(self.default_llm_flight_records)
+            )
+        if self.default_llm_flight_redact != "":
+            engine_kw.setdefault(
+                "flight_redact", self.default_llm_flight_redact != "0"
+            )
+        if self.default_llm_blackbox_dir != "":
+            engine_kw.setdefault(
+                "blackbox_dir", self.default_llm_blackbox_dir
+            )
+        if self.default_llm_blackbox_interval != "":
+            engine_kw.setdefault(
+                "blackbox_interval_s",
+                float(self.default_llm_blackbox_interval),
+            )
+        if self.default_llm_anomaly != "":
+            engine_kw.setdefault(
+                "anomaly", self.default_llm_anomaly != "0"
+            )
+        if self.default_llm_wide_sample != "":
+            engine_kw.setdefault(
+                "wide_event_sample", int(self.default_llm_wide_sample)
             )
         engine_kw.setdefault("kv_label", name)  # metric-series label
         engine_kw.setdefault("tracer", self.tracer)  # lifecycle spans

@@ -280,6 +280,21 @@ def _serving_summary(container, llms) -> dict:
             "throughput_tok_s": tput or None,
             "predicted_wait_s": wait,
         }
+    # degraded-backend signals (gofr_tpu.flightrec): when this process
+    # last wrote an incident bundle, and which perf signals are
+    # currently anomaly-flagged — the fleet view reads degradation from
+    # the summary poll instead of fetching every backend's debug_state
+    last_incident_ts = None
+    flagged: set[str] = set()
+    for handle in llms.values():
+        eng = getattr(handle, "engine", handle)
+        for rep in getattr(eng, "engines", None) or [eng]:
+            bb = getattr(rep, "blackbox", None)
+            if bb is not None and bb.last_ts is not None:
+                last_incident_ts = max(last_incident_ts or 0.0, bb.last_ts)
+            an = getattr(rep, "anomaly", None)
+            if an is not None:
+                flagged.update(an.flagged())
     draining = bool(getattr(container, "draining", False))
     return {
         "draining": draining,
@@ -288,6 +303,8 @@ def _serving_summary(container, llms) -> dict:
         "predicted_wait_s": (
             total_load / total_tput if total_tput > 1e-9 else None
         ),
+        "last_incident_ts": last_incident_ts,
+        "anomaly": sorted(flagged),
         "models": models,
     }
 
@@ -476,6 +493,82 @@ def rollout_handler(ctx: Context) -> Any:
         cfg, params, version=str(version) if version else None, **kw
     )
     return {"model": name, "rollout": snap}
+
+
+def debug_blackbox_handler(ctx: Context) -> Any:
+    """GET /.well-known/debug/blackbox — this process's incident view
+    (gofr_tpu.flightrec; docs/advanced-guide/incident-debugging.md):
+    completed bundle manifests (newest first, deduped across replicas
+    sharing one GOFR_BLACKBOX_DIR) plus per-engine recorder state. The
+    front router fans this route over the fleet the same way it fans
+    the journey query. Read-only and bounded."""
+    rt = ctx.container.tpu_runtime  # never construct: inspect what runs
+    llms = getattr(rt, "_llms", {}) if rt is not None else {}
+    bundles: dict[str, dict] = {}
+    recorders: dict[str, dict] = {}
+    for handle in llms.values():
+        eng = getattr(handle, "engine", handle)
+        for rep in getattr(eng, "engines", None) or [eng]:
+            bb = getattr(rep, "blackbox", None)
+            if bb is None:
+                continue
+            for m in bb.listing():
+                bundles.setdefault(m.get("bundle") or m.get("path", ""), m)
+            fr = getattr(rep, "flightrec", None)
+            an = getattr(rep, "anomaly", None)
+            recorders[rep.label] = {
+                "directory": bb.directory or None,
+                "enabled": bb.enabled(),
+                "last_trigger": bb.last_trigger,
+                "last_ts": bb.last_ts,
+                "rate_limited": bb.rate_limited,
+                "flight_records": len(fr) if fr is not None else 0,
+                "anomaly": an.flagged() if an is not None else [],
+            }
+    out = sorted(
+        bundles.values(), key=lambda m: m.get("ts") or 0, reverse=True
+    )
+    return {"bundles": out, "count": len(out), "recorders": recorders}
+
+
+def replay_handler(ctx: Context) -> Any:
+    """POST /.well-known/debug/replay — deterministically re-execute a
+    flight record and report the first-divergence token index vs the
+    recorded emission (gofr_tpu.flightrec). Body: ``{"id": <record id>,
+    "model": <llm name> (optional — all models searched when omitted),
+    "timeout": seconds (optional)}``. Loopback-only unless
+    GOFR_REPLAY_REMOTE=1: a replay decodes real tokens on the serving
+    chips, which is a resource-consumption surface an exposed port must
+    not hand to strangers."""
+    from .http.errors import ErrorEntityNotFound, ErrorInvalidParam
+
+    _require_loopback(ctx, "GOFR_REPLAY_REMOTE")
+    body = ctx.bind() or {}
+    try:
+        rid = int(body.get("id"))
+    except (TypeError, ValueError):
+        raise ErrorInvalidParam("id") from None
+    try:
+        timeout = float(body.get("timeout") or 120.0)
+    except (TypeError, ValueError):
+        raise ErrorInvalidParam("timeout") from None
+    rt = ctx.container.tpu_runtime  # never construct: replay what runs
+    llms = getattr(rt, "_llms", {}) if rt is not None else {}
+    name = body.get("model")
+    if name:
+        if name not in llms:
+            raise ErrorEntityNotFound("llm", str(name))
+        targets = {name: llms[name]}
+    else:
+        targets = llms
+    from .flightrec import find_record
+
+    for model, handle in targets.items():
+        eng = getattr(handle, "engine", handle)
+        rec, _owner = find_record(eng, rid)
+        if rec is not None:
+            return {"model": model, "replay": eng.replay(rid, timeout=timeout)}
+    raise ErrorEntityNotFound("flight_record", str(rid))
 
 
 async def favicon_wire_handler(_req: Request) -> Response:

@@ -78,6 +78,14 @@ class Router:
             raise RuntimeError("cannot add middleware after server start")
         self._middleware.append(mw)
 
+    def has(self, method: str, template: str) -> bool:
+        """Is a handler already bound to this exact static route? Lets
+        late built-in registration yield to an earlier explicit binding
+        (the front router rebinds a well-known path to its fleet-fan
+        variant before serve())."""
+        template = "/" + template.strip("/") if template.strip("/") else "/"
+        return (method.upper(), template) in self._static
+
     def add(self, method: str, template: str, handler: WireHandler) -> None:
         if self._built:
             raise RuntimeError("cannot add routes after server start")

@@ -663,6 +663,12 @@ class LLMEngine:
         version: str = "v1",
         slo=None,
         slo_tenants: dict | None = None,
+        flight_records: int | None = None,
+        flight_redact: bool | None = None,
+        blackbox_dir: str | None = None,
+        blackbox_interval_s: float | None = None,
+        anomaly: bool | None = None,
+        wide_event_sample: int | None = None,
     ):
         import jax
         import jax.numpy as jnp
@@ -946,6 +952,57 @@ class LLMEngine:
         self._registry = default_registry()
         self.warmup_s: float | None = None
         self._wide_events: list[dict] = []  # appended under _lock, drained outside
+        # -- incident flight recorder (gofr_tpu.flightrec; docs/advanced-
+        # guide/incident-debugging.md) -----------------------------------
+        # Per-request black-box ring (started at submit, finalized on
+        # every terminal path incl. _die), an incident bundle dumper
+        # (inert unless GOFR_BLACKBOX_DIR / blackbox_dir is set), and
+        # rolling-baseline perf-anomaly detectors whose flag transitions
+        # are themselves bundle triggers.
+        from .flightrec import (
+            WIDE_EVENTS_KEEP,
+            AnomalyDetector,
+            BlackboxDumper,
+            FlightRecorder,
+        )
+
+        self.flightrec = FlightRecorder(flight_records, redact=flight_redact)
+        self.blackbox = BlackboxDumper(
+            blackbox_dir, min_interval_s=blackbox_interval_s,
+            logger=logger, metrics=metrics, label=self.label,
+        )
+        if self.slo is not None:
+            # the fast-burn 0 -> 1 flip is a bundle trigger: capture the
+            # engine while the budget-burning requests are still visible
+            self.slo.on_fast_burn = lambda: self._incident(
+                "slo_fast_burn",
+                reason=f"error-budget fast burn tripped on {self.label}",
+            )
+        if anomaly is None:
+            anomaly = _os.environ.get("TPU_LLM_ANOMALY", "1") not in ("", "0")
+        self.anomaly = None
+        if anomaly:
+            self.anomaly = AnomalyDetector(
+                metrics, self.label,
+                on_flag=lambda sig, val, mean: self._incident(
+                    "anomaly",
+                    reason=(
+                        f"{sig} sustained deviant: {val:.3f} vs baseline "
+                        f"mean {mean:.3f}"
+                    ),
+                ),
+            )
+        # wide-event sampling (satellite of the flight recorder): 1-in-N
+        # request lines under load — incident/error/failover lines always
+        # emit. The FULL stream lands in _wide_retained either way, so a
+        # bundle's wide-event section never has sampling holes.
+        if wide_event_sample is None:
+            wide_event_sample = int(
+                _os.environ.get("TPU_LLM_WIDE_EVENT_SAMPLE", "") or 1
+            )
+        self._wide_sample = max(1, int(wide_event_sample))
+        self._wide_seq = 0
+        self._wide_retained: deque = deque(maxlen=WIDE_EVENTS_KEEP)
         # KV layout/residency/reuse policy lives in the kvcache subsystem:
         # rolling ring for sliding-window models (slot memory O(window)),
         # dense slab otherwise; optional prompt-prefix reuse at admission.
@@ -2570,6 +2627,10 @@ class LLMEngine:
             # client returning from idle starts at the active floor, not
             # at whatever stale credit its old counter banked
             self.ledger.touch(req.client)
+        # flight record: capture the re-execution inputs NOW, so an
+        # in-flight request is already replayable when the engine dies
+        # (a failover continuation re-records its continuation prompt)
+        self.flightrec.start(req, self)
         self._admit_q.put(req)
         # TOCTOU with _die()/close(): if the engine stopped between the
         # _stop check above and this put, its one-shot drain may already
@@ -2787,6 +2848,165 @@ class LLMEngine:
             "shed": self.shed,
             "kvcache": self.kv.stats(),
         }
+
+    # -- incident flight recorder (gofr_tpu.flightrec; docs/advanced-
+    # guide/incident-debugging.md) ----------------------------------------
+
+    def _inflight_requests(self) -> list[GenRequest]:
+        """Racy, lock-free sweep of every live request — slotted, riding
+        a device snapshot, prefilling, or waiting. Runs on the incident
+        path where the engine lock may be wedged under a hung device
+        call: a torn read (one request too many) beats a bundle dump
+        that blocks behind the very hang it is documenting."""
+        out: list[GenRequest] = []
+        seen: set[int] = set()
+
+        def take(r: GenRequest | None) -> None:
+            if r is not None and r.id not in seen:
+                seen.add(r.id)
+                out.append(r)
+
+        for r in list(self._slot_req):
+            take(r)
+        entries = list(self._inflight)
+        proc = self._processing
+        if proc is not None:
+            entries.append(proc)
+        for e in entries:
+            try:
+                for r in self._entry_requests(e):
+                    take(r)
+            except Exception:  # noqa: BLE001 — racy sweep, entries may be torn
+                continue
+        for r in list(self._prefilling):
+            take(r)
+        for r in list(self._waiting):
+            take(r)
+        return out
+
+    def _hbm_samples(self) -> list[dict]:
+        """Per-device HBM occupancy for the bundle (the telemetry
+        poller's sample shape, taken inline — the poller may be off)."""
+        import jax
+
+        out = []
+        for d in jax.devices():
+            try:
+                stats = d.memory_stats() or {}
+            except Exception:  # noqa: BLE001 — backends without memory_stats
+                stats = {}
+            out.append({
+                "device": d.id,
+                "platform": getattr(d, "platform", ""),
+                "kind": getattr(d, "device_kind", ""),
+                "bytes_in_use": stats.get("bytes_in_use"),
+                "bytes_limit": stats.get("bytes_limit"),
+                "peak_bytes_in_use": stats.get("peak_bytes_in_use"),
+            })
+        return out
+
+    def _config_fingerprint(self) -> dict:
+        """The engine's serving shape plus a content hash: 'is the
+        replay host configured like the incident host' is the first
+        question a post-mortem asks, and diffing two fingerprints
+        answers it without eyeballing forty knobs."""
+        import hashlib as _hashlib
+        import json as _json
+
+        shape = {
+            "model": self.label,
+            "version": self.version,
+            "role": self.role,
+            "slots": self.slots,
+            "max_seq_len": self.max_seq_len,
+            "decode_chunk": self.decode_chunk,
+            "chunked": self.chunked,
+            "speculative": self.speculative,
+            "spec_draft": self.spec_draft,
+            "constrained": self.constrained,
+            "lora_slots": self.lora_slots,
+            "quantized": self.quantized,
+            "kv_paged": self.kv.paged,
+            "kv_window": self.kv.window,
+            "tp_degree": self.tp_degree,
+            "flight_records": self.flightrec.capacity,
+            "flight_redact": self.flightrec.redact,
+            "wide_event_sample": self._wide_sample,
+        }
+        shape["sha256"] = _hashlib.sha256(
+            _json.dumps(shape, sort_keys=True, default=repr).encode()
+        ).hexdigest()
+        return shape
+
+    def _incident(
+        self, trigger: str, *, reason: str = "", lock_timeout: float = 2.0
+    ) -> str | None:
+        """Dump one black-box bundle (gofr_tpu.flightrec.BlackboxDumper):
+        engine debug state, the trace ring, the retained wide events,
+        the compile registry, HBM occupancy, the config fingerprint, and
+        the flight records of everything in flight. Returns the bundle
+        path, or None when the dumper is unarmed or the trigger class is
+        inside its rate-limit window. Never raises — the incident path
+        must not add a second failure to the first."""
+        if not self.blackbox.enabled():
+            return None
+        try:
+            sections: dict[str, Any] = {}
+            # engine state under a BOUNDED acquire: the incident may BE a
+            # wedged device call that still holds the lock (RLock, so an
+            # under-lock caller like the SLO flip re-enters instantly)
+            if self._lock.acquire(timeout=lock_timeout):
+                try:
+                    sections["debug_state"] = self.debug_state()
+                finally:
+                    self._lock.release()
+            else:
+                sections["debug_state"] = {
+                    "lock_wedged": True,
+                    "died": self._died,
+                    "died_reason": self.died_reason,
+                }
+            ring = getattr(self.tracer, "ring", None) if self.tracer else None
+            if ring is not None:
+                sections["traces"] = {
+                    "stats": ring.stats(),
+                    "trace_ids": ring.trace_ids(64),
+                    "spans": ring.dump(512),
+                }
+            sections["wide_events"] = list(self._wide_retained)
+            sections["compiles"] = self._registry.snapshot(model=self.label)
+            sections["hbm"] = self._hbm_samples()
+            sections["config"] = self._config_fingerprint()
+            if self.anomaly is not None:
+                sections["anomaly"] = self.anomaly.snapshot()
+            records = self.flightrec.snapshot_inflight(self._inflight_requests())
+            records.extend(self.flightrec.records(limit=64, final=True))
+            return self.blackbox.dump(
+                trigger, reason=reason, sections=sections, records=records
+            )
+        except Exception as e:  # noqa: BLE001 — see docstring
+            if self.logger is not None:
+                self.logger.error(f"black-box bundle capture failed: {e!r}")
+            return None
+
+    def replay(self, record_or_id, *, timeout: float = 120.0) -> dict:
+        """Deterministically re-execute a recorded request with pinned
+        version/adapter/grammar/seed and report the first-divergence
+        token index vs the recorded emission (gofr_tpu.flightrec;
+        `replay` CLI subcommand / POST /.well-known/debug/replay)."""
+        from .flightrec import replay_record
+
+        rec = record_or_id
+        if not isinstance(rec, dict):
+            rec = self.flightrec.get(int(record_or_id))
+            if rec is None:
+                return {
+                    "id": record_or_id,
+                    "error": "no flight record with that id (ring holds "
+                             f"{len(self.flightrec)} of "
+                             f"{self.flightrec.capacity})",
+                }
+        return replay_record(self, rec, timeout=timeout)
 
     def _spec_summary(self) -> dict:
         """Speculative-decoding telemetry block for stats()/debug_state:
@@ -3330,6 +3550,10 @@ class LLMEngine:
         # engine starts on a clean error budget
         if self.slo is not None:
             self.slo.zero_gauges()
+        # same class: a dead engine must not hold an anomaly flag — the
+        # degraded-backend signal would outlive the backend
+        if self.anomaly is not None:
+            self.anomaly.zero_gauges()
 
     def _teardown_profiling(self) -> None:
         """Compile-observatory teardown (close() and _die()): drop this
@@ -3369,6 +3593,12 @@ class LLMEngine:
             # a closed replica must not pin the fleet ledger's
             # new-arrival floor with a stale waiting-client set
             self.ledger.set_active(self.label, set())
+        # flight-recorder teardown: no further bundles (the close()/_die()
+        # contract), and the record ring clears WITH the engine — unlike
+        # _die, where the ring outlives the death for post-mortems (the
+        # bundle was already dumped by then)
+        self.blackbox.close()
+        self.flightrec.clear()
         self.kv.close()  # drop retained prefix rows (device buffers)
 
     def _drain_pending(self) -> None:
@@ -5168,6 +5398,27 @@ class LLMEngine:
                 ttft_ms=None if ttft is None else ttft * 1e3,
                 tpot_ms=None if tpot is None else tpot * 1e3,
             )
+        # flight record: stamp the terminal outcome (timings, finish
+        # reason, emitted token ids) — every terminal path funnels here,
+        # so the ring never holds a dangling non-final record for a
+        # finished request
+        self.flightrec.finalize(
+            r,
+            queue_wait_ms=None if queue_wait is None else queue_wait * 1e3,
+            ttft_ms=None if ttft is None else ttft * 1e3,
+            per_token_ms=None if tpot is None else tpot * 1e3,
+            total_ms=None if total is None else total * 1e3,
+        )
+        # perf-anomaly baselines (flightrec): sustained deviation flags
+        # app_llm_anomaly and triggers a perf-incident bundle. The step
+        # and spec-acceptance signals feed from the scheduler loop.
+        if self.anomaly is not None:
+            if queue_wait is not None:
+                self.anomaly.observe("queue_wait", queue_wait * 1e3)
+            if ttft is not None:
+                self.anomaly.observe("ttft", ttft * 1e3)
+            if tpot is not None:
+                self.anomaly.observe("tpot", tpot * 1e3)
         if r.finish_reason == "disconnect":
             # dead-peer cancellation (edge detected a closed connection):
             # the slot is free and the remaining decode was never done —
@@ -5200,30 +5451,51 @@ class LLMEngine:
             r.span.end()
         if r.finish_reason in ("error", "poison"):
             self.errored += 1  # bake-window regression signal (rollouts)
+        ms = lambda v: None if v is None else round(v * 1e3, 3)  # noqa: E731
+        ev = {
+            "event": "llm_request",
+            "model": self.label,
+            "model_version": self.version,
+            "id": r.id,
+            "trace_id": r.span.trace_id if r.span is not None else "",
+            # journey identity: stable across failover/preemption
+            # hops (the trace id of the FIRST submit), plus which hop
+            # finished the work — `grep journey_id` over the fleet's
+            # logs reconstructs the same object the stitcher serves
+            "journey_id": r.journey_id or "",
+            "hop": r.hop,
+            "prompt_tokens": len(r.prompt_tokens),
+            "output_tokens": r.emitted,
+            "finish_reason": r.finish_reason,
+            "queue_wait_ms": ms(queue_wait),
+            "ttft_ms": ms(ttft),
+            "per_token_ms": ms(tpot),
+            "total_ms": ms(total),
+            "prefix_hit": r.prefix_hit,
+            "capped": r.capped,
+        }
+        # the FULL stream is retained for incident bundles regardless of
+        # sampling or logger presence — a bundle's last-N wide events
+        # must not have sampling holes
+        self._wide_retained.append(ev)
         if self.logger is not None:
-            ms = lambda v: None if v is None else round(v * 1e3, 3)  # noqa: E731
-            self._wide_events.append({
-                "event": "llm_request",
-                "model": self.label,
-                "model_version": self.version,
-                "id": r.id,
-                "trace_id": r.span.trace_id if r.span is not None else "",
-                # journey identity: stable across failover/preemption
-                # hops (the trace id of the FIRST submit), plus which hop
-                # finished the work — `grep journey_id` over the fleet's
-                # logs reconstructs the same object the stitcher serves
-                "journey_id": r.journey_id or "",
-                "hop": r.hop,
-                "prompt_tokens": len(r.prompt_tokens),
-                "output_tokens": r.emitted,
-                "finish_reason": r.finish_reason,
-                "queue_wait_ms": ms(queue_wait),
-                "ttft_ms": ms(ttft),
-                "per_token_ms": ms(tpot),
-                "total_ms": ms(total),
-                "prefix_hit": r.prefix_hit,
-                "capped": r.capped,
-            })
+            # 1-in-N sampling (TPU_LLM_WIDE_EVENT_SAMPLE): one JSON line
+            # per request is a real cost at the 1k QPS/chip target.
+            # Incident lines — anything that didn't finish eos/length,
+            # or that survived a death/hop — ALWAYS emit; sampled lines
+            # carry the factor so log-derived rates can re-scale.
+            self._wide_seq += 1
+            forced = (
+                r.finish_reason not in ("eos", "length")
+                or r.deaths > 0
+                or r.hop > 0
+            )
+            if self._wide_sample <= 1:
+                self._wide_events.append(ev)
+            elif forced:
+                self._wide_events.append({**ev, "sample": 1})
+            elif self._wide_seq % self._wide_sample == 0:
+                self._wide_events.append({**ev, "sample": self._wide_sample})
 
     def _flush_wide_events(self) -> None:
         """Emit queued wide-event lines. Called with the lock NOT held."""
@@ -6115,6 +6387,8 @@ class LLMEngine:
             step_s,
         )
         self._phases["step"].observe(step_s)
+        if self.anomaly is not None:
+            self.anomaly.observe("step", step_s * 1e3)
         if self.metrics is not None:
             self.metrics.record_histogram(
                 "app_llm_step_seconds", step_s, model=self.label,
@@ -6243,6 +6517,16 @@ class LLMEngine:
         self.spec_accepted_c += accepted_c
         self._observe_tput(emitted_total, dt)
         self._phases["step"].observe(dt)
+        if self.anomaly is not None:
+            self.anomaly.observe("step", dt * 1e3)
+            # per-STEP acceptance (not the cumulative gauge — a drift
+            # detector needs the instantaneous rate): accepted over the
+            # positions this verify actually proposed (ys is [S, W],
+            # W-1 drafts + 1 bonus per selected lane)
+            self.anomaly.observe(
+                "spec_accept",
+                accepted_total / max(1, len(sel) * (ys.shape[1] - 1)),
+            )
         # per-token cadence the accepted spans actually delivered
         per_tok = dt / max(1.0, emitted_total / max(1, len(sel)))
         self._phases["decode_step"].observe(per_tok)
@@ -6481,6 +6765,18 @@ class LLMEngine:
         self._fail_sched_work()  # pending handoff work cannot run now
         if self.logger is not None:
             self.logger.error(f"LLM engine died: {why}")
+        # black-box bundle FIRST, while the corpse is still warm — the
+        # rescue/drain below mutates the very state the bundle captures
+        # (slots empty, gauges zero, requests re-homed). The reason
+        # prefix classifies the trigger: watchdog/numerical/poison trips
+        # each rate-limit independently of generic engine deaths.
+        from .flightrec import classify_die_reason
+
+        self._incident(
+            classify_die_reason(why), reason=why,
+            lock_timeout=2.0 if lock_timeout is None
+            else min(2.0, lock_timeout),
+        )
         if lock_timeout is None:
             acquired = self._lock.acquire()
         else:
@@ -6504,6 +6800,10 @@ class LLMEngine:
             )
         self._zero_state_gauges()
         self._teardown_profiling()
+        # the bundle above was this engine's LAST dump: a dead engine
+        # must not write further bundles. The record ring deliberately
+        # survives (unlike close()) — it is the post-mortem's evidence.
+        self.blackbox.close()
         try:
             # a dead engine's pool/radix/session bookkeeping (and its
             # resident-bytes gauges) must not survive it — same contract
@@ -7027,6 +7327,13 @@ class ReplicatedLLMEngine:
             raise first_err
         self.engines = engines
         self._observe_versions()
+        # incident seam (gofr_tpu.flightrec): a quarantine trip dumps a
+        # black-box bundle from a live replica — the dying replica's own
+        # _die bundle captures ITS corpse, this one captures the fleet
+        # context (ledger state, which device, surviving capacity)
+        self.health.on_quarantine = lambda device, why: self.incident(
+            "quarantine", reason=f"device {device} quarantined ({why})"
+        )
         self.supervisor = None
         if supervise:
             from .resilience import ReplicaSupervisor
@@ -7909,6 +8216,42 @@ class ReplicatedLLMEngine:
             e.slo.snapshot() for e in self.engines if e.slo is not None
         ]
         return pool_snapshots(snaps) or None
+
+    # -- incident flight recorder (gofr_tpu.flightrec; docs/advanced-
+    # guide/incident-debugging.md) ----------------------------------------
+    def incident(self, trigger: str, *, reason: str = "") -> str | None:
+        """Dump one black-box bundle from the first live replica —
+        fleet-level triggers (quarantine, rollout rollback) need a
+        witness that still has state; a dying replica dumps its own
+        bundle from _die before this could reach it."""
+        for e in self.engines:
+            if e.alive():
+                return e._incident(trigger, reason=reason)
+        return None
+
+    def replay(self, record_or_id, *, timeout: float = 120.0) -> dict:
+        """Deterministic replay across the fleet: locate the flight
+        record on any replica (dead ones keep their rings for exactly
+        this), then re-execute on a live replica pinned to the record's
+        model version — cross-version replays compare nothing."""
+        from .flightrec import find_record, replay_record
+
+        rec = record_or_id
+        if not isinstance(rec, dict):
+            rec, _owner = find_record(self, int(record_or_id))
+            if rec is None:
+                return {
+                    "id": record_or_id,
+                    "error": "no flight record with that id on any replica",
+                }
+        want = rec.get("model_version")
+        for e in self.engines:
+            if e.alive() and (not want or e.version == want):
+                return replay_record(e, rec, timeout=timeout)
+        return {
+            "id": rec.get("id"),
+            "error": f"no live replica serves version {want!r} for replay",
+        }
 
     def drain(self) -> None:
         """Fleet drain: stop the supervisor from rebuilding (the process

@@ -188,6 +188,12 @@ class SLOTracker:
         }
         self._good = 0
         self._total = 0
+        # incident seam (gofr_tpu.flightrec): fired once per 0 -> 1
+        # fast-burn transition — the flip is the moment the evidence
+        # (which requests burned the budget, what the engine looked
+        # like) is still live, so it triggers a black-box bundle.
+        self.on_fast_burn = None
+        self._fast_burn_prev = False
         if metrics is not None:
             register_slo_metrics(metrics)
 
@@ -250,16 +256,24 @@ class SLOTracker:
             m.set_gauge(
                 "app_llm_slo_burn_rate", rate, model=self.label, window=name
             )
+        fast = self.fast_burn()
         m.set_gauge(
-            "app_llm_slo_fast_burn",
-            1.0 if self.fast_burn() else 0.0,
-            model=self.label,
+            "app_llm_slo_fast_burn", 1.0 if fast else 0.0, model=self.label
         )
+        flipped, self._fast_burn_prev = (
+            fast and not self._fast_burn_prev, fast
+        )
+        if flipped and self.on_fast_burn is not None:
+            try:
+                self.on_fast_burn()
+            except Exception:  # noqa: BLE001 — incident capture is best-effort
+                pass
 
     def zero_gauges(self) -> None:
         """close()/_die() path: a dead engine's burn state must read 0 —
         the dead-engine-gauge regression class. Windows clear too, so a
         restarted engine starts with a clean budget."""
+        self._fast_burn_prev = False
         for w in self._windows.values():
             w.clear()
         m = self.metrics

@@ -156,6 +156,12 @@ class DeviceHealthLedger:
         self.logger = logger
         self.quarantines = 0  # total trips (counter twin)
         self._lock = threading.Lock()
+        # incident seam (gofr_tpu.flightrec): ReplicatedLLMEngine points
+        # this at a live replica's black-box dump — a quarantine trip is
+        # a bundle trigger, and the evidence (which device, which
+        # failure mix) must be captured while the fleet still has it.
+        # Called OUTSIDE the ledger lock; exceptions are swallowed.
+        self.on_quarantine = None
         # per-device: {"events": [(t, reason)], "state": str, "until": t,
         #              "cooldown": s, "trips": n, "by_reason": {r: n}}
         self._devices: dict[str, dict] = {}
@@ -224,6 +230,11 @@ class DeviceHealthLedger:
                 self.metrics.increment_counter(
                     "app_llm_device_quarantines_total", model=self.model
                 )
+            if self.on_quarantine is not None:
+                try:
+                    self.on_quarantine(device, f"{reason}: {detail or 'n/a'}")
+                except Exception:  # noqa: BLE001 — incident capture is best-effort
+                    pass
         self._observe_gauge()
         return tripped
 

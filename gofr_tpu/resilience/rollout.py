@@ -522,6 +522,17 @@ class RolloutController(_RolloutBase):
         versions in routing."""
         fleet = self.fleet
         self._set_state("rolling_back")
+        # incident seam (gofr_tpu.flightrec): a rollback means the new
+        # version FAILED in production — capture the fleet (bake-window
+        # counters, canary verdicts, per-version requests) before the
+        # converge below rebuilds the evidence away
+        incident = getattr(fleet, "incident", None)
+        if incident is not None:
+            incident(
+                "rollback",
+                reason=f"rolling back {self.to_version} -> "
+                       f"{self.from_version}: {self.error or 'gate failed'}",
+            )
         try:
             for i in range(len(fleet.engines)):
                 if self._stop or fleet._draining:

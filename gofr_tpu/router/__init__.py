@@ -223,6 +223,10 @@ class FrontRouter:
                 "app_router_journey_queries_total",
                 "fleet journey stitches by outcome (ok|partial|empty)",
             )
+            metrics.new_counter(
+                "app_router_blackbox_queries_total",
+                "fleet black-box listings by outcome (ok|partial|empty)",
+            )
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> None:
@@ -605,6 +609,62 @@ def journey_handler(ctx):
     }
 
 
+def blackbox_fleet_handler(ctx):
+    """GET /.well-known/debug/blackbox — the fleet incident view: fan
+    the listing over every backend's own blackbox route (each process
+    lists only the bundles IT can see) and merge, newest first. A fleet
+    operator asks ONE place "what incidents happened and where is the
+    evidence" — the journey stitcher's shape applied to crash bundles.
+    Backends that can't answer (down, breaker open — often the very
+    incident being investigated) are partial data, not a failure."""
+    fr = getattr(ctx.container, "front_router", None)
+    bundles: dict[str, dict] = {}
+    recorders: dict[str, dict] = {}
+    polled: list[dict] = []
+    failures = 0
+    if fr is not None:
+        cfg = ctx.container.config
+        try:
+            timeout = cfg.get_float("TPU_ROUTER_JOURNEY_TIMEOUT_S", 5.0)
+        except Exception:  # noqa: BLE001 — malformed config -> default
+            timeout = 5.0
+        for b in fr.fleet.backends():
+            try:
+                out = b.svc.request(
+                    "GET", "/.well-known/debug/blackbox", timeout=timeout,
+                ).json()
+            except Exception as e:  # noqa: BLE001 — a dead shard is partial data
+                failures += 1
+                polled.append({
+                    "address": b.address, "ok": False, "error": repr(e),
+                })
+                continue
+            frag = out.get("data", out) if isinstance(out, dict) else {}
+            got = frag.get("bundles") or []
+            for m in got:
+                if isinstance(m, dict):
+                    key = m.get("bundle") or m.get("path", "")
+                    bundles.setdefault(key, {**m, "backend": b.address})
+            for label, rec in (frag.get("recorders") or {}).items():
+                recorders[f"{b.address}:{label}"] = rec
+            polled.append({
+                "address": b.address, "ok": True, "bundles": len(got),
+            })
+        outcome = (
+            "empty" if not bundles else ("partial" if failures else "ok")
+        )
+        fr._count("app_router_blackbox_queries_total", outcome=outcome)
+    merged = sorted(
+        bundles.values(), key=lambda m: m.get("ts") or 0, reverse=True
+    )
+    return {
+        "bundles": merged,
+        "count": len(merged),
+        "recorders": recorders,
+        "backends": polled,
+    }
+
+
 def router_debug_handler(ctx):
     """GET /.well-known/router — the live fleet view: per-backend
     health/load/breaker state, ring membership, admission + autoscaler
@@ -642,6 +702,9 @@ def new_router_app(config=None, *, configs_dir: str = "./configs"):
     # the fleet stitcher (docs/advanced-guide/observability-serving.md):
     # registered ahead of the catch-all so it answers from THIS process
     app.get("/.well-known/debug/journey", journey_handler)
+    # the fleet incident listing (docs/advanced-guide/
+    # incident-debugging.md): same precedence rule as the stitcher
+    app.get("/.well-known/debug/blackbox", blackbox_fleet_handler)
     # HEAD rides along so LB health probes / curl -I against proxied
     # paths answer like direct engine access would; OPTIONS needs no
     # route — the CORS middleware short-circuits every preflight

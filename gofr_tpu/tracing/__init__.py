@@ -330,6 +330,15 @@ class RingExporter:
         out = list(seen.values())[::-1]
         return out[: max(0, int(limit))]
 
+    def dump(self, limit: int = 512) -> list[dict]:
+        """Last `limit` finished spans, oldest -> newest: the trace
+        section of a black-box incident bundle (gofr_tpu.flightrec) —
+        the raw material a post-mortem re-stitches journeys from after
+        the process that held the ring is gone."""
+        with self._lock:
+            spans = list(self._spans)
+        return spans[-max(0, int(limit)):]
+
     def clear(self) -> int:
         """Flush the ring (shutdown path — the dead-engine-gauge rule:
         no stale journey fragments survive the process's serving life)."""

@@ -1,0 +1,268 @@
+#!/usr/bin/env python
+"""CI incident flight-recorder smoke: black-box bundles, deterministic
+replay, and the fleet debug fan over real sockets
+(docs/advanced-guide/incident-debugging.md).
+
+Boots a front router over a 2-replica engine app armed with a fault
+injector and a tight step watchdog, then drives the incident loop an
+operator would:
+
+- warm traffic populates both replicas' flight-record rings,
+- an injected device hang mid-stream trips the step watchdog, the
+  victim replica dies, and a complete black-box bundle lands under
+  GOFR_BLACKBOX_DIR — manifest, debug_state, config fingerprint, wide
+  events, and the flight records INCLUDING the still-in-flight stream,
+- the hung stream itself fails over and finishes token-identical to an
+  unfaulted single-engine run,
+- a finished record pulled FROM THE BUNDLE replays byte-identical on
+  the surviving replica via POST /.well-known/debug/replay and via the
+  `replay` CLI subcommand (both the -bundle listing and -id modes),
+- app_blackbox_bundles_total{trigger="watchdog"} shows on /metrics,
+- the router's GET /.well-known/debug/blackbox fans the fleet and
+  serves the bundle manifest plus per-recorder state.
+
+Usage: JAX_PLATFORMS=cpu python scripts/smoke_blackbox.py
+Exit codes: 0 clean, non-zero assertion failure (message on stderr).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import io
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# two virtual CPU devices for the 2-replica fleet — BEFORE jax import
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=2"
+    ).strip()
+
+
+def _wait(pred, timeout: float, what: str) -> None:
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def _get(base: str, path: str, timeout=30):
+    with urllib.request.urlopen(f"{base}{path}", timeout=timeout) as r:
+        return r.read().decode()
+
+
+def _post(base: str, path: str, payload: dict, timeout=120):
+    req = urllib.request.Request(
+        f"{base}{path}", data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"}, method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read())["data"]
+
+
+def main() -> int:
+    import jax
+
+    from gofr_tpu import App
+    from gofr_tpu.cmd import CMDApp
+    from gofr_tpu.config import new_mock_config
+    from gofr_tpu.handler import llm_request_kwargs
+    from gofr_tpu.llm import LLMEngine
+    from gofr_tpu.models import TransformerConfig, init_params
+    from gofr_tpu.resilience import FaultInjector
+    from gofr_tpu.router import new_router_app
+
+    cfg = TransformerConfig.tiny()
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    assert len(jax.devices()) >= 2, jax.devices()
+    inj = FaultInjector()
+    bbdir = tempfile.mkdtemp(prefix="blackbox-smoke-")
+
+    app = App(config=new_mock_config({
+        "APP_NAME": "engines", "HTTP_PORT": "0", "METRICS_PORT": "0",
+        "LOG_LEVEL": "ERROR", "TPU_TELEMETRY_INTERVAL_S": "0",
+        "REQUEST_TIMEOUT": "120",
+    }))
+    # warmup=True: the dispatch heartbeat covers lazy compiles, and a
+    # cold compile longer than the watchdog threshold would false-trip
+    app.container.tpu().register_llm(
+        "tiny", cfg, params, max_seq_len=128, prefill_buckets=(8,),
+        prefill_chunk=4, step_token_budget=4, decode_chunk=2, lookahead=1,
+        replicas=2, fault_injector=inj, warmup=True,
+        step_watchdog_s=1.0, blackbox_dir=bbdir,
+    )
+
+    def gen(ctx):
+        body = ctx.bind()
+        out = ctx.tpu().llm("tiny").generate(
+            list(body["tokens"]),
+            max_new_tokens=int(body.get("max_new_tokens", 4)),
+            **llm_request_kwargs(ctx),
+        )
+        return {"tokens": out}
+
+    app.post("/generate", gen)
+    app.run_in_background()
+
+    router = new_router_app(config=new_mock_config({
+        "APP_NAME": "router", "HTTP_PORT": "0", "METRICS_PORT": "0",
+        "LOG_LEVEL": "ERROR", "REQUEST_TIMEOUT": "60",
+        "TPU_ROUTER_BACKENDS":
+            f"http://127.0.0.1:{app.http_server.port}",
+        "TPU_ROUTER_POLL_INTERVAL_S": "0.1",
+    }))
+    router.run_in_background()
+
+    base = f"http://127.0.0.1:{app.http_server.port}"
+    mbase = f"http://127.0.0.1:{app.metrics_server.port}"
+    rbase = f"http://127.0.0.1:{router.http_server.port}"
+    prompt = list(range(1, 25))  # 24 tokens -> 6 prefill chunks
+    try:
+        _wait(lambda: len(router.front_router.fleet.accepting()) == 1,
+              15, "router sees the backend")
+
+        # ------------------------------------------------- warm traffic
+        # populate BOTH replicas' rings so the eventual victim holds
+        # finished, replayable records when it dies
+        warm = [_post(base, "/generate",
+                      {"tokens": prompt, "max_new_tokens": 6})["tokens"]
+                for _ in range(6)]
+        assert all(len(t) == 6 for t in warm), warm
+
+        # unfaulted reference for the failover-identity check
+        mono = LLMEngine(
+            cfg, params, slots=2, max_seq_len=128, prefill_buckets=(8,),
+            prefill_chunk=4, step_token_budget=4, decode_chunk=2,
+            warmup=False,
+        )
+        try:
+            want = mono.generate(prompt, max_new_tokens=48)
+        finally:
+            mono.close()
+
+        # --------------------------------------- watchdog trip mid-stream
+        rep = app.container.tpu().llm("tiny").engine
+        result: dict = {}
+
+        def client():
+            result.update(_post(
+                base, "/generate",
+                {"tokens": prompt, "max_new_tokens": 48}, timeout=120,
+            ))
+
+        t = threading.Thread(target=client)
+        t.start()
+
+        def serving_index():
+            for i, e in enumerate(rep.engines):
+                if any(r is not None and r.emitted > 0
+                       for r in e._slot_req):
+                    return i
+            return None
+
+        _wait(lambda: serving_index() is not None, 30, "first token")
+        victim = serving_index()
+        # a device hang longer than the 1 s step watchdog: the victim
+        # replica dies mid-stream and dumps its black box on the way down
+        inj.arm("step_latency", label=f"/r{victim}", delay=8.0)
+        print(f"armed device hang on replica {victim} mid-stream")
+        _wait(lambda: not rep.engines[victim].alive(), 30, "watchdog death")
+        assert "step watchdog" in (rep.engines[victim].died_reason or "")
+
+        t.join(timeout=120)
+        assert not t.is_alive(), "client hung"
+        assert result["tokens"] == want, "failed-over stream diverged"
+        print(f"watchdog tripped replica {victim}; "
+              f"stream failed over token-identical ({len(want)} tokens)")
+
+        # --------------------------------------------- bundle on disk
+        bundles = [d for d in sorted(os.listdir(bbdir))
+                   if "-watchdog-" in d]
+        assert len(bundles) == 1, sorted(os.listdir(bbdir))
+        bpath = os.path.join(bbdir, bundles[0])
+        names = set(os.listdir(bpath))
+        for f in ("manifest.json", "debug_state.json", "config.json",
+                  "wide_events.json", "flight_records.json"):
+            assert f in names, sorted(names)
+        with open(os.path.join(bpath, "manifest.json")) as f:
+            manifest = json.load(f)
+        assert manifest["trigger"] == "watchdog", manifest
+        assert "step watchdog" in manifest["reason"], manifest
+        with open(os.path.join(bpath, "flight_records.json")) as f:
+            records = json.load(f)
+        inflight = [r for r in records if not r["final"]]
+        finished = [r for r in records
+                    if r["final"] and r["finish_reason"] in ("eos", "length")
+                    and r.get("emitted_token_ids")]
+        assert inflight, "bundle missing the in-flight stream's record"
+        assert any(r["prompt_len"] == len(prompt) for r in inflight)
+        assert finished, "bundle holds no finished replayable record"
+        print(f"bundle {bundles[0]}: {len(records)} flight records "
+              f"({len(inflight)} in flight at death)")
+
+        # --------------------------------- deterministic replay (HTTP)
+        # a finished record FROM THE BUNDLE, re-executed byte-for-byte —
+        # the dead victim's ring survives post-mortem and the fleet
+        # handle replays it on the surviving replica
+        rec = finished[0]
+        out = _post(base, "/.well-known/debug/replay", {"id": rec["id"]})
+        rep_out = out["replay"]
+        assert not rep_out.get("error"), rep_out
+        assert rep_out["match"] is True, rep_out
+        assert rep_out["first_divergence"] is None
+        assert rep_out["replayed_token_ids"] == rec["emitted_token_ids"]
+        print(f"replay id={rec['id']}: byte-identical "
+              f"({rep_out['recorded_len']} tokens, on the live replica)")
+
+        # ---------------------------------------- replay CLI subcommand
+        cli = CMDApp(config=new_mock_config({"LOG_LEVEL": "ERROR"}))
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            rc = cli.run(["replay", f"-bundle={bpath}"])
+        assert rc == 0 and f"id={rec['id']}" in buf.getvalue()
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            rc = cli.run(["replay", f"-id={rec['id']}", f"-url={base}"])
+        assert rc == 0, buf.getvalue()
+        assert "token-identical" in buf.getvalue(), buf.getvalue()
+        print("replay CLI: bundle listing + token-identical verdict")
+
+        # ------------------------------------------------- /metrics
+        expo = _get(mbase, "/metrics")
+        hits = [ln for ln in expo.splitlines()
+                if ln.startswith("app_blackbox_bundles_total{")
+                and 'trigger="watchdog"' in ln]
+        assert hits and any(float(ln.rsplit(" ", 1)[1]) >= 1 for ln in hits)
+        assert "app_llm_anomaly" in expo, "anomaly gauge family missing"
+        print("metrics: app_blackbox_bundles_total{trigger=watchdog} hot")
+
+        # --------------------------------------------- router fleet fan
+        fan = json.loads(_get(
+            rbase, "/.well-known/debug/blackbox"))["data"]
+        assert fan["count"] >= 1, fan
+        assert any(b["trigger"] == "watchdog" for b in fan["bundles"]), fan
+        assert fan["recorders"], fan
+        assert any(rec_state.get("flight_records", 0) > 0
+                   for rec_state in fan["recorders"].values()), fan
+        print(f"router fan: {fan['count']} bundle(s) over "
+              f"{len(fan['recorders'])} recorder(s)")
+
+        print("BLACKBOX SMOKE OK")
+        return 0
+    finally:
+        router.shutdown()
+        app.shutdown()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -205,6 +205,27 @@ def test_summary_line_carries_structured():
     assert "structured" not in bench._summary_line(_serving_result())
 
 
+def test_summary_line_carries_obs_overhead():
+    """The observability-overhead point rides the summary as a compact
+    block: decode tok/s with every per-request sink armed (flight
+    recorder, anomaly baselines, unsampled wide events, metrics) vs all
+    off, plus the adjudicated <=3% overhead verdict."""
+    r = _serving_result()
+    r["detail"]["obs_overhead"] = {
+        "requests": 256, "new_tokens": 64, "claim_frac": 0.03,
+        "base_tok_s": 21400.0, "obs_tok_s": 21100.0,
+        "overhead_frac": 0.014, "within_claim": True,
+    }
+    s = bench._summary_line(r)
+    assert s["obs_overhead"] == {
+        "base_tok_s": 21400.0, "obs_tok_s": 21100.0,
+        "overhead_frac": 0.014, "within_claim": True,
+    }
+    assert len(json.dumps(s)) < 1500
+    # absent block (--no-obs-overhead / CPU runs) must not leak a key
+    assert "obs_overhead" not in bench._summary_line(_serving_result())
+
+
 def test_summary_line_carries_multitenant():
     """The multi-tenant LoRA point rides the summary as a compact block:
     4-adapter mixed-batch decode tok/s vs the single-tenant baseline

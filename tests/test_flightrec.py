@@ -1,0 +1,729 @@
+"""Incident flight recorder (gofr_tpu.flightrec +
+docs/advanced-guide/incident-debugging.md).
+
+The load-bearing invariants:
+
+- **Records finalize on every terminal path**, including ``_die`` — the
+  ring never holds a dangling non-final record for a finished request,
+  and the ring is bounded (oldest-first eviction) with a redaction mode
+  that keeps only content hashes.
+- **Deterministic replay.** A greedy replay of a recorded request is
+  token-identical to the recorded emission across the dense, paged,
+  windowed, speculative, constrained, and LoRA layouts — pinned to the
+  recorded model version/adapter/grammar/seed, with the first-divergence
+  index reported when it is not.
+- **Black-box bundles.** An incident trigger dumps a complete bundle
+  directory (manifest written LAST), rate-limited per trigger class;
+  an engine death classified by reason writes one while the corpse is
+  still warm, with the in-flight records inside.
+- **Dead engines hold no state** (the dead-engine-gauge regression
+  class): anomaly gauges zero and the dumper closes at ``close()`` AND
+  ``_die()``; the record ring survives ``_die`` for post-mortems but
+  clears at ``close()``.
+
+scripts/smoke_blackbox.py drives the same surfaces over real sockets
+(watchdog trip mid-stream -> bundle on disk -> byte-identical replay).
+"""
+
+import glob
+import io
+import json
+import os
+import time
+from types import SimpleNamespace
+
+import jax
+import pytest
+
+from gofr_tpu.flightrec import (
+    ANOMALY_SIGNALS,
+    AnomalyDetector,
+    BlackboxDumper,
+    FlightRecorder,
+    classify_die_reason,
+    find_record,
+    first_divergence,
+    replay_record,
+)
+from gofr_tpu.llm import GenRequest, LLMEngine
+from gofr_tpu.logging import Logger
+from gofr_tpu.metrics import new_metrics_manager
+from gofr_tpu.models import TransformerConfig, init_params
+from gofr_tpu.resilience import FaultInjector
+from gofr_tpu.structured import compile_json_schema
+
+CFG = TransformerConfig.tiny()
+CFGW = TransformerConfig.tiny_mistral()  # sliding window 8
+CFG128 = TransformerConfig.tiny(vocab_size=128)
+
+PROMPT = list(range(1, 17))
+REPETITIVE = ([5, 6, 7, 8] * 6)[:16]
+
+# char-level vocab for the constrained layout (test_structured's shape)
+VOCAB = [
+    chr(0x20 + i).encode() if 0x20 + i < 0x7F else b"" for i in range(127)
+] + [b""]
+EOS128 = 127
+SCHEMA = {"type": "object", "properties": {"n": {"type": "integer"}}}
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(jax.random.PRNGKey(0), CFG)
+
+
+@pytest.fixture(scope="module")
+def params_w():
+    return init_params(jax.random.PRNGKey(3), CFGW)
+
+
+@pytest.fixture(scope="module")
+def params_128():
+    return init_params(jax.random.PRNGKey(0), CFG128)
+
+
+@pytest.fixture(scope="module")
+def grammar():
+    return compile_json_schema(SCHEMA, VOCAB, EOS128)
+
+
+@pytest.fixture(scope="module")
+def adapter():
+    from gofr_tpu.lora import init_adapter
+
+    return init_adapter(jax.random.PRNGKey(7), CFG, rank=4, scale=2.0)
+
+
+def _engine(params, cfg=CFG, **kw) -> LLMEngine:
+    kw.setdefault("slots", 4)
+    kw.setdefault("max_seq_len", 96)
+    kw.setdefault("warmup", False)
+    return LLMEngine(cfg, params, **kw)
+
+
+class _FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def _fake_engine(**kw):
+    ns = SimpleNamespace(label="m", version="v1", kv=None, speculative=False)
+    for k, v in kw.items():
+        setattr(ns, k, v)
+    return ns
+
+
+# ---------------------------------------------------------------------------
+# unit: the record ring
+# ---------------------------------------------------------------------------
+class TestFlightRecorder:
+    def test_ring_bounds_and_evicts_oldest(self):
+        fr = FlightRecorder(capacity=4)
+        eng = _fake_engine()
+        reqs = [GenRequest([1, 2, 3], max_new_tokens=2) for _ in range(6)]
+        for r in reqs:
+            fr.start(r, eng)
+        assert len(fr) == 4
+        assert fr.get(reqs[0].id) is None and fr.get(reqs[1].id) is None
+        assert fr.get(reqs[-1].id) is not None
+        # newest-first ordering
+        assert [r["id"] for r in fr.records()] == [r.id for r in reqs[2:]][::-1]
+
+    def test_capacity_zero_disables(self):
+        fr = FlightRecorder(capacity=0)
+        assert not fr.enabled
+        r = GenRequest([1], max_new_tokens=1)
+        fr.start(r, _fake_engine())
+        assert len(fr) == 0 and fr.finalize(r) is None
+
+    def test_start_captures_replay_inputs(self):
+        fr = FlightRecorder(capacity=8)
+        eng = _fake_engine(version="v7", rng_seed=0)
+        r = GenRequest(
+            [1, 2, 3], max_new_tokens=5, temperature=0.0, priority="batch",
+            client="t", session_id="s1",
+        )
+        fr.start(r, eng)
+        rec = fr.get(r.id)
+        assert rec["model"] == "m" and rec["model_version"] == "v7"
+        assert rec["seed"] == 0 and rec["temperature"] == 0.0
+        assert rec["prompt_token_ids"] == [1, 2, 3]
+        assert rec["prompt_len"] == 3 and len(rec["prompt_sha256"]) == 64
+        assert rec["kv_layout"] == "dense"
+        assert rec["final"] is False and rec["finish_reason"] is None
+        assert rec["priority"] == "batch" and rec["session_id"] == "s1"
+
+    def test_kv_layout_detection(self):
+        fr = FlightRecorder(capacity=8)
+        for kv, want in (
+            (SimpleNamespace(paged=True, ring=0), "paged"),
+            (SimpleNamespace(paged=False, ring=8), "windowed"),
+            (SimpleNamespace(paged=False, ring=0), "dense"),
+        ):
+            r = GenRequest([1], max_new_tokens=1)
+            fr.start(r, _fake_engine(kv=kv))
+            assert fr.get(r.id)["kv_layout"] == want
+
+    def test_finalize_stamps_outcome(self):
+        fr = FlightRecorder(capacity=8)
+        r = GenRequest([1, 2], max_new_tokens=4)
+        fr.start(r, _fake_engine())
+        r.finish_reason = "eos"
+        r.history.extend([9, 8, 7])
+        rec = fr.finalize(r, queue_wait_ms=1.5, ttft_ms=3.0, total_ms=9.0)
+        assert rec["final"] is True and rec["finish_reason"] == "eos"
+        assert rec["emitted_token_ids"] == [9, 8, 7]
+        assert rec["phase_ms"]["queue_wait"] == 1.5
+        assert rec["phase_ms"]["ttft"] == 3.0
+        assert fr.records(final=True)[0]["id"] == r.id
+        assert fr.records(final=False) == []
+
+    def test_redaction_keeps_hash_only(self):
+        fr = FlightRecorder(capacity=8, redact=True)
+        r = GenRequest([1, 2, 3], max_new_tokens=4)
+        fr.start(r, _fake_engine())
+        r.finish_reason = "length"
+        r.history.extend([4, 5])
+        rec = fr.finalize(r)
+        assert rec["redacted"] is True
+        assert rec["prompt_token_ids"] is None
+        assert rec["emitted_token_ids"] is None
+        assert len(rec["prompt_sha256"]) == 64
+        assert len(rec["emitted_sha256"]) == 64
+        out = replay_record(_fake_engine(), rec)
+        assert "redacted" in out["error"]
+
+    def test_snapshot_inflight_stubs_evicted(self):
+        fr = FlightRecorder(capacity=1)
+        eng = _fake_engine()
+        r1 = GenRequest([1], max_new_tokens=8)
+        r2 = GenRequest([2], max_new_tokens=8)
+        fr.start(r1, eng)
+        fr.start(r2, eng)  # evicts r1's record
+        r1.history.append(3)
+        rows = fr.snapshot_inflight([r1, r2, r2, None])
+        assert len(rows) == 2  # deduped, None skipped
+        by_id = {row["id"]: row for row in rows}
+        assert by_id[r1.id]["evicted"] is True
+        assert by_id[r1.id]["emitted_token_ids"] == [3]
+        assert by_id[r2.id]["final"] is False
+        assert "evicted" not in by_id[r2.id]
+
+    def test_serializable_strips_grammar_object(self, grammar):
+        fr = FlightRecorder(capacity=8)
+        r = GenRequest([1], max_new_tokens=4, grammar=grammar)
+        fr.start(r, _fake_engine())
+        rec = fr.get(r.id)
+        assert rec["_grammar"] is grammar and rec["constrained"] is True
+        ser = FlightRecorder.serializable(rec)
+        assert "_grammar" not in ser
+        json.dumps(ser)  # bundle-safe
+
+    def test_clear_empties_ring(self):
+        fr = FlightRecorder(capacity=8)
+        fr.start(GenRequest([1], max_new_tokens=1), _fake_engine())
+        fr.clear()
+        assert len(fr) == 0
+
+    def test_first_divergence(self):
+        assert first_divergence([1, 2, 3], [1, 2, 3]) is None
+        assert first_divergence([1, 2, 3], [1, 9, 3]) == 1
+        assert first_divergence([1, 2, 3], [1, 2]) == 2
+        assert first_divergence([], [1]) == 0
+        assert first_divergence([], []) is None
+
+    def test_classify_die_reason(self):
+        assert classify_die_reason("step watchdog: stuck 5s") == "watchdog"
+        assert classify_die_reason("numerical watchdog: nan") == "numerical"
+        assert classify_die_reason("poison payload isolated") == "poison"
+        assert classify_die_reason("collector thread exited") == "engine_death"
+        assert classify_die_reason("") == "engine_death"
+
+
+# ---------------------------------------------------------------------------
+# unit: black-box bundles under a fake clock
+# ---------------------------------------------------------------------------
+class TestBlackboxDumper:
+    def test_bundle_contents_and_manifest_last(self, tmp_path):
+        clock = _FakeClock(100.0)
+        bb = BlackboxDumper(
+            str(tmp_path), min_interval_s=60.0, clock=clock, label="llm/r0",
+        )
+        path = bb.dump(
+            "watchdog", reason="stuck",
+            sections={"debug_state": {"died": True}, "hbm": []},
+            records=[{"id": 1, "_grammar": object(), "final": False}],
+        )
+        assert path is not None and os.path.isdir(path)
+        assert os.path.basename(path) == "llm_r0-watchdog-0001"
+        files = sorted(os.listdir(path))
+        assert files == [
+            "debug_state.json", "flight_records.json", "hbm.json",
+            "manifest.json",
+        ]
+        with open(os.path.join(path, "manifest.json")) as f:
+            m = json.load(f)
+        assert m["trigger"] == "watchdog" and m["reason"] == "stuck"
+        assert m["ts"] == 100.0 and m["flight_records"] == 1
+        assert m["sections"] == ["debug_state", "hbm"]
+        with open(os.path.join(path, "flight_records.json")) as f:
+            recs = json.load(f)
+        assert recs == [{"id": 1, "final": False}]  # underscore keys gone
+        assert bb.last_ts == 100.0 and bb.last_trigger == "watchdog"
+
+    def test_rate_limit_is_per_trigger_class(self, tmp_path):
+        clock = _FakeClock(0.0)
+        bb = BlackboxDumper(str(tmp_path), min_interval_s=60.0, clock=clock)
+        assert bb.dump("watchdog") is not None
+        clock.t = 30.0
+        assert bb.dump("watchdog") is None  # same class, inside window
+        assert bb.rate_limited == 1
+        assert bb.dump("anomaly") is not None  # other class unaffected
+        clock.t = 61.0
+        assert bb.dump("watchdog") is not None  # window elapsed
+        assert len(bb.listing()) == 3
+
+    def test_unconfigured_and_closed_are_inert(self, tmp_path):
+        assert BlackboxDumper("", min_interval_s=0).dump("manual") is None
+        bb = BlackboxDumper(str(tmp_path), min_interval_s=0)
+        bb.close()
+        assert not bb.enabled()
+        assert bb.dump("manual") is None
+        assert os.listdir(tmp_path) == []
+
+    def test_listing_skips_half_written_and_sorts_newest_first(self, tmp_path):
+        clock = _FakeClock(10.0)
+        bb = BlackboxDumper(str(tmp_path), min_interval_s=0, clock=clock)
+        bb.dump("manual")
+        clock.t = 20.0
+        bb.dump("watchdog")
+        # a crash mid-write leaves a directory without a manifest — the
+        # listing must not serve it as a completed bundle
+        os.makedirs(tmp_path / "llm-torn-9999")
+        names = [m["bundle"] for m in bb.listing()]
+        assert names == ["llm-watchdog-0002", "llm-manual-0001"]
+
+    def test_dump_counts_bundles_metric(self, tmp_path):
+        metrics = new_metrics_manager()
+        bb = BlackboxDumper(
+            str(tmp_path), min_interval_s=0, metrics=metrics, label="tiny",
+        )
+        bb.dump("slo_fast_burn")
+        text = metrics.render_prometheus()
+        assert "app_blackbox_bundles_total" in text
+        assert 'trigger="slo_fast_burn"' in text
+
+    def test_dump_survives_unwritable_directory(self):
+        bb = BlackboxDumper("/proc/nonexistent-blackbox", min_interval_s=0)
+        assert bb.dump("manual") is None  # never raises
+
+
+# ---------------------------------------------------------------------------
+# unit: anomaly detection under synthetic drift
+# ---------------------------------------------------------------------------
+def _detector(**kw):
+    kw.setdefault("factor", 3.0)
+    kw.setdefault("min_samples", 8)
+    kw.setdefault("sustain", 4)
+    return AnomalyDetector(None, "tiny", **kw)
+
+
+class TestAnomalyDetector:
+    def test_sustained_drift_flags_and_fires_once(self):
+        fired = []
+        det = _detector(on_flag=lambda s, v, m: fired.append((s, v, m)))
+        for _ in range(20):
+            assert det.observe("ttft", 10.0) is False
+        for i in range(10):  # 10x the baseline, sustained
+            flagged = det.observe("ttft", 100.0)
+            assert flagged is (i >= 3)  # sustain=4
+        assert det.flagged() == ["ttft"]
+        assert len(fired) == 1
+        sig, val, mean = fired[0]
+        assert sig == "ttft" and val == 100.0 and mean == pytest.approx(10.0)
+
+    def test_single_straggler_never_flags(self):
+        det = _detector()
+        for _ in range(20):
+            det.observe("step", 5.0)
+        for _ in range(3):  # sustain-1 deviants, then back to normal
+            det.observe("step", 500.0)
+        assert det.observe("step", 5.0) is False
+        assert det.flagged() == []
+
+    def test_deviants_do_not_poison_baseline(self):
+        det = _detector()
+        for _ in range(20):
+            det.observe("tpot", 10.0)
+        for _ in range(10):
+            det.observe("tpot", 1000.0)
+        # the anomaly must not become its own baseline
+        assert det.snapshot()["tpot"]["baseline_mean"] == pytest.approx(10.0)
+
+    def test_clears_after_sustained_normal(self):
+        det = _detector()
+        for _ in range(20):
+            det.observe("queue_wait", 10.0)
+        for _ in range(6):
+            det.observe("queue_wait", 200.0)
+        assert det.flagged() == ["queue_wait"]
+        for _ in range(3):
+            det.observe("queue_wait", 10.0)
+        assert det.flagged() == ["queue_wait"]  # not yet: sustain=4
+        det.observe("queue_wait", 10.0)
+        assert det.flagged() == []
+
+    def test_spec_accept_flags_below_baseline(self):
+        det = _detector()
+        for _ in range(20):
+            det.observe("spec_accept", 0.9)
+        for _ in range(4):
+            det.observe("spec_accept", 0.1)  # < mean/factor
+        assert det.flagged() == ["spec_accept"]
+        # high acceptance is good, never deviant
+        det2 = _detector()
+        for _ in range(20):
+            det2.observe("spec_accept", 0.3)
+        for _ in range(10):
+            det2.observe("spec_accept", 1.0)
+        assert det2.flagged() == []
+
+    def test_quiet_until_min_samples(self):
+        det = _detector(min_samples=50)
+        for _ in range(49):
+            assert det.observe("ttft", 1e9) is False
+
+    def test_unknown_signal_ignored(self):
+        assert _detector().observe("no_such_signal", 1.0) is False
+
+    def test_gauge_published_and_zeroed(self):
+        metrics = new_metrics_manager()
+        det = AnomalyDetector(
+            metrics, "tiny", factor=3.0, min_samples=8, sustain=4,
+        )
+        for _ in range(20):
+            det.observe("ttft", 10.0)
+        for _ in range(4):
+            det.observe("ttft", 100.0)
+        text = metrics.render_prometheus()
+        assert 'app_llm_anomaly{model="tiny",signal="ttft"} 1' in text
+        det.zero_gauges()
+        assert det.flagged() == []
+        text = metrics.render_prometheus()
+        for s in ANOMALY_SIGNALS:
+            assert f'signal="{s}"}} 0' in text
+        # baselines cleared: a restarted engine recalibrates fresh
+        assert det.snapshot()["ttft"]["baseline_samples"] == 0
+
+
+# ---------------------------------------------------------------------------
+# engine integration: lifecycle, replay identity, bundles, _die
+# ---------------------------------------------------------------------------
+class TestEngineRecords:
+    def test_generate_finalizes_record(self, params):
+        eng = _engine(params)
+        try:
+            out = eng.generate(PROMPT, max_new_tokens=8)
+            recs = eng.flightrec.records(final=True)
+            assert len(recs) == 1
+            rec = recs[0]
+            assert rec["emitted_token_ids"] == out
+            assert rec["finish_reason"] in ("length", "eos")
+            assert rec["prompt_token_ids"] == PROMPT
+            assert rec["model_version"] == eng.version
+            assert rec["phase_ms"]["total"] is not None
+        finally:
+            eng.close()
+
+    def test_close_clears_ring_and_closes_dumper(self, params, tmp_path):
+        eng = _engine(params, blackbox_dir=str(tmp_path))
+        eng.generate(PROMPT, max_new_tokens=4)
+        assert len(eng.flightrec) == 1
+        eng.close()
+        assert len(eng.flightrec) == 0
+        assert not eng.blackbox.enabled()
+        assert eng._incident("manual") is None
+
+    def test_flight_records_knob_disables(self, params):
+        eng = _engine(params, flight_records=0)
+        try:
+            eng.generate(PROMPT, max_new_tokens=4)
+            assert len(eng.flightrec) == 0
+        finally:
+            eng.close()
+
+    def test_replay_of_unknown_id_errors(self, params):
+        eng = _engine(params)
+        try:
+            out = eng.replay(424242)
+            assert "error" in out
+        finally:
+            eng.close()
+
+    def test_replay_refuses_version_mismatch(self, params):
+        eng = _engine(params, version="v2")
+        try:
+            eng.generate(PROMPT, max_new_tokens=4)
+            rec = dict(eng.flightrec.records(final=True)[0])
+            rec["model_version"] = "v1"
+            out = eng.replay(rec)
+            assert "version mismatch" in out["error"]
+        finally:
+            eng.close()
+
+    def test_find_record_searches_handle(self, params):
+        eng = _engine(params)
+        try:
+            eng.generate(PROMPT, max_new_tokens=4)
+            rid = eng.flightrec.records()[0]["id"]
+            rec, owner = find_record(eng, rid)
+            assert rec["id"] == rid and owner is eng
+            assert find_record(eng, 999999) == (None, None)
+        finally:
+            eng.close()
+
+
+class TestReplayIdentity:
+    """Greedy replay is token-identical across every layout — the
+    record carries everything needed to re-execute bit-for-bit."""
+
+    def _roundtrip(self, eng, prompt, max_new=12, **req_kw):
+        req = eng.submit(GenRequest(prompt, max_new_tokens=max_new, **req_kw))
+        want = req.tokens(timeout=120)
+        rec = eng.flightrec.get(req.id)
+        assert rec["final"] is True
+        out = eng.replay(req.id)
+        assert out["error" if "error" in out else "match"] is True, out
+        assert out["first_divergence"] is None
+        assert out["replayed_token_ids"] == want
+        assert out["recorded_len"] == len(want)
+        return rec, out
+
+    def test_dense(self, params):
+        eng = _engine(params, kv_paged=False)
+        try:
+            rec, _ = self._roundtrip(eng, PROMPT)
+            assert rec["kv_layout"] == "dense"
+        finally:
+            eng.close()
+
+    def test_paged(self, params):
+        eng = _engine(params, kv_paged=True)
+        try:
+            rec, _ = self._roundtrip(eng, PROMPT)
+            assert rec["kv_layout"] == "paged"
+        finally:
+            eng.close()
+
+    def test_windowed(self, params_w):
+        eng = _engine(params_w, cfg=CFGW, kv_window=8)
+        try:
+            rec, _ = self._roundtrip(eng, PROMPT)
+            assert rec["kv_layout"] == "windowed"
+        finally:
+            eng.close()
+
+    def test_speculative(self, params):
+        eng = _engine(params, speculative=True, spec_draft=4)
+        try:
+            rec, _ = self._roundtrip(eng, REPETITIVE)
+            assert rec["speculative"] is True
+        finally:
+            eng.close()
+
+    def test_constrained(self, params_128, grammar):
+        eng = _engine(params_128, cfg=CFG128, max_seq_len=160)
+        try:
+            rec, out = self._roundtrip(
+                eng, [1, 2, 3], max_new=100, grammar=grammar,
+                eos_token=EOS128,
+            )
+            assert rec["constrained"] is True
+            assert rec["grammar_id"] is not None
+        finally:
+            eng.close()
+
+    def test_lora(self, params, adapter):
+        eng = _engine(params, lora_slots=4)
+        try:
+            eng.load_adapter("tenant", adapter)
+            rec, _ = self._roundtrip(eng, PROMPT, adapter="tenant")
+            assert rec["lora"] is True and rec["adapter"] == "tenant"
+            assert rec["adapter_version"].startswith("tenant@")
+        finally:
+            eng.close()
+
+
+class TestEngineBundles:
+    def test_die_writes_classified_bundle_with_inflight_record(
+        self, params, tmp_path,
+    ):
+        inj = FaultInjector()
+        eng = _engine(
+            params, blackbox_dir=str(tmp_path), blackbox_interval_s=0,
+            fault_injector=inj,
+        )
+        try:
+            eng.generate(PROMPT, max_new_tokens=4)  # one FINAL record
+            # hold the next request in flight: every step sleeps long
+            # enough for the kill below to land mid-decode
+            inj.arm("step_latency", count=-1, delay=0.2)
+            req = eng.submit(GenRequest(PROMPT, max_new_tokens=64))
+            deadline = time.time() + 10
+            while eng.flightrec.get(req.id) is None and time.time() < deadline:
+                time.sleep(0.01)
+            time.sleep(0.3)
+            eng._die("step watchdog: injected trip")
+            bundles = glob.glob(str(tmp_path / "*-watchdog-*"))
+            assert len(bundles) == 1
+            files = set(os.listdir(bundles[0]))
+            assert {
+                "manifest.json", "debug_state.json", "flight_records.json",
+                "wide_events.json", "config.json", "anomaly.json",
+            } <= files
+            with open(os.path.join(bundles[0], "manifest.json")) as f:
+                m = json.load(f)
+            assert m["trigger"] == "watchdog"
+            assert "injected trip" in m["reason"]
+            with open(os.path.join(bundles[0], "flight_records.json")) as f:
+                recs = json.load(f)
+            by_id = {r["id"]: r for r in recs}
+            # the in-flight victim is in the bundle, non-final, with its
+            # progress-so-far; the earlier finished request rides along
+            assert by_id[req.id]["final"] is False
+            assert any(r["final"] for r in recs)
+            # _die drains the victim to a terminal record (finalize on
+            # EVERY terminal path), and the ring survives for post-mortems
+            assert req.tokens(timeout=10) is not None
+            assert eng.flightrec.get(req.id)["final"] is True
+            assert len(eng.flightrec) >= 1
+            # dead engine holds no further bundle-writing capability
+            assert not eng.blackbox.enabled()
+            assert eng.anomaly is None or eng.anomaly.flagged() == []
+        finally:
+            inj.disarm()
+            eng.close()
+
+    def test_incident_rate_limited_and_counted(self, params, tmp_path):
+        metrics = new_metrics_manager()
+        eng = _engine(params, blackbox_dir=str(tmp_path), metrics=metrics)
+        try:
+            eng.generate(PROMPT, max_new_tokens=4)
+            path = eng._incident("manual", reason="operator poke")
+            assert path is not None
+            assert eng._incident("manual") is None  # 60 s class window
+            text = metrics.render_prometheus()
+            assert 'app_blackbox_bundles_total{' in text
+            assert 'trigger="manual"' in text
+            with open(os.path.join(path, "config.json")) as f:
+                cfg = json.load(f)
+            assert cfg["model"] == eng.label
+            assert len(cfg["sha256"]) == 64
+        finally:
+            eng.close()
+
+    def test_incident_disabled_without_dir(self, params):
+        eng = _engine(params)
+        try:
+            assert not eng.blackbox.enabled()
+            assert eng._incident("manual") is None
+        finally:
+            eng.close()
+
+
+# ---------------------------------------------------------------------------
+# wide-event sampling (TPU_LLM_WIDE_EVENT_SAMPLE)
+# ---------------------------------------------------------------------------
+def _wide_events(out: io.StringIO) -> list[dict]:
+    evs = []
+    for ln in out.getvalue().splitlines():
+        try:
+            msg = json.loads(ln)["message"]
+        except (ValueError, KeyError, TypeError):
+            continue
+        if isinstance(msg, dict) and msg.get("event") == "llm_request":
+            evs.append(msg)
+    return evs
+
+
+class TestWideEventSampling:
+    def test_one_in_n_with_factor_stamped(self, params):
+        out = io.StringIO()
+        eng = _engine(
+            params, wide_event_sample=3,
+            logger=Logger(out=out, err=out, pretty=False),
+        )
+        try:
+            for _ in range(6):
+                eng.generate(PROMPT, max_new_tokens=2)
+            deadline = time.time() + 5
+            while len(_wide_events(out)) < 2 and time.time() < deadline:
+                time.sleep(0.02)
+            evs = _wide_events(out)
+            assert len(evs) == 2  # 1-in-3 of six normal finishes
+            assert all(ev["sample"] == 3 for ev in evs)
+            # the bundle deque retains ALL of them regardless of sampling
+            assert len(eng._wide_retained) == 6
+        finally:
+            eng.close()
+
+    def test_incident_lines_always_emit(self, params):
+        out = io.StringIO()
+        eng = _engine(
+            params, wide_event_sample=1000,
+            logger=Logger(out=out, err=out, pretty=False),
+        )
+        try:
+            req = eng.submit(GenRequest(PROMPT, max_new_tokens=64))
+            req.cancel()
+            req.tokens(timeout=30)
+            deadline = time.time() + 5
+            while not _wide_events(out) and time.time() < deadline:
+                time.sleep(0.02)
+            evs = _wide_events(out)
+            assert len(evs) == 1  # sampled out for normal, forced here
+            assert evs[0]["finish_reason"] == "cancelled"
+            assert evs[0]["sample"] == 1  # rate-rescaling sees weight 1
+        finally:
+            eng.close()
+
+
+# ---------------------------------------------------------------------------
+# serving-summary degradation fields (the fleet poll's incident view)
+# ---------------------------------------------------------------------------
+class TestServingSummary:
+    def test_summary_carries_incident_and_anomaly(self):
+        from gofr_tpu.handler import _serving_summary
+
+        class Eng:
+            def __init__(self):
+                self.blackbox = SimpleNamespace(last_ts=123.5)
+                self.anomaly = SimpleNamespace(flagged=lambda: ["ttft"])
+
+            def load_tokens(self):
+                return 0
+
+            def throughput_tok_s(self):
+                return None
+
+            def predicted_wait_s(self):
+                return None
+
+        class C:
+            draining = False
+
+        out = _serving_summary(C(), {"a": Eng()})
+        assert out["last_incident_ts"] == 123.5
+        assert out["anomaly"] == ["ttft"]
+
+    def test_summary_quiet_without_incidents(self):
+        from gofr_tpu.handler import _serving_summary
+
+        class C:
+            draining = False
+
+        out = _serving_summary(C(), {})
+        assert out["last_incident_ts"] is None and out["anomaly"] == []
