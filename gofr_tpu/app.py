@@ -26,6 +26,7 @@ from .handler import (
     debug_engine_handler,
     debug_profile_handler,
     debug_traces_handler,
+    debug_usage_handler,
     favicon_wire_handler,
     health_handler,
     live_handler,
@@ -320,6 +321,11 @@ class App:
         # well-known block runs late, at serve()).
         if not self.router.has("GET", "/.well-known/debug/blackbox"):
             self.get("/.well-known/debug/blackbox", debug_blackbox_handler)
+        # Per-tenant usage metering / chargeback export (gofr_tpu.goodput;
+        # docs/advanced-guide/cost-accounting.md). Same yield-to-router
+        # discipline: the front router binds its fleet-fan variant here.
+        if not self.router.has("GET", "/.well-known/debug/usage"):
+            self.get("/.well-known/debug/usage", debug_usage_handler)
         self._add(
             "POST", "/.well-known/debug/replay", replay_handler,
             timeout_s=max(120.0, self.request_timeout),

@@ -232,6 +232,7 @@ class FlightRecorder:
         ttft_ms=None,
         per_token_ms=None,
         total_ms=None,
+        chip=None,
     ) -> dict | None:
         """Stamp the terminal outcome. Every terminal path lands here —
         the regular finish observer AND the die-drain paths — so a
@@ -258,6 +259,10 @@ class FlightRecorder:
                 "per_token": per_token_ms,
                 "total": total_ms,
             },
+            # chip-time attribution by waste class (gofr_tpu.goodput;
+            # milliseconds) — the per-request cost line an incident
+            # bundle carries alongside the latency breakdown
+            "chip_ms": chip,
             # history holds the tokens emitted since THIS engine's
             # submit — exactly the emission a replay of the recorded
             # prompt reproduces
@@ -373,6 +378,7 @@ def replay_record(engine, record: dict, *, timeout: float = 120.0) -> dict:
         client="flightrec-replay",
         grammar=rec.get("_grammar"),
         adapter=rec.get("adapter") or "",
+        probe=True,  # debug traffic: goodput classes it as probe waste
     )
     t0 = time.perf_counter()
     replayed = engine.submit(req).tokens(timeout=timeout)

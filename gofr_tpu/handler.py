@@ -531,6 +531,26 @@ def debug_blackbox_handler(ctx: Context) -> Any:
     return {"bundles": out, "count": len(out), "recorders": recorders}
 
 
+def debug_usage_handler(ctx: Context) -> Any:
+    """GET /.well-known/debug/usage — this process's chargeback view
+    (gofr_tpu.goodput; docs/advanced-guide/cost-accounting.md): per
+    model, the windowed per-tenant usage (chip-seconds by waste class,
+    useful tokens, token rate), the cumulative goodput attribution with
+    its conservation identity, and the quota table. The front router
+    fans this route over the fleet the same way it fans the journey and
+    blackbox queries. Read-only and bounded (the meter caps tenants)."""
+    rt = ctx.container.tpu_runtime  # never construct: meter what runs
+    llms = getattr(rt, "_llms", {}) if rt is not None else {}
+    models: dict[str, dict] = {}
+    for name, handle in llms.items():
+        eng = getattr(handle, "engine", handle)
+        usage_state = getattr(eng, "usage_state", None)
+        if usage_state is None:
+            continue
+        models[name] = usage_state()
+    return {"models": models, "count": len(models)}
+
+
 def replay_handler(ctx: Context) -> Any:
     """POST /.well-known/debug/replay — deterministically re-execute a
     flight record and report the first-divergence token index vs the

@@ -290,6 +290,9 @@ def _register_phase_metrics(metrics) -> None:
     from .resilience import register_resilience_metrics
 
     register_resilience_metrics(metrics)  # app_llm_*_total + drain gauge
+    from .goodput import register_goodput_metrics
+
+    register_goodput_metrics(metrics)  # app_llm_goodput_* + tenant meters
 
 
 class EngineOverloaded(RuntimeError):
@@ -443,6 +446,12 @@ class GenRequest:
     # token-identical to an engine with no adapter support). Requires the
     # chunked scheduler and a LoRA-enabled engine (lora_slots > 0).
     adapter: str = ""
+    # Synthetic-traffic marker (gofr_tpu.goodput): canary checks, shadow
+    # probes, rollout bakes, and flight-record replays set probe=True so
+    # the goodput ledger classes their chip time as `probe` waste rather
+    # than tenant demand — and the quota gate waves them through (an
+    # over-quota tenant must not block the canary that protects it).
+    probe: bool = False
     id: int = field(default_factory=itertools.count().__next__)
 
     def __post_init__(self):
@@ -533,6 +542,15 @@ class GenRequest:
         # cross-process stitch is queried by.
         self.hop = 0
         self.journey_id: str | None = None
+        # -- goodput attribution (gofr_tpu.goodput; engine-maintained) --
+        # _chip: chip-seconds attributed to this request by waste class
+        # (useful/padding/spec_reject/replay/probe) — rolled into the
+        # wide event, flight record, and OpenAI usage block at finish.
+        # _replay_pos: prompt positions below this index were already
+        # computed once (preemption/failover continuation re-prefill) —
+        # the ledger classes their re-prefill as `replay`, not `useful`.
+        self._chip: dict[str, float] = {}
+        self._replay_pos = 0
 
     # -- consumption ------------------------------------------------------
     def _raise_terminal(self) -> None:
@@ -669,6 +687,10 @@ class LLMEngine:
         blackbox_interval_s: float | None = None,
         anomaly: bool | None = None,
         wide_event_sample: int | None = None,
+        goodput: bool | None = None,
+        quotas: dict | None = None,
+        usage_meter=None,
+        usage_window_s: float | None = None,
     ):
         import jax
         import jax.numpy as jnp
@@ -1003,6 +1025,43 @@ class LLMEngine:
         self._wide_sample = max(1, int(wide_event_sample))
         self._wide_seq = 0
         self._wide_retained: deque = deque(maxlen=WIDE_EVENTS_KEEP)
+        # -- goodput ledger + per-tenant usage metering (gofr_tpu.goodput;
+        # docs/advanced-guide/cost-accounting.md) -------------------------
+        # Chip-time attribution at the fetch seam (every device window
+        # split across its lanes into the waste taxonomy), rolling
+        # per-tenant usage windows (shared fleet-wide when replicated
+        # serving passes usage_meter=), and hard token-rate quotas
+        # enforced at admission with a Retry-After priced from the
+        # tenant's measured window.
+        from .goodput import GoodputLedger, QuotaGate, UsageMeter
+        from .goodput import parse_quota_spec as _parse_quota
+
+        if goodput is None:
+            goodput = _os.environ.get("TPU_LLM_GOODPUT", "1") not in ("", "0")
+        self.goodput = None
+        self.usage = None
+        self.quota = None
+        self.quota_sheds = 0
+        if goodput:
+            if usage_window_s is None:
+                usage_window_s = float(
+                    _os.environ.get("TPU_LLM_USAGE_WINDOW_S", "") or 60.0
+                )
+            self.usage = (
+                usage_meter if usage_meter is not None
+                else UsageMeter(window_s=usage_window_s)
+            )
+            self.goodput = GoodputLedger(
+                metrics=metrics, label=self.label,
+                version_fn=lambda: self.version, usage=self.usage,
+            )
+            q = _parse_quota(_os.environ.get("TPU_LLM_TENANT_QUOTA_TOK_S"))
+            for tenant, rate in (quotas or {}).items():
+                try:
+                    q[str(tenant)] = float(rate)
+                except (TypeError, ValueError):
+                    continue
+            self.quota = QuotaGate(q, self.usage)
         # KV layout/residency/reuse policy lives in the kvcache subsystem:
         # rolling ring for sliding-window models (slot memory O(window)),
         # dense slab otherwise; optional prompt-prefix reuse at admission.
@@ -2442,6 +2501,31 @@ class LLMEngine:
         # forward the X-GoFr-Priority header verbatim, and a typo must
         # degrade to the latency-safe class, not an error.
         req.priority = "batch" if req.priority == "batch" else "interactive"
+        # -- per-tenant token-rate quota (gofr_tpu.goodput) ---------------
+        # Hard admission ceiling on the MEASURED usage window (chargeback
+        # truth, not fair-share weights): tenants without an explicit
+        # quota fall through to fair-share only. Probes are exempt — an
+        # over-quota tenant must not block the canary that protects it.
+        # Checked before any reference is taken (grammar/adapter) so a
+        # quota shed never leaks engine state.
+        if self.quota is not None and self.quota.active() and not req.probe:
+            tenant = req.client or (
+                f"adapter:{req.adapter}" if req.adapter else "-"
+            )
+            quota_retry = self.quota.check(tenant)
+            if quota_retry is not None:
+                self.quota_sheds += 1
+                if self.metrics is not None:
+                    self.metrics.increment_counter(
+                        "app_llm_quota_sheds_total",
+                        model=self.label, tenant=tenant,
+                    )
+                raise EngineOverloaded(
+                    f"tenant {tenant!r} over token-rate quota "
+                    f"{self.quota.quota_for(tenant):.0f} tok/s "
+                    "(TPU_LLM_TENANT_QUOTA_TOK_S)",
+                    retry_after=quota_retry,
+                )
         wait_s = self.predicted_wait_s()
         spec = self.faults.take("overload_pressure", self.label)
         if spec is not None:
@@ -2715,8 +2799,45 @@ class LLMEngine:
                 # utilization: analytic-FLOPs MFU + tokens/s/chip windows
                 # and the roofline verdict (profiling.mfu)
                 "mfu": self._mfu_summary(),
+                # chip-time attribution + quota state (gofr_tpu.goodput)
+                "goodput": (
+                    self.goodput.snapshot()
+                    if self.goodput is not None else None
+                ),
+                "quota": (
+                    {**self.quota.snapshot(), "sheds": self.quota_sheds}
+                    if self.quota is not None else None
+                ),
                 "warmup_s": self.warmup_s,
             }
+
+    def usage_state(self) -> dict:
+        """Windowed per-tenant usage + cumulative goodput attribution
+        for the /.well-known/debug/usage endpoint (chargeback export).
+        Same shape as ReplicatedLLMEngine.usage_state so the handler
+        never branches on the engine kind."""
+        usage = (
+            self.usage.snapshot() if self.usage is not None
+            else {"window_s": None, "tenants": {}}
+        )
+        return {
+            "replicas": 1,
+            "goodput": (
+                self.goodput.snapshot() if self.goodput is not None else None
+            ),
+            "quota": (
+                self.quota.snapshot() if self.quota is not None else None
+            ),
+            "quota_sheds": self.quota_sheds,
+            **usage,
+        }
+
+    def set_tenant_quota(self, tenant: str, tok_s: float | None) -> None:
+        """Set (or clear, with None) a tenant's hard token-rate quota at
+        runtime — register_adapter's quota= knob lands here with the
+        adapter's pseudo-client id."""
+        if self.quota is not None:
+            self.quota.set(tenant, tok_s)
 
     def debug_state(self) -> dict:
         """Live introspection for /.well-known/debug/engine: the slot
@@ -2839,6 +2960,16 @@ class LLMEngine:
             "phases": phases,
             "slo": self.slo.snapshot() if self.slo is not None else None,
             "mfu": self._mfu_summary(),
+            "goodput": (
+                self.goodput.snapshot() if self.goodput is not None else None
+            ),
+            "usage": (
+                self.usage.snapshot() if self.usage is not None else None
+            ),
+            "quota": (
+                {**self.quota.snapshot(), "sheds": self.quota_sheds}
+                if self.quota is not None else None
+            ),
             "warmup_s": self.warmup_s,
             # this engine's rows from the process compile registry (the
             # full cross-engine view lives at /.well-known/debug/compiles)
@@ -3537,6 +3668,11 @@ class LLMEngine:
             "app_llm_moe_experts",
         ):
             self.metrics.set_gauge(name, 0.0, model=self.label)
+        # goodput ratio is load state too: a dead engine must not freeze
+        # its last useful-fraction on the exposition (close() AND _die()
+        # both funnel here — the PR 3/PR 18 regression class)
+        if self.goodput is not None:
+            self.goodput.zero_gauges()
         # a closed engine must not keep exporting its version row (the
         # dead-engine gauge bug class): the series would read as "this
         # label still serves version X" forever
@@ -4103,9 +4239,16 @@ class LLMEngine:
         # continuation re-seed (ReplicatedLLMEngine._failover semantics):
         # prompt grows by what was already streamed, scheduling state
         # resets, consumer-facing state (out queue, emitted) carries over
+        # goodput replay marker: everything the continuation re-prefills
+        # below this position was computed once already — the chunk
+        # progress if nothing streamed yet, the whole grown prompt after
+        # the history fold (the served tokens re-enter as prompt rows)
+        replay_to = r.prefill_pos
         if r.history:
             r.prompt_tokens = list(r.prompt_tokens) + r.history
             r.history = []
+            replay_to = len(r.prompt_tokens)
+        r._replay_pos = max(r._replay_pos, replay_to)
         r.prefill_pos = 0
         r.prefill_done = False
         r._rows_hi = 0
@@ -5402,12 +5545,14 @@ class LLMEngine:
         # reason, emitted token ids) — every terminal path funnels here,
         # so the ring never holds a dangling non-final record for a
         # finished request
+        chip = dict(r._chip) if r._chip else {}
         self.flightrec.finalize(
             r,
             queue_wait_ms=None if queue_wait is None else queue_wait * 1e3,
             ttft_ms=None if ttft is None else ttft * 1e3,
             per_token_ms=None if tpot is None else tpot * 1e3,
             total_ms=None if total is None else total * 1e3,
+            chip={c: round(v * 1e3, 3) for c, v in chip.items()} or None,
         )
         # perf-anomaly baselines (flightrec): sustained deviation flags
         # app_llm_anomaly and triggers a perf-incident bundle. The step
@@ -5473,6 +5618,13 @@ class LLMEngine:
             "total_ms": ms(total),
             "prefix_hit": r.prefix_hit,
             "capped": r.capped,
+            # chip-time attribution (gofr_tpu.goodput): device seconds
+            # this request owned, by waste class — the per-request cost
+            # line chargeback joins against the tenant usage windows
+            "chip_ms": round(sum(chip.values()) * 1e3, 3),
+            "chip_breakdown_ms": {
+                c: round(v * 1e3, 3) for c, v in chip.items()
+            },
         }
         # the FULL stream is retained for incident bundles regardless of
         # sampling or logger presence — a bundle's last-N wide events
@@ -5934,6 +6086,9 @@ class LLMEngine:
                 "t0": t0, "shape": shape, "nb": nb,
                 "prefill_tokens": prefill_tokens, "spans": spans,
                 "active": active_n,
+                # row requests aligned with spans — the goodput ledger
+                # attributes each prefill span to its owner at the fetch
+                "rows": [r for r, _n in rows],
             }
             self._inflight.append(
                 ("step", first_dev, finishes, toks_dev, snapshot, K, info)
@@ -6274,6 +6429,36 @@ class LLMEngine:
                     ),
                     dt=now - info["t0"],
                 )
+            if self.goodput is not None:
+                from .goodput import prefill_classes
+
+                # miss wave: the device ran [nb, bucket] prompt rows —
+                # live lanes own their prompt length (replay-split for
+                # continuations), everything else in the rectangle is
+                # padding (scrubbed lanes included). A prefix-hit wave
+                # dispatched no prefill; its cost is ~the one seeded
+                # first-token sample per lane.
+                lanes: list = []
+                plen_sum = 0
+                for _s, r in taken:
+                    if r is None:
+                        continue
+                    if info["bucket"] is not None:
+                        plen = len(r.prompt_tokens)
+                        lanes.append(
+                            (r, prefill_classes(r._replay_pos, 0, plen))
+                        )
+                        plen_sum += plen
+                    else:
+                        lanes.append((r, {"useful": 1}))
+                if info["bucket"] is not None:
+                    pad = (
+                        info["bucket"] * max(info["nb"], len(taken))
+                        - plen_sum
+                    )
+                    if pad > 0:
+                        lanes.append((None, {"padding": pad}))
+                self.goodput.observe("prefill", info["t0"], now, lanes)
             with self._lock:
                 for j, (slot, r) in enumerate(taken):
                     if r is None:  # scrubbed by preemption: tokens dropped
@@ -6338,6 +6523,25 @@ class LLMEngine:
                 model=self.label, chunk=str(k), wave=str(wave), fused="0",
                 **self._role_labels,
             )
+        if self.goodput is not None:
+            # dense decode pass: every slot lane ran k serial steps —
+            # live lanes decoded useful tokens (capped at the request's
+            # remaining budget: positions computed past max_new are
+            # truncated at emit, i.e. slack, not demand), empty lanes
+            # are padding
+            lanes = []
+            for r in snapshot:
+                if r is None:
+                    continue
+                use = min(k, max(0, r.max_new_tokens - r.emitted))
+                cl = {"useful": use}
+                if k - use > 0:
+                    cl["padding"] = k - use
+                lanes.append((r, cl))
+            dead = k * (len(snapshot) - active_n)
+            if dead > 0:
+                lanes.append((None, {"padding": dead}))
+            self.goodput.observe("chunk", t_dispatch, now, lanes)
         cols = toks.T  # [S, K]
         with self._lock:
             for slot, r in enumerate(snapshot):
@@ -6448,6 +6652,32 @@ class LLMEngine:
                     fused="1" if info["prefill_tokens"] else "0",
                     **self._role_labels,
                 )
+        if self.goodput is not None:
+            from .goodput import prefill_classes
+
+            # fused step: each packed prefill span belongs to its row's
+            # request (replay-split for continuations); the piggybacked
+            # decode ran k steps over ALL slot lanes. Padding = unpacked
+            # prefill rectangle + empty decode lanes.
+            lanes = []
+            for r, (pos, n) in zip(info.get("rows", ()), info["spans"]):
+                lanes.append((r, prefill_classes(r._replay_pos, pos, n)))
+            decode_n = 0
+            for r in snapshot:
+                if r is not None:
+                    decode_n += 1
+                    use = min(k, max(0, r.max_new_tokens - r.emitted))
+                    cl = {"useful": use}
+                    if k - use > 0:
+                        cl["padding"] = k - use
+                    lanes.append((r, cl))
+            pad = (
+                info["shape"] * info["nb"] - info["prefill_tokens"]
+                + k * (len(snapshot) - decode_n)
+            )
+            if pad > 0:
+                lanes.append((None, {"padding": pad}))
+            self.goodput.observe("step", info["t0"], now, lanes)
         with self._lock:
             for j, slot, r in finishes:
                 if r.span is not None and r.finish_reason is None:
@@ -6569,6 +6799,25 @@ class LLMEngine:
                 model=self.label, chunk=f"v{info['W']}", wave=str(wave),
                 fused="0", **self._role_labels,
             )
+        if self.goodput is not None:
+            # verify is a dense [S, W] device pass: selected lanes own
+            # their accepted span (+1 bonus) as useful and the rejected
+            # draft positions as spec_reject; unselected rows are padding
+            lanes = []
+            for slot, r in sel:
+                a = int(acc[slot])
+                use = min(a + 1, max(0, r.max_new_tokens - r.emitted))
+                cl = {"useful": use}
+                if a + 1 - use > 0:
+                    cl["padding"] = a + 1 - use
+                rej = info["n_draft"].get(slot, 0) - a
+                if rej > 0:
+                    cl["spec_reject"] = rej
+                lanes.append((r, cl))
+            pad = ys.shape[1] * (ys.shape[0] - len(sel))
+            if pad > 0:
+                lanes.append((None, {"padding": pad}))
+            self.goodput.observe("verify", info["t0"], now, lanes)
         from .spec import SPEC_EMA_ALPHA
 
         with self._lock:
@@ -7229,6 +7478,24 @@ class ReplicatedLLMEngine:
                 for c, w in weights.items():
                     engine_kw["fair_ledger"].set_weight(c, w)
         self.ledger = engine_kw.get("fair_ledger")
+        # ONE usage meter shared by every replica (the fair-ledger
+        # pattern): per-tenant chip-second/token windows pool across the
+        # fleet, so quota enforcement and the usage endpoint see the
+        # tenant's total rate no matter which replica admitted the
+        # request. Retained in _engine_kw for supervised rebuilds.
+        gp_on = engine_kw.get("goodput")
+        if gp_on is None:
+            gp_on = _os.environ.get("TPU_LLM_GOODPUT", "1") not in ("", "0")
+        if gp_on and engine_kw.get("usage_meter") is None:
+            from .goodput import UsageMeter
+
+            win = engine_kw.get("usage_window_s")
+            if win is None:
+                win = float(
+                    _os.environ.get("TPU_LLM_USAGE_WINDOW_S", "") or 60.0
+                )
+            engine_kw["usage_meter"] = UsageMeter(window_s=float(win))
+        self.usage = engine_kw.get("usage_meter")
         # Fleet admission cap: reject at the summed queued-token estimate
         # across accepting replicas instead of piling onto the last
         # healthy engine (0 disables; per-engine max_queue still applies)
@@ -7524,7 +7791,7 @@ class ReplicatedLLMEngine:
                 try:
                     ref = e.generate(
                         list(CANARY_PROMPT), max_new_tokens=CANARY_MAX_NEW,
-                        temperature=0.0, eos_token=-1,
+                        temperature=0.0, eos_token=-1, probe=True,
                     )
                     if len(ref) == CANARY_MAX_NEW:
                         self._canary_ref[v] = ref
@@ -7945,9 +8212,15 @@ class ReplicatedLLMEngine:
                     self.retry_budget_exhausted += 1
                 self._observe_retry_budget()
             if budget_ok and r.retries <= self.failover_retries:
+                # goodput replay marker: the survivor re-prefills work
+                # the dead replica already did — its prefill progress,
+                # or the whole grown prompt once history folds in
+                replay_to = r.prefill_pos
                 if r.history:
                     r.prompt_tokens = list(r.prompt_tokens) + r.history
                     r.history = []
+                    replay_to = len(r.prompt_tokens)
+                r._replay_pos = max(r._replay_pos, replay_to)
                 # reset engine-owned scheduling state; consumer-facing
                 # state (out queue, emitted, span) carries over
                 r.finish_reason = None
@@ -8110,6 +8383,9 @@ class ReplicatedLLMEngine:
             # average of per-replica percentiles (which has no meaning)
             "phases": self._merged_phases(),
             "mfu": self._merged_mfu(),
+            # fleet chip-time attribution (gofr_tpu.goodput): summed
+            # per-replica ledgers; ratio recomputed from the pooled sums
+            "goodput": self._merged_goodput(),
         }
         prefixes = [
             s["kvcache"]["prefix"] for s in per if s["kvcache"].get("prefix")
@@ -8203,8 +8479,58 @@ class ReplicatedLLMEngine:
             "canary": self._canary_enabled,
             "phases": self._merged_phases(),
             "slo": self._merged_slo(),
+            "goodput": self._merged_goodput(),
+            "usage": (
+                self.usage.snapshot() if self.usage is not None else None
+            ),
             "per_replica": [e.debug_state() for e in self.engines],
         }
+
+    def _merged_goodput(self) -> dict | None:
+        """Fleet goodput pooling: chip-second sums are additive across
+        replicas; the useful fraction recomputes from the pooled sums
+        (never average per-replica ratios)."""
+        from .goodput import pool_goodput
+
+        snaps = [
+            e.goodput.snapshot() for e in self.engines
+            if e.goodput is not None
+        ]
+        return pool_goodput(snaps) if snaps else None
+
+    def usage_state(self) -> dict:
+        """Windowed per-tenant usage + pooled goodput for the
+        /.well-known/debug/usage endpoint (chargeback export). The meter
+        is SHARED across replicas, so tenant windows are fleet-local
+        totals already — no per-replica summing needed."""
+        usage = (
+            self.usage.snapshot() if self.usage is not None
+            else {"window_s": None, "tenants": {}}
+        )
+        return {
+            "replicas": len(self.engines),
+            "goodput": self._merged_goodput(),
+            "quota": (
+                self.engines[0].quota.snapshot()
+                if self.engines and self.engines[0].quota is not None
+                else None
+            ),
+            "quota_sheds": sum(e.quota_sheds for e in self.engines),
+            **usage,
+        }
+
+    def set_tenant_quota(self, tenant: str, tok_s: float | None) -> None:
+        """Fleet quota update: every replica's gate enforces against the
+        SHARED usage meter, so the ceiling is a fleet-total rate.
+        Retained in _engine_kw so supervised rebuilds rejoin with the
+        same quota table (the shared-ledger discipline)."""
+        q = self._engine_kw.setdefault("quotas", {})
+        if tok_s is None or tok_s <= 0:
+            q.pop(tenant, None)
+        else:
+            q[tenant] = float(tok_s)
+        for e in self.engines:
+            e.set_tenant_quota(tenant, tok_s)
 
     def _merged_slo(self) -> dict | None:
         """Fleet SLO pooling: summed goodput, max-burn-across-replicas

@@ -106,6 +106,13 @@ def register_openai_routes(
     stream_timeout = float(
         cfg.get_or_default("GOFR_OPENAI_STREAM_TIMEOUT_S", "120")
     )
+    # GOFR_OPENAI_USAGE_EXTRA=1: the usage object additionally carries
+    # the request's chip-time attribution (gofr_tpu.goodput) — total
+    # device milliseconds and the waste breakdown. Off by default so the
+    # wire format stays byte-compatible with the OpenAI schema.
+    usage_extra = cfg.get_or_default(
+        "GOFR_OPENAI_USAGE_EXTRA", "0"
+    ) not in ("", "0")
     # per-MODEL caches: the routes dispatch on the request's model field
     # across every registered LLM, and vocabularies differ per model — a
     # shared cache would compile grammars over the wrong vocab. An
@@ -378,6 +385,17 @@ def register_openai_routes(
             None, lambda: req.tokens(timeout=stream_timeout)
         )
         content_ids = [t for t in out if t != eos_id]
+        usage = {
+            "prompt_tokens": n_prompt,
+            "completion_tokens": len(out),
+            "total_tokens": n_prompt + len(out),
+        }
+        if usage_extra:
+            chip = dict(getattr(req, "_chip", None) or {})
+            usage["chip_time_ms"] = round(sum(chip.values()) * 1e3, 3)
+            usage["chip_breakdown_ms"] = {
+                c: round(v * 1e3, 3) for c, v in chip.items()
+            }
         payload = {
             **base,
             "object": "chat.completion",
@@ -389,11 +407,7 @@ def register_openai_routes(
                 },
                 "finish_reason": _finish(req.finish_reason),
             }],
-            "usage": {
-                "prompt_tokens": n_prompt,
-                "completion_tokens": len(out),
-                "total_tokens": n_prompt + len(out),
-            },
+            "usage": usage,
         }
         return Response(
             200, [("Content-Type", "application/json")], to_json_bytes(payload)

@@ -340,7 +340,7 @@ def canary_check(
     try:
         req = candidate.submit(GenRequest(
             list(prompt), max_new_tokens=max_new, temperature=0.0,
-            eos_token=-1,
+            eos_token=-1, probe=True,
         ))
         toks = req.tokens(timeout=timeout)
     except Exception as e:  # noqa: BLE001 — a crashing probe IS the verdict

@@ -156,6 +156,7 @@ def shadow_probe(candidate, prompts, *, max_new: int = SHADOW_MAX_NEW,
             req = candidate.submit(GenRequest(
                 list(prompt), max_new_tokens=max_new, temperature=0.0,
                 eos_token=-1, adapter=adapter, traceparent=tp,
+                probe=True,
             ))
             toks = req.tokens(timeout=timeout)
         except Exception as e:  # noqa: BLE001 — a crashing replay IS the verdict
@@ -861,7 +862,7 @@ class ModelHandle:
     def register_adapter(
         self, name: str, adapter: dict, *, version: str = "v1",
         alpha: float | None = None, fair_weight: float | None = None,
-        shadow_probes: int | None = None,
+        shadow_probes: int | None = None, quota: float | None = None,
     ) -> dict:
         """Canary-gated adapter hot-load — the PR 9 deploy shape scaled
         down to a table row. The checkpoint is validated against the
@@ -875,7 +876,10 @@ class ModelHandle:
         serving untouched (canary-reject-keeps-serving, test-pinned).
         In-flight requests on a replaced binding drain on their old gid.
         ``fair_weight`` sets the tenant's FairLedger share
-        (``adapter:<name>``) after publish."""
+        (``adapter:<name>``) after publish; ``quota`` sets a hard
+        token-rate ceiling (tok/s) on the same tenant id, enforced at
+        admission against the goodput usage meter
+        (docs/advanced-guide/cost-accounting.md)."""
         eng = self._engine
         staging = f"{name}@{version}"
         probes = (
@@ -913,12 +917,16 @@ class ModelHandle:
             ledger = getattr(eng, "ledger", None)
             if ledger is not None:
                 ledger.set_weight(f"adapter:{name}", fair_weight)
+        if quota is not None:
+            set_q = getattr(eng, "set_tenant_quota", None)
+            if set_q is not None:
+                set_q(f"adapter:{name}", float(quota))
         # host registry: the fleet keeps its own (replica rebuilds
         # re-stage from it); a bare engine's lives on this handle so the
         # blue-green engine swap can re-stage into its candidate
         rec = {
             "adapter": adapter, "version": str(version), "alpha": alpha,
-            "fair_weight": fair_weight,
+            "fair_weight": fair_weight, "quota": quota,
         }
         host = getattr(eng, "_adapters_host", None)
         if host is not None:

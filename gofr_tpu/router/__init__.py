@@ -162,6 +162,22 @@ class FrontRouter:
         self.sheds = 0
         self.proxied = 0
         self.retries = 0
+        # -- front-door tenant quotas (gofr_tpu.goodput; docs/advanced-
+        # guide/cost-accounting.md) — opt-in. The router prices a
+        # tenant's FLEET-WIDE token rate from the usage endpoint it
+        # already fans (TTL-cached so the hot path pays one fan per
+        # refresh window, not per request) and sheds over-quota traffic
+        # before it costs a proxy hop. Engine-side admission quotas
+        # (TPU_LLM_TENANT_QUOTA_TOK_S) still apply behind it.
+        from ..goodput import parse_quota_spec
+
+        self.tenant_quotas = parse_quota_spec(
+            config.get("TPU_ROUTER_TENANT_QUOTA_TOK_S") or ""
+        )
+        self.quota_refresh_s = g("TPU_ROUTER_QUOTA_REFRESH_S", 2.0)
+        self.quota_sheds = 0
+        self._usage_cache: tuple[float, dict] | None = None
+        self._usage_lock = threading.Lock()
         self._live_pid = os.getpid()
         self._pid_lock = threading.Lock()
         self.autoscaler: Autoscaler | None = None
@@ -227,6 +243,14 @@ class FrontRouter:
                 "app_router_blackbox_queries_total",
                 "fleet black-box listings by outcome (ok|partial|empty)",
             )
+            metrics.new_counter(
+                "app_router_usage_queries_total",
+                "fleet usage-meter fans by outcome (ok|partial|empty)",
+            )
+            metrics.new_counter(
+                "app_router_quota_sheds_total",
+                "front-door 429s for tenants over token-rate quota",
+            )
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> None:
@@ -277,7 +301,73 @@ class FrontRouter:
                 self.autoscaler.snapshot()
                 if self.autoscaler is not None else None
             ),
+            "tenant_quotas": dict(self.tenant_quotas),
+            "quota_sheds": self.quota_sheds,
         }
+
+    # -- front-door tenant quotas (gofr_tpu.goodput) -----------------------
+    def fleet_usage(self) -> dict:
+        """Fleet-pooled per-tenant usage, TTL-cached: fan the usage
+        endpoint over every backend (each process meters only what IT
+        served) and sum tenant windows. The cache bounds the fan to one
+        sweep per TPU_ROUTER_QUOTA_REFRESH_S, so the proxy hot path
+        reads a dict, not the network."""
+        now = self._now()
+        with self._usage_lock:
+            cached = self._usage_cache
+            if cached is not None and cached[0] > now:
+                return cached[1]
+            tenants: dict[str, dict] = {}
+            failures = polled = 0
+            for b in self.fleet.backends():
+                polled += 1
+                try:
+                    out = b.svc.request(
+                        "GET", "/.well-known/debug/usage",
+                        timeout=max(self.quota_refresh_s, 1.0),
+                    ).json()
+                except Exception:  # noqa: BLE001 — a dead shard is partial data
+                    failures += 1
+                    continue
+                frag = out.get("data", out) if isinstance(out, dict) else {}
+                for m in (frag.get("models") or {}).values():
+                    win = m.get("window_s")
+                    for tenant, row in (m.get("tenants") or {}).items():
+                        agg = tenants.setdefault(tenant, {
+                            "tokens": 0, "tok_s": 0.0,
+                            "chip_s_total": 0.0, "window_s": win,
+                        })
+                        agg["tokens"] += row.get("tokens", 0)
+                        agg["tok_s"] += row.get("tok_s", 0.0)
+                        agg["chip_s_total"] += row.get("chip_s_total", 0.0)
+            if polled:
+                outcome = (
+                    "empty" if not tenants
+                    else ("partial" if failures else "ok")
+                )
+                self._count("app_router_usage_queries_total", outcome=outcome)
+            self._usage_cache = (now + self.quota_refresh_s, tenants)
+            return tenants
+
+    def quota_check(self, tenant: str) -> float | None:
+        """None when the tenant may proceed; otherwise the priced
+        Retry-After: the time the trailing window needs, with no new
+        admissions, for the tenant's fleet rate to decay under quota."""
+        if not self.tenant_quotas or not tenant:
+            return None
+        quota = self.tenant_quotas.get(tenant)
+        if quota is None:
+            quota = self.tenant_quotas.get("*")
+        if quota is None:
+            return None
+        row = self.fleet_usage().get(tenant)
+        if row is None:
+            return None
+        rate = row.get("tok_s", 0.0)
+        if rate <= quota:
+            return None
+        win = row.get("window_s") or 60.0
+        return max(0.5, (rate - quota) * win / quota)
 
     # -- routing -----------------------------------------------------------
     def pick(self, session_id: str, exclude: set[str]) -> tuple[Backend | None, str]:
@@ -345,6 +435,22 @@ class FrontRouter:
             from ..handler import llm_request_kwargs
 
             fwd["X-GoFr-Client"] = llm_request_kwargs(ctx)["client"]
+        if self.tenant_quotas:
+            # front-door tenant quota: shed over-quota traffic before it
+            # costs a proxy hop, priced from the fleet usage windows
+            tenant = (
+                fwd.get("x-gofr-client") or fwd.get("X-GoFr-Client") or ""
+            )
+            quota_retry = self.quota_check(tenant)
+            if quota_retry is not None:
+                self.quota_sheds += 1
+                self._count("app_router_quota_sheds_total", tenant=tenant)
+                self._count("app_router_requests_total", outcome="quota_shed")
+                raise ErrorTooManyRequests(
+                    f"tenant {tenant!r} over token-rate quota "
+                    "(TPU_ROUTER_TENANT_QUOTA_TOK_S)",
+                    retry_after=quota_retry,
+                )
         session_id = req.headers.get("x-gofr-session", "")
         sem = self._acquire_sem()
         if sem is not None:
@@ -665,6 +771,82 @@ def blackbox_fleet_handler(ctx):
     }
 
 
+def usage_fleet_handler(ctx):
+    """GET /.well-known/debug/usage — the fleet chargeback view: fan the
+    per-process usage route over every backend (each process meters only
+    the chip time IT spent) and merge per model and per tenant. A fleet
+    operator asks ONE place "which tenant burned which chip-seconds" —
+    the journey/blackbox fan shape applied to the goodput meter.
+    Backends that can't answer are partial data, not a failure."""
+    fr = getattr(ctx.container, "front_router", None)
+    models: dict[str, dict] = {}
+    polled: list[dict] = []
+    failures = 0
+    if fr is not None:
+        cfg = ctx.container.config
+        try:
+            timeout = cfg.get_float("TPU_ROUTER_JOURNEY_TIMEOUT_S", 5.0)
+        except Exception:  # noqa: BLE001 — malformed config -> default
+            timeout = 5.0
+        for b in fr.fleet.backends():
+            try:
+                out = b.svc.request(
+                    "GET", "/.well-known/debug/usage", timeout=timeout,
+                ).json()
+            except Exception as e:  # noqa: BLE001 — a dead shard is partial data
+                failures += 1
+                polled.append({
+                    "address": b.address, "ok": False, "error": repr(e),
+                })
+                continue
+            frag = out.get("data", out) if isinstance(out, dict) else {}
+            got = frag.get("models") or {}
+            for name, m in got.items():
+                if not isinstance(m, dict):
+                    continue
+                agg = models.setdefault(name, {
+                    "window_s": m.get("window_s"),
+                    "tenants": {},
+                    "goodput": None,
+                    "quota_sheds": 0,
+                })
+                from ..goodput import pool_goodput
+
+                gp = [s for s in (agg["goodput"], m.get("goodput")) if s]
+                agg["goodput"] = pool_goodput(gp) if gp else None
+                agg["quota_sheds"] += m.get("quota_sheds", 0) or 0
+                for tenant, row in (m.get("tenants") or {}).items():
+                    if not isinstance(row, dict):
+                        continue
+                    t = agg["tenants"].setdefault(tenant, {
+                        "chip_s": {}, "chip_s_total": 0.0,
+                        "tokens": 0, "tok_s": 0.0,
+                    })
+                    for cls, v in (row.get("chip_s") or {}).items():
+                        t["chip_s"][cls] = round(
+                            t["chip_s"].get(cls, 0.0) + v, 6
+                        )
+                    t["chip_s_total"] = round(
+                        t["chip_s_total"] + row.get("chip_s_total", 0.0), 6
+                    )
+                    t["tokens"] += row.get("tokens", 0)
+                    t["tok_s"] = round(t["tok_s"] + row.get("tok_s", 0.0), 3)
+            polled.append({
+                "address": b.address, "ok": True, "models": len(got),
+            })
+        outcome = (
+            "empty" if not models else ("partial" if failures else "ok")
+        )
+        fr._count("app_router_usage_queries_total", outcome=outcome)
+    return {
+        "models": models,
+        "count": len(models),
+        "backends": polled,
+        "quotas": dict(fr.tenant_quotas) if fr is not None else {},
+        "quota_sheds": fr.quota_sheds if fr is not None else 0,
+    }
+
+
 def router_debug_handler(ctx):
     """GET /.well-known/router — the live fleet view: per-backend
     health/load/breaker state, ring membership, admission + autoscaler
@@ -705,6 +887,9 @@ def new_router_app(config=None, *, configs_dir: str = "./configs"):
     # the fleet incident listing (docs/advanced-guide/
     # incident-debugging.md): same precedence rule as the stitcher
     app.get("/.well-known/debug/blackbox", blackbox_fleet_handler)
+    # the fleet chargeback view (docs/advanced-guide/cost-accounting.md):
+    # same precedence rule — per-tenant chip-seconds pooled fleet-wide
+    app.get("/.well-known/debug/usage", usage_fleet_handler)
     # HEAD rides along so LB health probes / curl -I against proxied
     # paths answer like direct engine access would; OPTIONS needs no
     # route — the CORS middleware short-circuits every preflight

@@ -1,0 +1,279 @@
+#!/usr/bin/env python
+"""CI goodput-ledger smoke: device-time attribution, per-tenant
+chargeback, and quota enforcement over real sockets
+(docs/advanced-guide/cost-accounting.md).
+
+Boots a front router over a 2-replica engine app with a fault injector,
+one LoRA adapter tenant resident next to base-model traffic, and a hard
+token-rate quota on one tenant, then drives the chargeback loop a fleet
+operator would:
+
+- mixed warm load from two tenants (base client `alice`, adapter tenant
+  `adapter:acme`) meters per-tenant chip-seconds and useful tokens,
+- an injected replica kill mid-stream forces a failover continuation;
+  the re-prefill of already-served positions shows up as `replay` waste
+  in the merged ledger — conservation (attributed + idle == wall)
+  holds within 1% across the kill,
+- GET /.well-known/debug/usage (per-process AND fanned fleet-wide by
+  the router) serves the windowed per-tenant usage: both tenants'
+  chip-seconds are positive and sum to no more than the attributed
+  device time,
+- the quota'd tenant `greedy` (TPU_LLM_TENANT_QUOTA_TOK_S semantics via
+  the quotas= engine knob) sheds at admission with HTTP 429 + a priced
+  Retry-After while `alice` keeps serving,
+- app_llm_goodput_seconds_total / app_llm_tenant_chip_seconds_total /
+  app_llm_quota_sheds_total land on /metrics.
+
+Usage: JAX_PLATFORMS=cpu python scripts/smoke_goodput.py
+Exit codes: 0 clean, non-zero assertion failure (message on stderr).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# two virtual CPU devices for the 2-replica fleet — BEFORE jax import
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=2"
+    ).strip()
+
+
+def _wait(pred, timeout: float, what: str) -> None:
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def _get(base: str, path: str, timeout=30):
+    with urllib.request.urlopen(f"{base}{path}", timeout=timeout) as r:
+        return r.read().decode()
+
+
+def _post(base: str, path: str, payload: dict, headers=None, timeout=120):
+    hdrs = {"Content-Type": "application/json"}
+    hdrs.update(headers or {})
+    req = urllib.request.Request(
+        f"{base}{path}", data=json.dumps(payload).encode(),
+        headers=hdrs, method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read())["data"]
+
+
+def main() -> int:
+    import jax
+
+    from gofr_tpu import App
+    from gofr_tpu.config import new_mock_config
+    from gofr_tpu.handler import llm_request_kwargs
+    from gofr_tpu.lora import init_adapter
+    from gofr_tpu.models import TransformerConfig, init_params
+    from gofr_tpu.resilience import FaultInjector
+    from gofr_tpu.router import new_router_app
+
+    cfg = TransformerConfig.tiny()
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    assert len(jax.devices()) >= 2, jax.devices()
+    inj = FaultInjector()
+
+    app = App(config=new_mock_config({
+        "APP_NAME": "engines", "HTTP_PORT": "0", "METRICS_PORT": "0",
+        "LOG_LEVEL": "ERROR", "TPU_TELEMETRY_INTERVAL_S": "0",
+        "REQUEST_TIMEOUT": "120",
+    }))
+    # small chunks: many scheduler passes, room to kill mid-flight.
+    # quotas= is the engine-knob spelling of TPU_LLM_TENANT_QUOTA_TOK_S.
+    app.container.tpu().register_llm(
+        "tiny", cfg, params, max_seq_len=128, prefill_buckets=(8,),
+        prefill_chunk=4, step_token_budget=4, decode_chunk=2, lookahead=1,
+        replicas=2, fault_injector=inj, warmup=True, lora_slots=4,
+        # 0.25 tok/s over the 60 s usage window allows ~15 tokens —
+        # greedy's first request (24 prompt + 12 decode) blows through it
+        quotas={"greedy": 0.25},
+    )
+    rep = app.container.tpu().llm("tiny").engine
+    rep.load_adapter("acme", init_adapter(jax.random.PRNGKey(7), cfg, rank=4))
+
+    def gen(ctx):
+        body = ctx.bind()
+        kw = llm_request_kwargs(ctx)
+        if body.get("adapter"):
+            kw["adapter"] = body["adapter"]
+            kw.pop("client", None)  # adapter requests bill adapter:<name>
+        out = ctx.tpu().llm("tiny").generate(
+            list(body["tokens"]),
+            max_new_tokens=int(body.get("max_new_tokens", 4)),
+            **kw,
+        )
+        return {"tokens": out}
+
+    app.post("/generate", gen)
+    app.run_in_background()
+
+    router = new_router_app(config=new_mock_config({
+        "APP_NAME": "router", "HTTP_PORT": "0", "METRICS_PORT": "0",
+        "LOG_LEVEL": "ERROR", "REQUEST_TIMEOUT": "60",
+        "TPU_ROUTER_BACKENDS":
+            f"http://127.0.0.1:{app.http_server.port}",
+        "TPU_ROUTER_POLL_INTERVAL_S": "0.1",
+    }))
+    router.run_in_background()
+
+    base = f"http://127.0.0.1:{app.http_server.port}"
+    mbase = f"http://127.0.0.1:{app.metrics_server.port}"
+    rbase = f"http://127.0.0.1:{router.http_server.port}"
+    prompt = list(range(1, 25))  # 24 tokens -> 6 prefill chunks
+    try:
+        _wait(lambda: len(router.front_router.fleet.accepting()) == 1,
+              15, "router sees the backend")
+
+        # ------------------------------------------- mixed tenant load
+        alice = {"X-GoFr-Client": "alice"}
+        for _ in range(4):
+            got = _post(base, "/generate",
+                        {"tokens": prompt, "max_new_tokens": 6},
+                        headers=alice)["tokens"]
+            assert len(got) == 6, got
+        for _ in range(3):
+            got = _post(base, "/generate",
+                        {"tokens": prompt[:12], "max_new_tokens": 6,
+                         "adapter": "acme"})["tokens"]
+            assert len(got) == 6, got
+        print("warm load: 4x alice + 3x adapter:acme served")
+
+        # --------------------------------- replica kill mid-stream
+        result: dict = {}
+
+        def client():
+            result.update(_post(
+                base, "/generate",
+                {"tokens": prompt, "max_new_tokens": 48},
+                headers=alice, timeout=120,
+            ))
+
+        t = threading.Thread(target=client)
+        t.start()
+
+        def serving_index():
+            for i, e in enumerate(rep.engines):
+                if any(r is not None and r.emitted > 0
+                       for r in e._slot_req):
+                    return i
+            return None
+
+        _wait(lambda: serving_index() is not None, 30, "first token")
+        victim = serving_index()
+        inj.arm("replica_kill", label=f"/r{victim}")
+        t.join(timeout=120)
+        assert not t.is_alive(), "client hung"
+        assert len(result["tokens"]) == 48, result
+        _wait(lambda: rep.failovers >= 1, 10, "failover counted")
+        print(f"replica {victim} killed mid-stream; "
+              "continuation finished on the survivor")
+
+        # ------------------------- ledger: replay waste + conservation
+        merged = rep.stats()["goodput"]
+        gap = abs(merged["attributed_s"] + merged["idle_s"]
+                  - merged["wall_s"])
+        assert gap <= 0.01 * merged["wall_s"], merged
+        assert merged["by_class"]["replay"] > 0, merged
+        assert merged["by_class"]["useful"] > 0, merged
+        print(f"merged ledger conserves: wall={merged['wall_s']:.3f}s "
+              f"attributed={merged['attributed_s']:.3f}s "
+              f"idle={merged['idle_s']:.3f}s "
+              f"replay={merged['by_class']['replay']:.4f}s")
+
+        # -------------------------------- usage endpoint (per-process)
+        usage = json.loads(_get(
+            base, "/.well-known/debug/usage"))["data"]
+        tiny = usage["models"]["tiny"]
+        assert tiny["replicas"] == 2, tiny
+        tenants = tiny["tenants"]
+        assert tenants["alice"]["chip_s_total"] > 0, tenants
+        assert tenants["alice"]["tokens"] > 0, tenants
+        assert tenants["adapter:acme"]["chip_s_total"] > 0, tenants
+        tenant_sum = sum(t["chip_s_total"] for t in tenants.values())
+        # chargeback is closed: per-tenant chip-seconds sum to ~the
+        # attributed engine time (slack is billed to the requests packed
+        # in each window, so nothing vanishes off-book)
+        att = tiny["goodput"]["attributed_s"]
+        assert 0.95 * att <= tenant_sum <= 1.01 * att, (tenant_sum, att)
+        print(f"usage endpoint: {len(tenants)} tenants, "
+              f"chip sum {tenant_sum:.3f}s of "
+              f"{tiny['goodput']['attributed_s']:.3f}s attributed")
+
+        # --------------------------------------- quota shed at the edge
+        # build up greedy's usage window (admits: no usage on file yet),
+        # then watch the second admission shed with a priced Retry-After
+        got = _post(rbase, "/generate",
+                    {"tokens": prompt, "max_new_tokens": 12},
+                    headers={"X-GoFr-Client": "greedy"})["tokens"]
+        assert len(got) == 12
+        try:
+            _post(rbase, "/generate",
+                  {"tokens": prompt, "max_new_tokens": 4},
+                  headers={"X-GoFr-Client": "greedy"})
+            raise AssertionError("over-quota admission was not shed")
+        except urllib.error.HTTPError as e:
+            assert e.code == 429, e.code
+            retry = e.headers.get("Retry-After")
+            assert retry is not None and float(retry) > 0, retry
+        # the un-quota'd tenant is untouched by greedy's shed
+        got = _post(rbase, "/generate",
+                    {"tokens": prompt[:8], "max_new_tokens": 4},
+                    headers=alice)["tokens"]
+        assert len(got) == 4
+        assert rep.usage_state()["quota_sheds"] >= 1
+        print(f"quota: greedy shed 429 Retry-After={retry}s; "
+              "alice unaffected")
+
+        # ------------------------------------------------- /metrics
+        expo = _get(mbase, "/metrics")
+        for needle in (
+            'app_llm_goodput_seconds_total{',
+            'class="useful"',
+            'class="replay"',
+            'app_llm_goodput_ratio{',
+            'app_llm_tenant_chip_seconds_total{',
+            'tenant="adapter:acme"',
+            'app_llm_tenant_tokens_total{',
+            'app_llm_quota_sheds_total{',
+            'tenant="greedy"',
+        ):
+            assert needle in expo, f"missing on /metrics: {needle}"
+        print("metrics: goodput + tenant + quota counter families hot")
+
+        # --------------------------------------------- router fleet fan
+        fan = json.loads(_get(
+            rbase, "/.well-known/debug/usage"))["data"]
+        assert fan["count"] >= 1, fan
+        ftiny = fan["models"]["tiny"]
+        assert ftiny["tenants"]["alice"]["chip_s_total"] > 0, ftiny
+        assert ftiny["goodput"]["by_class"]["replay"] > 0, ftiny
+        assert fan["backends"] and all(
+            b.get("ok") for b in fan["backends"]), fan
+        print(f"router fan: {fan['count']} model(s) over "
+              f"{len(fan['backends'])} backend(s)")
+
+        print("GOODPUT SMOKE OK")
+        return 0
+    finally:
+        router.shutdown()
+        app.shutdown()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -226,6 +226,32 @@ def test_summary_line_carries_obs_overhead():
     assert "obs_overhead" not in bench._summary_line(_serving_result())
 
 
+def test_summary_line_carries_goodput():
+    """The goodput-ledger point rides the summary as a compact block:
+    the measured goodput ratio, the meter's decode-throughput overhead
+    vs meter-off (adjudicated <=3% claim), and the per-class waste
+    split of attributed device time."""
+    r = _serving_result()
+    r["detail"]["goodput"] = {
+        "requests": 256, "new_tokens": 64, "claim_frac": 0.03,
+        "base_tok_s": 21400.0, "metered_tok_s": 21200.0,
+        "overhead_frac": 0.009, "within_claim": True,
+        "goodput_ratio": 0.81, "idle_frac": 0.02,
+        "waste_frac": {"padding": 0.17, "spec_reject": 0.0,
+                       "replay": 0.0, "probe": 0.0},
+    }
+    s = bench._summary_line(r)
+    assert s["goodput"] == {
+        "goodput_ratio": 0.81, "overhead_frac": 0.009,
+        "within_claim": True,
+        "waste_frac": {"padding": 0.17, "spec_reject": 0.0,
+                       "replay": 0.0, "probe": 0.0},
+    }
+    assert len(json.dumps(s)) < 1500
+    # absent block (--no-goodput / CPU runs) must not leak a key
+    assert "goodput" not in bench._summary_line(_serving_result())
+
+
 def test_summary_line_carries_multitenant():
     """The multi-tenant LoRA point rides the summary as a compact block:
     4-adapter mixed-batch decode tok/s vs the single-tenant baseline
